@@ -1,0 +1,113 @@
+"""Device management — TPU-native analog of the reference's hl device layer.
+
+The reference manages CUDA devices/streams/events explicitly (reference:
+paddle/cuda/include/hl_cuda.h:34-343, src/hl_cuda_device.cc:86-162).  Under
+XLA none of that is user-visible: devices come from ``jax.devices()``, streams
+are the runtime's, and multi-device execution is expressed as a
+``jax.sharding.Mesh``.  This module is the single place that touches global
+device state: platform selection, virtual-device forcing for tests, and mesh
+construction from flags.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "init",
+    "devices",
+    "device_count",
+    "default_backend",
+    "make_mesh",
+    "force_virtual_devices",
+]
+
+_initialized = False
+
+
+def force_virtual_devices(n: int) -> None:
+    """Force N virtual CPU devices (must run before first jax import/use).
+
+    Test-only analog of a multi-chip pod; see SURVEY.md §4 (device-equivalence
+    strategy) — used by tests/conftest.py and driver dry runs.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    token = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def init(argv: Optional[list] = None) -> list:
+    """Framework init — analog of paddle.init()/initMain (reference:
+    paddle/trainer/TrainerMain.cpp:32-49).  Parses flags, selects platform,
+    seeds determinism. Returns leftover argv."""
+    global _initialized
+    from paddle_tpu.utils.flags import FLAGS, parse_flags
+
+    rest = parse_flags(argv)
+    if not _initialized:
+        if FLAGS.num_virtual_devices:
+            force_virtual_devices(FLAGS.num_virtual_devices)
+        if FLAGS.platform:
+            os.environ["JAX_PLATFORMS"] = FLAGS.platform
+        _initialized = True
+    return rest
+
+
+def devices() -> List:
+    import jax
+
+    return jax.devices()
+
+
+def device_count() -> int:
+    return len(devices())
+
+
+def default_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _parse_mesh_shape(spec: str, ndev: int) -> Tuple[int, ...]:
+    if not spec:
+        return (ndev,)
+    dims = tuple(int(d) for d in spec.replace(",", "x").split("x") if d)
+    return dims
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+):
+    """Build a ``jax.sharding.Mesh`` from flags or explicit shape.
+
+    This replaces both the reference's per-GPU TrainerThread pool
+    (gserver/gradientmachines/MultiGradientMachine.h:44-94) and its
+    trainers-by-pservers network topology (pserver/): on TPU the set of chips is
+    one SPMD mesh and collectives ride ICI.
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.utils.flags import FLAGS
+
+    devs = jax.devices()
+    if shape is None:
+        shape = _parse_mesh_shape(FLAGS.mesh_shape, len(devs))
+    if axis_names is None:
+        axis_names = tuple(FLAGS.mesh_axes.split(","))[: len(shape)]
+    shape = tuple(shape)
+    if len(axis_names) != len(shape):
+        # default names data, model, seq, ... truncated/extended to rank
+        base = ("data", "model", "seq", "expert", "stage")
+        axis_names = base[: len(shape)]
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(shape)
+    return Mesh(arr, axis_names)

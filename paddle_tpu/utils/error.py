@@ -1,0 +1,56 @@
+"""Errors and layer-stack debugging.
+
+The reference carries an Error monad (paddle/utils/Error.h) and a per-thread
+custom layer call-stack printed on crash (paddle/utils/CustomStackTrace.h:51-182).
+In a traced/functional world the useful analog is a scoped *build* stack: while a
+topology is being built or applied, layer names are pushed so any exception
+message names the layer responsible.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator, List
+
+__all__ = ["PaddleTpuError", "ConfigError", "ShapeError", "layer_scope", "current_layer_stack"]
+
+
+class PaddleTpuError(Exception):
+    """Base for framework errors."""
+
+
+class ConfigError(PaddleTpuError):
+    """Bad model/layer configuration."""
+
+
+class ShapeError(PaddleTpuError):
+    """Shape/dtype mismatch when wiring or applying layers."""
+
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextmanager
+def layer_scope(name: str) -> Iterator[None]:
+    stack = _stack()
+    stack.append(name)
+    try:
+        yield
+    except PaddleTpuError:
+        raise
+    except Exception as e:
+        path = " -> ".join(stack)
+        raise PaddleTpuError(f"error in layer stack [{path}]: {e}") from e
+    finally:
+        stack.pop()
+
+
+def current_layer_stack() -> List[str]:
+    return list(_stack())

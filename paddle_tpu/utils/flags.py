@@ -1,0 +1,177 @@
+"""Runtime flag system — the gflags plane of the reference.
+
+The reference keeps ~117 global gflags (reference: paddle/utils/Flags.cpp:18-77)
+controlling devices, trainer counts, ports, logging cadence, etc.  Here flags are
+a typed registry parsed from argv and ``PADDLE_TPU_*`` environment variables.
+TPU-relevant flags replace the CUDA ones (use_gpu -> use_tpu/platform), and the
+pserver networking flags are replaced by mesh-shape flags (the pserver tier does
+not exist on TPU; see parallel/).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["FLAGS", "define_flag", "parse_flags", "flags_snapshot"]
+
+_ENV_PREFIX = "PADDLE_TPU_"
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    help: str
+    type: type
+    validator: Optional[Callable[[Any], bool]] = None
+
+
+class _Flags:
+    """Singleton typed flag store.
+
+    Mirrors the role of the DEFINE_int32/DEFINE_bool/... globals in the
+    reference (paddle/utils/Flags.cpp); values are attributes: ``FLAGS.log_period``.
+    """
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_specs", {})
+        object.__setattr__(self, "_values", {})
+        object.__setattr__(self, "_lock", threading.Lock())
+
+    def _define(self, spec: _FlagSpec) -> None:
+        with self._lock:
+            if spec.name in self._specs:
+                raise ValueError(f"flag {spec.name!r} already defined")
+            self._specs[spec.name] = spec
+            env = os.environ.get(_ENV_PREFIX + spec.name.upper())
+            self._values[spec.name] = (
+                _coerce(env, spec.type) if env is not None else spec.default
+            )
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"unknown flag {name!r}") from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name not in self._specs:
+            raise AttributeError(f"unknown flag {name!r}")
+        spec = self._specs[name]
+        value = _coerce(value, spec.type)
+        if spec.validator is not None and not spec.validator(value):
+            raise ValueError(f"invalid value {value!r} for flag {name!r}")
+        self._values[name] = value
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def _coerce(value: Any, typ: type) -> Any:
+    if isinstance(value, typ):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return typ(value)
+
+
+FLAGS = _Flags()
+
+
+def define_flag(
+    name: str,
+    default: Any,
+    help: str = "",
+    *,
+    type: Optional[type] = None,
+    validator: Optional[Callable[[Any], bool]] = None,
+) -> None:
+    FLAGS._define(
+        _FlagSpec(
+            name=name,
+            default=default,
+            help=help,
+            type=type or (bool if isinstance(default, bool) else builtins_type(default)),
+            validator=validator,
+        )
+    )
+
+
+def builtins_type(v: Any) -> type:
+    for t in (bool, int, float, str):
+        if isinstance(v, t):
+            return t
+    return object
+
+
+def parse_flags(argv: Optional[list] = None) -> list:
+    """Parse ``--name=value`` / ``--name value`` args; returns leftover argv."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rest = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("--"):
+            body = arg[2:]
+            if "=" in body:
+                name, value = body.split("=", 1)
+            else:
+                name = body
+                if name in FLAGS._specs and FLAGS._specs[name].type is bool:
+                    value = "true"
+                elif i + 1 < len(argv):
+                    value = argv[i + 1]
+                    i += 1
+                else:
+                    value = "true"
+            name = name.replace("-", "_")
+            if name in FLAGS._specs:
+                setattr(FLAGS, name, value)
+            else:
+                rest.append(arg)
+        else:
+            rest.append(arg)
+        i += 1
+    return rest
+
+
+def flags_snapshot() -> Dict[str, Any]:
+    return FLAGS.as_dict()
+
+
+# --- Core flag set (TPU-native analog of paddle/utils/Flags.cpp:18-77) ---
+
+# Device / platform (replaces use_gpu, gpu_id, parallel_nn ...)
+define_flag("platform", "", "jax platform override: '', 'tpu', 'cpu'")
+define_flag("use_tpu", True, "prefer TPU devices when available")
+define_flag("seed", 1, "global RNG seed (0 = nondeterministic)")
+define_flag("dtype", "float32", "default parameter dtype")
+define_flag("compute_dtype", "bfloat16", "preferred matmul/conv compute dtype on TPU")
+
+# Trainer loop (log_period, test_period, checkgrad ...)
+define_flag("log_period", 100, "log every N batches")
+define_flag("test_period", 0, "test every N batches (0 = per pass)")
+define_flag("show_parameter_stats_period", 0, "print param stats every N batches")
+define_flag("checkgrad_eps", 1e-2, "epsilon for finite-difference gradient checks")
+define_flag("save_dir", "./output", "checkpoint root; pass dirs saved under it")
+define_flag("start_pass", 0, "resume training from this pass")
+define_flag("saving_period", 1, "save checkpoint every N passes")
+
+# Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
+define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")
+define_flag("mesh_axes", "data", "comma-separated mesh axis names, e.g. 'data,model'")
+define_flag("num_virtual_devices", 0, "force N virtual CPU devices (tests/dry-runs)")
+
+# Sequence / generation (replaces beam_size, rnn_use_batch ...)
+define_flag("beam_size", 3, "default beam width for sequence generation")
+define_flag("max_gen_length", 100, "max generated sequence length")
+
+# Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
+define_flag("enable_timers", False, "collect Stat timer registry stats")
+define_flag("prefetch_batches", 2, "data provider background prefetch depth")

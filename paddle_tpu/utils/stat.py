@@ -1,0 +1,102 @@
+"""Timer/stat registry — analog of the reference's Stat system.
+
+The reference registers named timers around hot sections and prints an aggregate
+table per pass (reference: paddle/utils/Stat.h:70-247, used e.g. in
+trainer/TrainerInternal.cpp:118 and gserver/gradientmachines/NeuralNetwork.cpp:246).
+Here the registry is a process-global dict of named accumulators with context
+managers.  On TPU, device work is asynchronous; `timeit` optionally calls
+``block_until_ready`` on a result to time real device latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = ["StatSet", "global_stat", "timer", "reset_stats", "print_stats"]
+
+
+@dataclass
+class _Stat:
+    name: str
+    total: float = 0.0
+    count: int = 0
+    max: float = 0.0
+    min: float = float("inf")
+
+    def add(self, seconds: float) -> None:
+        self.total += seconds
+        self.count += 1
+        self.max = max(self.max, seconds)
+        self.min = min(self.min, seconds)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self, name: str = "global") -> None:
+        self.name = name
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> _Stat:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = _Stat(name)
+            return self._stats[name]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def table(self) -> str:
+        rows = ["%-32s %10s %12s %12s %12s" % ("Stat", "count", "total(s)", "avg(ms)", "max(ms)")]
+        with self._lock:
+            for s in sorted(self._stats.values(), key=lambda s: -s.total):
+                rows.append(
+                    "%-32s %10d %12.3f %12.3f %12.3f"
+                    % (s.name, s.count, s.total, s.avg * 1e3, s.max * 1e3)
+                )
+        return "\n".join(rows)
+
+
+global_stat = StatSet()
+
+
+@contextmanager
+def timer(name: str, *, sync: Any = None, stat_set: Optional[StatSet] = None) -> Iterator[None]:
+    """Time a block if FLAGS.enable_timers; ``sync`` may be a callable returning
+    a jax array (or an array) to block on, so device work is included."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not FLAGS.enable_timers:
+        yield
+        return
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            obj = sync() if callable(sync) else sync
+            try:
+                import jax
+
+                jax.block_until_ready(obj)
+            except Exception:
+                pass
+        (stat_set or global_stat).get(name).add(time.perf_counter() - start)
+
+
+def reset_stats() -> None:
+    global_stat.reset()
+
+
+def print_stats() -> None:
+    from paddle_tpu.utils.log import logger
+
+    logger.info("\n%s", global_stat.table())

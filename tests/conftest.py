@@ -1,0 +1,29 @@
+"""Test harness: force an 8-virtual-device CPU platform before jax initializes.
+
+Mirrors the reference's test strategy of exercising distributed paths
+in-process (SURVEY.md §4): multi-chip sharding logic runs on a virtual CPU
+mesh; numerical checks compare against numpy and finite differences.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
+
+# The container's sitecustomize imports jax at interpreter start (registering
+# the axon TPU platform), so the env var alone is read too late — override the
+# locked-in config value before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

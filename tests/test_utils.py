@@ -1,0 +1,58 @@
+import pytest
+
+from paddle_tpu.utils.flags import FLAGS, define_flag, parse_flags
+from paddle_tpu.utils.registry import Registry
+from paddle_tpu.utils.error import PaddleTpuError, layer_scope
+from paddle_tpu.utils import devices
+
+
+def test_flag_defaults_and_parse():
+    assert FLAGS.log_period == 100
+    rest = parse_flags(["--log_period=7", "positional", "--beam_size", "5"])
+    assert FLAGS.log_period == 7
+    assert FLAGS.beam_size == 5
+    assert rest == ["positional"]
+    FLAGS.log_period = 100
+    FLAGS.beam_size = 3
+
+
+def test_flag_bool_coercion():
+    parse_flags(["--enable_timers"])
+    assert FLAGS.enable_timers is True
+    parse_flags(["--enable_timers=false"])
+    assert FLAGS.enable_timers is False
+
+
+def test_unknown_flag_left_in_argv():
+    rest = parse_flags(["--no_such_flag=1"])
+    assert rest == ["--no_such_flag=1"]
+
+
+def test_registry():
+    reg = Registry("thing")
+
+    @reg.register("a")
+    def a():
+        return 1
+
+    assert reg.get("a") is a
+    assert "a" in reg
+    with pytest.raises(KeyError):
+        reg.get("missing")
+    with pytest.raises(ValueError):
+        reg.register("a")(a)
+
+
+def test_layer_scope_wraps_errors():
+    with pytest.raises(PaddleTpuError, match=r"outer -> inner"):
+        with layer_scope("outer"):
+            with layer_scope("inner"):
+                raise RuntimeError("boom")
+
+
+def test_virtual_devices_mesh():
+    assert devices.device_count() == 8
+    mesh = devices.make_mesh((4, 2), ("data", "model"))
+    assert mesh.shape == {"data": 4, "model": 2}
+    mesh1 = devices.make_mesh()
+    assert mesh1.shape == {"data": 8}

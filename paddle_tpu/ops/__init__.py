@@ -1,0 +1,70 @@
+"""paddle_tpu.ops — the kernel tier.
+
+TPU-native equivalent of the reference's `hl_*` CUDA kernel library +
+device-polymorphic Matrix ops (reference: paddle/cuda/, paddle/math/,
+paddle/function/ — see SURVEY.md §1.1-1.3).  Pure JAX functions; hot fused
+variants live in ops/pallas_kernels.py and are selected automatically on TPU.
+"""
+
+from paddle_tpu.ops.numerics import param_dtype, compute_dtype, acc_dtype, mxu_cast
+from paddle_tpu.ops.matmul import matmul, linear
+from paddle_tpu.ops.activations import ACTIVATIONS, get_activation, softmax, sequence_softmax
+from paddle_tpu.ops.losses import (
+    cross_entropy,
+    soft_cross_entropy,
+    binary_cross_entropy,
+    multi_binary_label_cross_entropy,
+    mse,
+    huber,
+    smooth_l1,
+    rank_cost,
+    masked_token_mean,
+    sequence_cross_entropy,
+)
+from paddle_tpu.ops.sequence import (
+    mask_from_lengths,
+    seq_pool_sum,
+    seq_pool_avg,
+    seq_pool_sqrt,
+    seq_pool_max,
+    seq_last,
+    seq_first,
+    seq_expand,
+    seq_reverse,
+    seq_concat,
+    context_projection,
+)
+from paddle_tpu.ops.conv import (
+    conv2d,
+    max_pool2d,
+    avg_pool2d,
+    batch_norm,
+    cmr_norm,
+    bilinear_interp,
+    maxout,
+    global_avg_pool,
+)
+from paddle_tpu.ops.rnn import lstm_step, gru_step, lstm_layer, gru_layer, scan_rnn
+from paddle_tpu.ops.attention import (
+    additive_attention_scores,
+    attend,
+    dot_product_attention,
+)
+from paddle_tpu.ops.embedding import embedding_lookup, one_hot
+from paddle_tpu.ops.misc import (
+    row_sum,
+    row_max,
+    col_sum,
+    top_k,
+    max_id,
+    batch_transpose,
+    cos_sim,
+    interpolation,
+    outer_prod,
+    tensor_bilinear,
+    sum_cost,
+    scaling,
+    slope_intercept,
+    power_op,
+    dropout,
+)

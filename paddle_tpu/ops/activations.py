@@ -1,0 +1,125 @@
+"""Activation functions — analog of the reference's activation registry.
+
+The reference registers ~14 activation types applied in-place on layer outputs
+(reference: paddle/gserver/activations/ActivationFunction.cpp:30-60,387, plus
+the hl_avx/cpu twins in paddle/cuda/src/hl_avx_functions.cc).  Here each is a
+pure jnp function; XLA fuses them into the producing matmul, so there is no
+separate "activation kernel" tier.  ``sequence_softmax`` operates on a padded
+sequence batch with a mask (the analog of softmax over a flat sequence slice).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.registry import Registry
+
+__all__ = ["ACTIVATIONS", "get_activation", "softmax", "sequence_softmax"]
+
+ACTIVATIONS: Registry = Registry("activation")
+
+
+def get_activation(name):
+    """Resolve an activation by name; None / '' / 'linear' → identity."""
+    if name is None or name == "":
+        return ACTIVATIONS.get("linear")
+    if callable(name):
+        return name
+    return ACTIVATIONS.get(name)
+
+
+def _reg(name):
+    return ACTIVATIONS.register(name)
+
+
+@_reg("linear")
+def linear(x):
+    return x
+
+
+@_reg("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_reg("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@_reg("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@_reg("brelu")
+def brelu(x, t_min=0.0, t_max=24.0):
+    # bounded relu, reference default bound 24 (hl_activation_functions.h)
+    return jnp.clip(x, t_min, t_max)
+
+
+@_reg("stanh")
+def stanh(x, a=1.7159, b=2.0 / 3.0):
+    # scaled tanh a*tanh(b*x) (reference STanhActivation)
+    return a * jnp.tanh(b * x)
+
+
+@_reg("softrelu")
+def softrelu(x, threshold=40.0):
+    # log(1+exp(x)), clipped like the reference for stability
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+@_reg("exponential")
+def exponential(x):
+    return jnp.exp(x)
+
+
+@_reg("log")
+def log_act(x):
+    return jnp.log(x)
+
+
+@_reg("abs")
+def abs_act(x):
+    return jnp.abs(x)
+
+
+@_reg("square")
+def square(x):
+    return jnp.square(x)
+
+
+@_reg("sqrt")
+def sqrt_act(x):
+    return jnp.sqrt(x)
+
+
+@_reg("reciprocal")
+def reciprocal(x):
+    return 1.0 / x
+
+
+@_reg("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@_reg("sequence_softmax")
+def sequence_softmax(x, mask=None, axis=-2):
+    """Softmax along the time axis of a padded [B, T, 1]/[B, T] batch.
+
+    Analog of the reference's per-sequence softmax over a flat slice
+    (SequenceSoftmaxActivation); padding positions get probability 0.
+    """
+    if mask is None:
+        return jax.nn.softmax(x, axis=axis)
+    if x.ndim == mask.ndim + 1:
+        m = mask[..., None]
+    else:
+        m = mask
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(m > 0, x, neg)
+    p = jax.nn.softmax(z, axis=axis)
+    return p * m.astype(p.dtype)

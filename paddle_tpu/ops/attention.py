@@ -1,0 +1,73 @@
+"""Attention primitives.
+
+The reference predates fused attention; its seq2seq demo builds Bahdanau
+attention out of primitive layers — ``simple_attention`` =
+fc(expand(decoder_state)) + encoded_proj -> tanh -> fc(1) -> sequence_softmax
+-> weighted sum (reference: python/paddle/trainer_config_helpers/networks.py
+simple_attention; demo/seqToseq/seqToseq_net.py), using
+ConvexCombinationLayer / InterpolationLayer style primitives
+(gserver/layers/LinearChainCRF… ConvexCombination in CostLayer neighborhood).
+
+TPU-first: the same math as fused batched einsums over padded [B, S, D]
+encodings with masks; plus modern scaled-dot-product attention as a
+first-class op (the parallel tier adds the ring/sequence-parallel variant).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.matmul import linear, matmul
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = ["additive_attention_scores", "attend", "dot_product_attention"]
+
+
+def additive_attention_scores(enc_proj, dec_state, w_dec, v):
+    """Bahdanau scores: tanh(enc_proj + dec_state @ w_dec) @ v.
+
+    enc_proj: [B, S, A] (precomputed once per source — the reference's
+    ``encoded_proj``), dec_state: [B, D], w_dec: [D, A], v: [A].
+    Returns [B, S] unnormalized scores.
+    """
+    q = linear(dec_state, w_dec)[:, None, :]  # [B, 1, A]
+    e = jnp.tanh(enc_proj + q)
+    return jnp.einsum("bsa,a->bs", e, v.astype(e.dtype))
+
+
+def attend(scores, values, mask):
+    """Mask + softmax scores over S, then weighted sum of values.
+
+    scores: [B, S], values: [B, S, D], mask: [B, S] -> (context [B, D],
+    weights [B, S]).
+    """
+    neg = jnp.finfo(scores.dtype).min
+    z = jnp.where(mask > 0, scores, neg)
+    w = jax.nn.softmax(z, axis=-1) * mask.astype(scores.dtype)
+    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    ctx = jnp.einsum("bs,bsd->bd", w, values)
+    return ctx, w
+
+
+def dot_product_attention(q, k, v, mask=None, *, scale=None):
+    """Batched multi-head SDPA: q [B,H,Tq,Dh], k/v [B,H,Tk,Dh].
+
+    mask: broadcastable to [B, H, Tq, Tk] (1 = attend). f32 softmax, bf16
+    matmuls on the MXU.
+    """
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    qc, kc, vc = mxu_cast(q, k, v)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", qc, kc, preferred_element_type=acc_dtype()
+    ) * scale
+    if mask is not None:
+        logits = jnp.where(mask > 0, logits, jnp.finfo(logits.dtype).min)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", w.astype(vc.dtype), vc, preferred_element_type=acc_dtype()
+    )
+    return out.astype(q.dtype)

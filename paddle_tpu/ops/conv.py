@@ -1,0 +1,124 @@
+"""Convolution / pooling / normalization ops — analog of the reference's CNN tier.
+
+Reference surface: cuDNN wrappers (paddle/cuda/src/hl_cuda_cudnn.cc: conv,
+pool, batch-norm descriptors) and hand CNN kernels
+(paddle/cuda/src/hl_cuda_cnn.cu: hl_maxpool_forward, hl_avgpool_forward,
+hl_CMRNorm_forward, bilinear, maxout).
+
+TPU-first: NHWC layout throughout (XLA:TPU's native conv layout — channels on
+the 128-lane minor dimension), ``lax.conv_general_dilated`` onto the MXU with
+bf16 operands and f32 accumulation, ``lax.reduce_window`` for pooling.  The
+reference's NCHW Matrix layout is *not* reproduced; the feeder delivers NHWC.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = [
+    "conv2d",
+    "max_pool2d",
+    "avg_pool2d",
+    "batch_norm",
+    "cmr_norm",
+    "bilinear_interp",
+    "maxout",
+    "global_avg_pool",
+]
+
+
+def conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
+    """NHWC conv: x [B,H,W,Cin], w [kh,kw,Cin//groups,Cout] -> [B,H',W',Cout]."""
+    x, w = mxu_cast(x, w)
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=acc_dtype(),
+    )
+
+
+def _pool(x, window, stride, padding, init, op):
+    dims = (1, window[0], window[1], 1)
+    strides = (1, stride[0], stride[1], 1)
+    return lax.reduce_window(x, init, op, dims, strides, padding)
+
+
+def max_pool2d(x, window=(2, 2), stride=None, padding="VALID"):
+    stride = stride or window
+    return _pool(x, window, stride, padding, -jnp.inf, lax.max)
+
+
+def avg_pool2d(x, window=(2, 2), stride=None, padding="VALID"):
+    """Average pooling; with SAME/edge padding the divisor counts only the
+    in-bounds window elements (cuDNN's include-padding=false behavior)."""
+    stride = stride or window
+    s = _pool(x, window, stride, padding, 0.0, lax.add)
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    cnt = _pool(ones, window, stride, padding, 0.0, lax.add)
+    return s / cnt
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def batch_norm(x, scale, bias, running_mean, running_var, *, train, momentum=0.9, eps=1e-5):
+    """Batch norm over all but the channel axis (last). Returns
+    (y, new_running_mean, new_running_var).
+
+    Analog of the reference's three BN impls (BatchNormalizationLayer.cpp,
+    CudnnBatchNormLayer.cpp); running stats use the same EMA with
+    ``movingAvgFraction`` = momentum.
+    """
+    axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = momentum * running_mean + (1.0 - momentum) * mean
+        new_var = momentum * running_var + (1.0 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv * scale + bias
+    return y.astype(x.dtype), new_mean, new_var
+
+
+def cmr_norm(x, *, size=5, scale=1e-4, power=0.75):
+    """Cross-map (cross-channel) response normalization, NHWC.
+
+    Analog of hl_CMRNorm_forward (paddle/cuda/src/hl_cuda_cnn.cu) /
+    CMRProjectionNormLayer — AlexNet-style LRN: denominator sums squares over a
+    window of ``size`` adjacent channels.
+    """
+    sq = jnp.square(x)
+    half = size // 2
+    pad = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+    # windowed channel sum via reduce_window on the channel axis
+    acc = lax.reduce_window(pad, 0.0, lax.add, (1, 1, 1, size), (1, 1, 1, 1), "VALID")
+    denom = jnp.power(1.0 + scale * acc, power)
+    return x / denom
+
+
+def bilinear_interp(x, out_h, out_w):
+    """Bilinear resize NHWC (analog of hl_bilinear_forward / BilinearInterpLayer)."""
+    return jax.image.resize(
+        x, (x.shape[0], out_h, out_w, x.shape[3]), method="bilinear"
+    ).astype(x.dtype)
+
+
+def maxout(x, groups):
+    """Maxout over channel groups (analog of hl_maxout_forward / MaxOutLayer):
+    [B,H,W,C] -> [B,H,W,C//groups], max over each group of ``groups`` channels."""
+    B, H, W, C = x.shape
+    assert C % groups == 0, "channels must divide maxout groups"
+    return jnp.max(x.reshape(B, H, W, C // groups, groups), axis=-1)

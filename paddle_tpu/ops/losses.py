@@ -1,0 +1,98 @@
+"""Cost/loss ops — analog of the reference's cost layers and CE kernels.
+
+Reference surface: hl_matrix cross-entropy kernels
+(paddle/cuda/src/hl_cuda_matrix.cu: crossEntropy/crossEntropyBp) and the cost
+layer family (paddle/gserver/layers/CostLayer.cpp: multi-class CE, soft CE,
+huber, MSE/sum-of-squares, smooth-l1, rank cost, multi-binary-label CE;
+LambdaCost.cpp).  TPU-first: all are fused log-softmax formulations — never
+materialize probabilities then log() (numerically unstable, and XLA fuses the
+subtraction into the softmax reduction).
+
+Sequence-aware variants take a mask [B, T]; padded positions contribute zero
+loss and the mean is taken over *real* tokens, matching the reference's
+flat-sequence costs (no padding there by construction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "cross_entropy",
+    "soft_cross_entropy",
+    "binary_cross_entropy",
+    "multi_binary_label_cross_entropy",
+    "mse",
+    "huber",
+    "smooth_l1",
+    "rank_cost",
+    "masked_token_mean",
+    "sequence_cross_entropy",
+]
+
+
+def cross_entropy(logits, labels, *, axis=-1):
+    """Multi-class CE from logits and integer labels; per-example losses."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    lab = jnp.expand_dims(labels.astype(jnp.int32), axis)
+    nll = -jnp.take_along_axis(logp, lab, axis=axis)
+    return jnp.squeeze(nll, axis)
+
+
+def soft_cross_entropy(logits, target_probs, *, axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    return -jnp.sum(target_probs * logp, axis=axis)
+
+
+def binary_cross_entropy(logits, labels):
+    # stable BCE-with-logits
+    z = jax.nn.log_sigmoid(logits)
+    zneg = jax.nn.log_sigmoid(-logits)
+    return -(labels * z + (1.0 - labels) * zneg)
+
+
+def multi_binary_label_cross_entropy(logits, label_matrix):
+    """Per-class independent BCE summed over classes (reference
+    MultiBinaryLabelCrossEntropy)."""
+    return jnp.sum(binary_cross_entropy(logits, label_matrix), axis=-1)
+
+
+def mse(pred, target):
+    return 0.5 * jnp.sum(jnp.square(pred - target), axis=-1)
+
+
+def huber(pred, target, delta=1.0):
+    d = pred - target
+    a = jnp.abs(d)
+    quad = 0.5 * jnp.square(d)
+    lin = delta * (a - 0.5 * delta)
+    return jnp.sum(jnp.where(a <= delta, quad, lin), axis=-1)
+
+
+def smooth_l1(pred, target):
+    return huber(pred, target, delta=1.0)
+
+
+def rank_cost(score_left, score_right, label, weight=None):
+    """Pairwise rank cost (reference RankingCost): -o*log(s)-(1-o)*log(1-s)
+    with s = sigmoid(left-right), o = label in [0,1]."""
+    d = score_left - score_right
+    cost = binary_cross_entropy(d, label)
+    if weight is not None:
+        cost = cost * weight
+    return cost
+
+
+def masked_token_mean(per_token, mask):
+    """Mean over real (mask>0) positions — the sequence-cost reduction."""
+    mask = mask.astype(per_token.dtype)
+    total = jnp.sum(per_token * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def sequence_cross_entropy(logits, labels, mask):
+    """Token-level CE over a padded [B, T, V] batch, averaged over real tokens."""
+    per_tok = cross_entropy(logits, labels)
+    return masked_token_mean(per_tok, mask)

@@ -1,0 +1,42 @@
+"""Dense matmul ops with the TPU dtype policy.
+
+Analog of the reference's gemm paths: GpuMatrix::mul -> hl_matrix_mul (cuBLAS)
+and CpuMatrix::mul -> cblas gemm (reference: paddle/math/Matrix.cpp:501-549,
+:2357; paddle/cuda/src/hl_cuda_cublas.cc).  On TPU a single ``dot_general`` with
+bf16 operands and f32 accumulation maps straight onto the MXU; XLA fuses the
+bias add and activation into the same kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = ["matmul", "linear"]
+
+
+def matmul(a, b, *, transpose_a=False, transpose_b=False):
+    """MXU matmul: bf16 operands, f32 accumulation, batch dims broadcast."""
+    a, b = mxu_cast(a, b)
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b, preferred_element_type=acc_dtype())
+    return out
+
+
+def linear(x, w, b=None):
+    """x @ w (+ b) over the last axis; any leading batch/time dims."""
+    xc, wc = mxu_cast(x, w)
+    y = lax.dot_general(
+        xc,
+        wc,
+        (((xc.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype(),
+    )
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y

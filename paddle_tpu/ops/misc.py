@@ -1,0 +1,119 @@
+"""Aggregate / top-k / misc ops — analog of the reference's utility kernels.
+
+Reference surface: row/col reductions (paddle/cuda/src/hl_cuda_aggregate.cu),
+top-k (hl_top_k.cu), batched transpose (hl_batch_transpose.cu), interpolation /
+convex-combination / outer-product / cos-sim layers
+(gserver/layers/InterpolationLayer.cpp, CosSimLayer.cpp, OuterProdLayer.cpp,
+TensorLayer.cpp), and feature-map perturbation (hl_perturbation_util.cu).
+On TPU every one of these is a short jnp/lax expression XLA fuses; they exist
+as named functions so the layer tier and tests have a stable kernel surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.matmul import matmul
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = [
+    "row_sum",
+    "row_max",
+    "row_min",
+    "col_sum",
+    "top_k",
+    "max_id",
+    "batch_transpose",
+    "cos_sim",
+    "interpolation",
+    "outer_prod",
+    "tensor_bilinear",
+    "sum_cost",
+    "scaling",
+    "slope_intercept",
+    "power_op",
+    "dropout",
+]
+
+
+def row_sum(x):
+    return jnp.sum(x, axis=-1)
+
+
+def row_max(x):
+    return jnp.max(x, axis=-1)
+
+
+def row_min(x):
+    return jnp.min(x, axis=-1)
+
+
+def col_sum(x):
+    return jnp.sum(x, axis=0)
+
+
+def top_k(x, k):
+    """Values and indices of the k largest entries along the last axis."""
+    return lax.top_k(x, k)
+
+
+def max_id(x):
+    return jnp.argmax(x, axis=-1).astype(jnp.int32)
+
+
+def batch_transpose(x):
+    """[B, M, N] -> [B, N, M] (hl_batch_transpose analog)."""
+    return jnp.swapaxes(x, -1, -2)
+
+
+def cos_sim(a, b, scale=1.0, eps=1e-8):
+    """Row-wise cosine similarity (CosSimLayer): [B,D],[B,D] -> [B]."""
+    num = jnp.sum(a * b, axis=-1)
+    den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+    return scale * num / jnp.maximum(den, eps)
+
+
+def interpolation(w, a, b):
+    """w*a + (1-w)*b with per-row scalar w [B,1] (InterpolationLayer)."""
+    return w * a + (1.0 - w) * b
+
+
+def outer_prod(a, b):
+    """[B,M],[B,N] -> [B,M*N] row-wise outer product (OuterProdLayer)."""
+    out = a[:, :, None] * b[:, None, :]
+    return out.reshape(a.shape[0], -1)
+
+
+def tensor_bilinear(a, b, w):
+    """TensorLayer: out[b, k] = a[b] @ W[k] @ b[b]; w: [K, Da, Db]."""
+    ac, bc, wc = mxu_cast(a, b, w)
+    return jnp.einsum("bi,kij,bj->bk", ac, wc, bc, preferred_element_type=acc_dtype())
+
+
+def sum_cost(x):
+    return jnp.sum(x)
+
+
+def scaling(scale, x):
+    """Per-row scalar scaling [B,1] * [B,D] (ScalingLayer)."""
+    return scale * x
+
+
+def slope_intercept(x, slope=1.0, intercept=0.0):
+    return slope * x + intercept
+
+
+def power_op(p, x):
+    """Per-row power: x ** p with p [B,1] (PowerLayer)."""
+    return jnp.power(x, p)
+
+
+def dropout(rng, x, rate, *, train):
+    """Inverted dropout (the reference applies dropout via layer attr)."""
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

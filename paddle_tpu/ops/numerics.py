@@ -1,0 +1,38 @@
+"""Numeric policy: parameter dtype vs MXU compute dtype.
+
+The reference compiles for float or double globally (WITH_DOUBLE,
+reference: CMakeLists.txt:44; real/hl_base.h).  On TPU the idiomatic policy is
+mixed precision: parameters and accumulations in float32, matmul/conv operands
+in bfloat16 so they hit the MXU at full rate.  ``matmul_compute_dtype`` is
+controlled by FLAGS.compute_dtype; tests pin it to float32 so finite-difference
+gradient checks are meaningful.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["param_dtype", "compute_dtype", "mxu_cast", "acc_dtype"]
+
+
+def param_dtype():
+    from paddle_tpu.utils.flags import FLAGS
+
+    return jnp.dtype(FLAGS.dtype)
+
+
+def compute_dtype():
+    from paddle_tpu.utils.flags import FLAGS
+
+    return jnp.dtype(FLAGS.compute_dtype)
+
+
+def acc_dtype():
+    return jnp.float32
+
+
+def mxu_cast(*arrays):
+    """Cast matmul/conv operands to the compute dtype (bf16 on TPU)."""
+    cd = compute_dtype()
+    out = tuple(a.astype(cd) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,155 @@
+"""Sequence ops over padded batches — analog of the reference's sequence tier.
+
+The reference stores variable-length sequences *flat* (one [sum_len, D] matrix
++ start positions, reference: paddle/parameter/Argument.h:29-90) and provides
+scatter/gather kernels between sequence and batch layouts
+(paddle/cuda/src/hl_cuda_sequence.cu, gserver/layers/SequenceToBatch.h:23-46)
+plus pooling/expand/concat layers (SequencePoolLayer.cpp, ExpandLayer.cpp...).
+
+TPU-first design: XLA wants static shapes, so the device layout is a padded
+dense batch ``value: [B, T, D]`` with ``lengths: [B] int32``; masks are derived
+on the fly and fuse into consuming ops.  Host-side bucketing (data/feeder)
+bounds padding waste, and sequence *packing* (segment_ids) is the long-form
+analog used by the attention/parallel tier.  These functions are the kernel
+surface the layer tier builds on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "mask_from_lengths",
+    "seq_pool_sum",
+    "seq_pool_avg",
+    "seq_pool_sqrt",
+    "seq_pool_max",
+    "seq_last",
+    "seq_first",
+    "seq_expand",
+    "seq_reverse",
+    "seq_concat",
+    "context_projection",
+    "seq_slice_window",
+]
+
+
+def mask_from_lengths(lengths, max_len):
+    """[B] lengths -> [B, T] float mask (1.0 for real positions)."""
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    return (pos < lengths[:, None].astype(jnp.int32)).astype(jnp.float32)
+
+
+def _masked(value, mask):
+    return value * mask[..., None].astype(value.dtype)
+
+
+def seq_pool_sum(value, mask):
+    """[B,T,D],[B,T] -> [B,D] sum over real positions."""
+    return jnp.sum(_masked(value, mask), axis=1)
+
+
+def seq_pool_avg(value, mask):
+    s = seq_pool_sum(value, mask)
+    n = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return s / n.astype(s.dtype)
+
+
+def seq_pool_sqrt(value, mask):
+    # sum / sqrt(len) — the reference's "SquareRootN" average strategy
+    s = seq_pool_sum(value, mask)
+    n = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return s / jnp.sqrt(n).astype(s.dtype)
+
+
+def seq_pool_max(value, mask):
+    neg = jnp.finfo(value.dtype).min
+    z = jnp.where(mask[..., None] > 0, value, neg)
+    return jnp.max(z, axis=1)
+
+
+def seq_last(value, lengths):
+    """Last real timestep of each sequence: [B,T,D],[B] -> [B,D]."""
+    idx = jnp.maximum(lengths.astype(jnp.int32) - 1, 0)
+    return jnp.take_along_axis(value, idx[:, None, None], axis=1)[:, 0]
+
+
+def seq_first(value):
+    return value[:, 0]
+
+
+def seq_expand(vec, mask):
+    """Broadcast a per-sequence [B,D] vector to every timestep: -> [B,T,D].
+
+    Analog of ExpandLayer (non-seq -> seq expansion); padded positions zeroed.
+    """
+    out = jnp.broadcast_to(vec[:, None, :], (vec.shape[0], mask.shape[1], vec.shape[1]))
+    return _masked(out, mask)
+
+
+def seq_reverse(value, lengths):
+    """Reverse each sequence within its real length (padding stays at the end).
+
+    Analog of SequenceReverseLayer; needed for bidirectional RNNs.
+    """
+    B, T = value.shape[0], value.shape[1]
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    L = lengths[:, None].astype(jnp.int32)
+    src = jnp.where(pos < L, L - 1 - pos, pos)
+    return jnp.take_along_axis(value, src[..., None], axis=1)
+
+
+def seq_concat(a, a_len, b, b_len):
+    """Concatenate sequences along time: each row = a_i ++ b_i, repadded.
+
+    Analog of SequenceConcatLayer.  Output T = Ta + Tb (static).
+    """
+    B, Ta = a.shape[0], a.shape[1]
+    Tb = b.shape[1]
+    T = Ta + Tb
+    D = a.shape[2]
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+    aL = a_len[:, None].astype(jnp.int32)
+    in_a = pos < aL
+    a_pad = jnp.pad(a, ((0, 0), (0, Tb), (0, 0)))
+    b_pad = jnp.pad(b, ((0, 0), (0, Ta), (0, 0)))
+    b_idx = jnp.clip(pos - aL, 0, Tb + Ta - 1)
+    b_shift = jnp.take_along_axis(b_pad, b_idx[..., None], axis=1)
+    out = jnp.where(in_a[..., None], a_pad, b_shift)
+    out_len = a_len + b_len
+    mask = mask_from_lengths(out_len, T)
+    return _masked(out, mask), out_len
+
+
+def context_projection(value, mask, context_len, context_start):
+    """Sliding window over time: output[t] = concat(value[t+start .. t+start+len-1]).
+
+    Analog of the reference's context projection kernels
+    (paddle/cuda/src/hl_cuda_sequence.cu: hl_context_projection_forward;
+    gserver/layers/ContextProjection.cpp).  Out-of-range positions are zero
+    (trainable start padding is handled at the layer tier).  [B,T,D] ->
+    [B,T,D*context_len].
+    """
+    B, T, D = value.shape
+    v = _masked(value, mask)
+    cols = []
+    for k in range(context_len):
+        off = context_start + k
+        if off < 0:
+            shifted = jnp.pad(v, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+        elif off > 0:
+            shifted = jnp.pad(v, ((0, 0), (0, off), (0, 0)))[:, off : off + T]
+        else:
+            shifted = v
+        cols.append(shifted)
+    out = jnp.concatenate(cols, axis=-1)
+    return _masked(out, mask)
+
+
+def seq_slice_window(value, starts, width):
+    """Gather a fixed-width window starting at per-row dynamic offsets."""
+    B, T, D = value.shape
+    pos = starts[:, None].astype(jnp.int32) + jnp.arange(width, dtype=jnp.int32)[None, :]
+    pos = jnp.clip(pos, 0, T - 1)
+    return jnp.take_along_axis(value, pos[..., None], axis=1)

@@ -1,0 +1,320 @@
+"""Ops-tier numeric tests.
+
+Mirrors the reference's math test strategy (SURVEY.md §4): op results checked
+against numpy, gradients against finite differences (the testLayerGrad analog),
+and sequence ops checked for padding invariance (the analog of CPU/GPU
+flat-sequence equivalence).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops as ops
+
+
+def fd_grad(f, x, eps=1e-4):
+    """Central finite-difference gradient of scalar f at x (numpy)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (float(f(xp)) - float(f(xm))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(f, x, rtol=2e-2, atol=1e-3):
+    jg = np.asarray(jax.grad(lambda a: f(a))(jnp.asarray(x, jnp.float32)))
+    ng = fd_grad(lambda a: f(jnp.asarray(a, jnp.float32)), x)
+    np.testing.assert_allclose(jg, ng, rtol=rtol, atol=atol)
+
+
+class TestDense:
+    def test_linear_matches_numpy(self, rng):
+        x = rng.randn(4, 7).astype(np.float32)
+        w = rng.randn(7, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        out = ops.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5, atol=1e-5)
+
+    def test_matmul_transpose_flags(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(5, 4).astype(np.float32)
+        out = ops.matmul(jnp.asarray(a), jnp.asarray(b), transpose_b=True)
+        np.testing.assert_allclose(np.asarray(out), a @ b.T, rtol=1e-5, atol=1e-5)
+
+    def test_cross_entropy_matches_numpy(self, rng):
+        logits = rng.randn(6, 9).astype(np.float32)
+        labels = rng.randint(0, 9, 6)
+        out = np.asarray(ops.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, -np.log(p[np.arange(6), labels]), rtol=1e-5, atol=1e-5)
+
+    def test_cross_entropy_grad(self, rng):
+        logits = rng.randn(3, 5).astype(np.float32)
+        labels = jnp.asarray(rng.randint(0, 5, 3))
+        check_grad(lambda l: jnp.sum(ops.cross_entropy(l, labels)), logits)
+
+    def test_huber_and_mse_grad(self, rng):
+        x = rng.randn(4, 3).astype(np.float32)
+        t = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+        check_grad(lambda a: jnp.sum(ops.mse(a, t)), x)
+        check_grad(lambda a: jnp.sum(ops.huber(a, t)), x)
+
+    def test_activations_all_run(self, rng):
+        x = jnp.asarray(rng.rand(4, 8).astype(np.float32) + 0.1)
+        for name in ops.ACTIVATIONS:
+            if name == "sequence_softmax":
+                continue
+            y = ops.get_activation(name)(x)
+            assert y.shape == x.shape
+            assert np.all(np.isfinite(np.asarray(y))), name
+
+
+class TestSequence:
+    def _batch(self, rng, B=4, T=6, D=3):
+        lengths = np.array([6, 3, 1, 5], np.int32)
+        v = rng.randn(B, T, D).astype(np.float32)
+        mask = np.asarray(ops.mask_from_lengths(jnp.asarray(lengths), T))
+        v = v * mask[..., None]
+        return jnp.asarray(v), jnp.asarray(lengths), jnp.asarray(mask)
+
+    def test_pools_match_numpy(self, rng):
+        v, lengths, mask = self._batch(rng)
+        vn, ln = np.asarray(v), np.asarray(lengths)
+        np.testing.assert_allclose(
+            np.asarray(ops.seq_pool_sum(v, mask)),
+            np.stack([vn[i, : ln[i]].sum(0) for i in range(4)]),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ops.seq_pool_avg(v, mask)),
+            np.stack([vn[i, : ln[i]].mean(0) for i in range(4)]),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ops.seq_pool_max(v, mask)),
+            np.stack([vn[i, : ln[i]].max(0) for i in range(4)]),
+            rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ops.seq_last(v, lengths)),
+            np.stack([vn[i, ln[i] - 1] for i in range(4)]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_seq_reverse_twice_is_identity(self, rng):
+        v, lengths, mask = self._batch(rng)
+        r2 = ops.seq_reverse(ops.seq_reverse(v, lengths), lengths)
+        np.testing.assert_allclose(np.asarray(r2), np.asarray(v), atol=1e-6)
+
+    def test_seq_concat(self, rng):
+        a = jnp.asarray(rng.randn(2, 3, 2).astype(np.float32))
+        b = jnp.asarray(rng.randn(2, 4, 2).astype(np.float32))
+        al = jnp.asarray(np.array([2, 3], np.int32))
+        bl = jnp.asarray(np.array([4, 1], np.int32))
+        am = ops.mask_from_lengths(al, 3)
+        bm = ops.mask_from_lengths(bl, 4)
+        a = a * am[..., None]
+        b = b * bm[..., None]
+        out, out_len = ops.seq_concat(a, al, b, bl)
+        assert out.shape == (2, 7, 2)
+        np.testing.assert_array_equal(np.asarray(out_len), [6, 4])
+        an, bn = np.asarray(a), np.asarray(b)
+        row0 = np.concatenate([an[0, :2], bn[0, :4]])
+        np.testing.assert_allclose(np.asarray(out)[0, :6], row0, atol=1e-6)
+        row1 = np.concatenate([an[1, :3], bn[1, :1]])
+        np.testing.assert_allclose(np.asarray(out)[1, :4], row1, atol=1e-6)
+
+    def test_context_projection_window(self, rng):
+        v, lengths, mask = self._batch(rng, D=2)
+        out = ops.context_projection(v, mask, context_len=3, context_start=-1)
+        assert out.shape == (4, 6, 6)
+        vn = np.asarray(v)
+        # row 0 (full length): position t sees [t-1, t, t+1]
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 2], np.concatenate([vn[0, 1], vn[0, 2], vn[0, 3]]), atol=1e-6
+        )
+        # left edge zero-padded
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0], np.concatenate([np.zeros(2, np.float32), vn[0, 0], vn[0, 1]]),
+            atol=1e-6,
+        )
+
+    def test_sequence_softmax_masks_padding(self, rng):
+        x = jnp.asarray(rng.randn(2, 5).astype(np.float32))
+        mask = ops.mask_from_lengths(jnp.asarray(np.array([3, 5], np.int32)), 5)
+        p = np.asarray(ops.sequence_softmax(x, mask, axis=-1))
+        assert np.all(p[0, 3:] == 0)
+        np.testing.assert_allclose(p.sum(-1), [1.0, 1.0], rtol=1e-5)
+
+
+class TestConv:
+    def test_conv2d_matches_manual(self, rng):
+        x = rng.randn(1, 4, 4, 1).astype(np.float32)
+        w = rng.randn(2, 2, 1, 1).astype(np.float32)
+        out = np.asarray(ops.conv2d(jnp.asarray(x), jnp.asarray(w), padding="VALID"))
+        ref = np.zeros((1, 3, 3, 1), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[0, i, j, 0] = np.sum(x[0, i : i + 2, j : j + 2, 0] * w[:, :, 0, 0])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_pooling(self, rng):
+        x = jnp.asarray(rng.randn(2, 4, 4, 3).astype(np.float32))
+        mx = np.asarray(ops.max_pool2d(x, (2, 2)))
+        av = np.asarray(ops.avg_pool2d(x, (2, 2)))
+        xn = np.asarray(x)
+        np.testing.assert_allclose(mx[0, 0, 0], xn[0, :2, :2].max((0, 1)), atol=1e-6)
+        np.testing.assert_allclose(av[0, 0, 0], xn[0, :2, :2].mean((0, 1)), rtol=1e-5)
+
+    def test_batch_norm_train_normalizes(self, rng):
+        x = jnp.asarray(rng.randn(16, 3, 3, 4).astype(np.float32) * 3 + 1)
+        scale = jnp.ones(4)
+        bias = jnp.zeros(4)
+        y, m, v = ops.batch_norm(x, scale, bias, jnp.zeros(4), jnp.ones(4), train=True)
+        yn = np.asarray(y)
+        np.testing.assert_allclose(yn.mean((0, 1, 2)), 0, atol=1e-4)
+        np.testing.assert_allclose(yn.std((0, 1, 2)), 1, atol=1e-2)
+
+    def test_maxout(self, rng):
+        x = jnp.asarray(rng.randn(1, 2, 2, 6).astype(np.float32))
+        y = np.asarray(ops.maxout(x, 2))
+        assert y.shape == (1, 2, 2, 3)
+        xn = np.asarray(x).reshape(1, 2, 2, 3, 2)
+        np.testing.assert_allclose(y, xn.max(-1), atol=1e-6)
+
+
+class TestRNN:
+    def test_lstm_padding_invariance(self, rng):
+        """Extending padding must not change outputs within real lengths —
+        the analog of the reference's flat-vs-padded equivalence."""
+        B, T, D, H = 3, 5, 4, 6
+        lengths = jnp.asarray(np.array([5, 3, 2], np.int32))
+        x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+        w_x = jnp.asarray(rng.randn(D, 4 * H).astype(np.float32) * 0.1)
+        w_h = jnp.asarray(rng.randn(H, 4 * H).astype(np.float32) * 0.1)
+        b = jnp.zeros(4 * H)
+        mask = ops.mask_from_lengths(lengths, T)
+        h_seq, (h_f, c_f) = ops.lstm_layer(x, mask, w_x, w_h, b)
+        # pad to T+3 with garbage
+        x2 = jnp.concatenate([x, jnp.asarray(rng.randn(B, 3, D).astype(np.float32))], 1)
+        mask2 = ops.mask_from_lengths(lengths, T + 3)
+        h_seq2, (h_f2, c_f2) = ops.lstm_layer(x2, mask2, w_x, w_h, b)
+        np.testing.assert_allclose(np.asarray(h_seq2[:, :T]), np.asarray(h_seq), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_f2), np.asarray(h_f), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_f2), np.asarray(c_f), atol=1e-5)
+        # final h equals h_seq at position length-1
+        np.testing.assert_allclose(
+            np.asarray(ops.seq_last(h_seq, lengths)), np.asarray(h_f), atol=1e-6
+        )
+
+    def test_lstm_matches_manual_loop(self, rng):
+        B, T, D, H = 2, 4, 3, 5
+        x = rng.randn(B, T, D).astype(np.float32)
+        w_x = (rng.randn(D, 4 * H) * 0.2).astype(np.float32)
+        w_h = (rng.randn(H, 4 * H) * 0.2).astype(np.float32)
+        b = (rng.randn(4 * H) * 0.1).astype(np.float32)
+        mask = np.ones((B, T), np.float32)
+        h_seq, _ = ops.lstm_layer(
+            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(w_x), jnp.asarray(w_h), jnp.asarray(b)
+        )
+
+        def sigmoid(a):
+            return 1 / (1 + np.exp(-a))
+
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        for t in range(T):
+            z = x[:, t] @ w_x + b + h @ w_h
+            i, f, o, g = np.split(z, 4, -1)
+            c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+            h = sigmoid(o) * np.tanh(c)
+            np.testing.assert_allclose(np.asarray(h_seq[:, t]), h, rtol=1e-4, atol=1e-5)
+
+    def test_gru_padding_invariance(self, rng):
+        B, T, D, H = 3, 5, 4, 6
+        lengths = jnp.asarray(np.array([4, 5, 1], np.int32))
+        x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+        w_x = jnp.asarray(rng.randn(D, 3 * H).astype(np.float32) * 0.1)
+        w_h = jnp.asarray(rng.randn(H, 3 * H).astype(np.float32) * 0.1)
+        b = jnp.zeros(3 * H)
+        mask = ops.mask_from_lengths(lengths, T)
+        h_seq, h_f = ops.gru_layer(x, mask, w_x, w_h, b)
+        x2 = jnp.concatenate([x, jnp.asarray(rng.randn(B, 2, D).astype(np.float32))], 1)
+        mask2 = ops.mask_from_lengths(lengths, T + 2)
+        h_seq2, h_f2 = ops.gru_layer(x2, mask2, w_x, w_h, b)
+        np.testing.assert_allclose(np.asarray(h_seq2[:, :T]), np.asarray(h_seq), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_f2), np.asarray(h_f), atol=1e-5)
+
+    def test_lstm_grad_flows(self, rng):
+        B, T, D, H = 2, 3, 2, 3
+        lengths = jnp.asarray(np.array([3, 2], np.int32))
+        mask = ops.mask_from_lengths(lengths, T)
+        x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+        w_h = jnp.asarray(rng.randn(H, 4 * H).astype(np.float32) * 0.1)
+        b = jnp.zeros(4 * H)
+
+        def loss(w_x):
+            h_seq, _ = ops.lstm_layer(x, mask, w_x, w_h, b)
+            return jnp.sum(h_seq)
+
+        w_x0 = (rng.randn(D, 4 * H) * 0.1).astype(np.float32)
+        check_grad(loss, w_x0, rtol=5e-2, atol=5e-3)
+
+
+class TestAttention:
+    def test_attend_masks(self, rng):
+        scores = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+        values = jnp.asarray(rng.randn(2, 4, 3).astype(np.float32))
+        mask = ops.mask_from_lengths(jnp.asarray(np.array([2, 4], np.int32)), 4)
+        ctx, w = ops.attend(scores, values, mask)
+        wn = np.asarray(w)
+        assert np.all(wn[0, 2:] == 0)
+        np.testing.assert_allclose(wn.sum(-1), [1, 1], rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ctx[0]), (wn[0, :, None] * np.asarray(values)[0]).sum(0), rtol=1e-5, atol=1e-6
+        )
+
+    def test_sdpa_uniform_when_equal_keys(self, rng):
+        q = jnp.ones((1, 1, 2, 4))
+        k = jnp.ones((1, 1, 3, 4))
+        v = jnp.asarray(rng.randn(1, 1, 3, 4).astype(np.float32))
+        out = ops.dot_product_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0, 0], np.asarray(v)[0, 0].mean(0), rtol=1e-3, atol=1e-3
+        )
+
+
+class TestMisc:
+    def test_top_k_and_maxid(self, rng):
+        x = jnp.asarray(rng.randn(3, 10).astype(np.float32))
+        vals, idx = ops.top_k(x, 4)
+        xn = np.asarray(x)
+        np.testing.assert_array_equal(np.asarray(idx)[:, 0], xn.argmax(-1))
+        np.testing.assert_allclose(np.asarray(vals), np.sort(xn, -1)[:, ::-1][:, :4], atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ops.max_id(x)), xn.argmax(-1))
+
+    def test_cos_sim(self, rng):
+        a = rng.randn(4, 5).astype(np.float32)
+        out = np.asarray(ops.cos_sim(jnp.asarray(a), jnp.asarray(a)))
+        np.testing.assert_allclose(out, np.ones(4), rtol=1e-5)
+
+    def test_embedding_lookup_pad_zero(self, rng):
+        table = jnp.asarray(rng.randn(10, 4).astype(np.float32))
+        ids = jnp.asarray(np.array([[1, 0, 3]], np.int32))
+        out = np.asarray(ops.embedding_lookup(table, ids, pad_to_zero_id=0))
+        assert np.all(out[0, 1] == 0)
+        np.testing.assert_allclose(out[0, 0], np.asarray(table)[1], atol=1e-6)
+
+    def test_dropout_eval_identity(self, rng):
+        x = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+        y = ops.dropout(jax.random.PRNGKey(0), x, 0.5, train=False)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))

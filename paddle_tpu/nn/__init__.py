@@ -1,0 +1,17 @@
+"""paddle_tpu.nn — symbolic layer DSL + graph compiler.
+
+TPU-native replacement for the reference's gserver engine + Python layer DSL
+(SURVEY.md §1.5, §1.10).  Build a DAG with layer functions, compile with
+``Topology``, run the resulting pure functions under jit/pjit.
+"""
+
+from paddle_tpu.nn.graph import (
+    Act,
+    ParamAttr,
+    ParamSpec,
+    LayerOutput,
+    Topology,
+    reset_naming,
+)
+from paddle_tpu.nn.layers import *  # noqa: F401,F403
+from paddle_tpu.nn import layers as layer

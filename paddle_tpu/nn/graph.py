@@ -1,0 +1,356 @@
+"""The layer graph core — TPU-native analog of the reference's gserver engine.
+
+Reference architecture: a Python DSL builds a protobuf ModelConfig
+(python/paddle/trainer/config_parser.py), C++ instantiates a Layer object per
+proto entry into a topologically-ordered NeuralNetwork, and forward/backward
+walk that list mutating per-layer Argument buffers
+(gserver/gradientmachines/NeuralNetwork.cpp:235-294; layer base
+gserver/layers/Layer.h:56-231).
+
+TPU-native architecture: layer functions build a symbolic DAG of
+``LayerOutput`` nodes at Python time; ``Topology`` compiles the DAG **once**
+into pure functions
+
+    init(rng)                  -> (params, state)
+    apply(params, state, feed, train, rng) -> (outputs, new_state)
+
+which jit/grad/shard like any JAX function.  There is no mutable Argument and
+no backward pass to write: autodiff derives it, and XLA fuses across layer
+boundaries (the fusion the reference's expression templates only did within
+one elementwise chain).  Activations between layers are immutable ``Act``
+records — the Argument analog (reference: paddle/parameter/Argument.h:29-90)
+carrying value + sequence lengths/mask.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.error import ConfigError, ShapeError, layer_scope
+from paddle_tpu.utils.registry import Registry
+
+__all__ = [
+    "Act",
+    "ParamAttr",
+    "ParamSpec",
+    "LayerOutput",
+    "Topology",
+    "next_name",
+    "reset_naming",
+    "LAYER_TYPES",
+]
+
+LAYER_TYPES: Registry = Registry("layer_type")
+
+
+# ---------------------------------------------------------------------------
+# Runtime activation record (Argument analog)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Act:
+    """Value flowing between layers.
+
+    value: [B, D] (non-seq), [B, T, D] (sequence) or int ids [B, T].
+    lengths/mask present iff the activation is a sequence. ``state`` carries
+    auxiliary outputs (e.g. RNN final cell state, attention weights).
+    """
+
+    value: Any
+    lengths: Optional[Any] = None
+    mask: Optional[Any] = None
+    state: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def is_seq(self) -> bool:
+        return self.lengths is not None
+
+    def tree_flatten(self):
+        keys = tuple(sorted(self.state))
+        children = (self.value, self.lengths, self.mask) + tuple(
+            self.state[k] for k in keys
+        )
+        return children, keys
+
+    @classmethod
+    def tree_unflatten(cls, keys, children):
+        value, lengths, mask = children[:3]
+        state = dict(zip(keys, children[3:]))
+        return cls(value=value, lengths=lengths, mask=mask, state=state)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParamAttr:
+    """Per-parameter attributes — analog of the reference's ParameterConfig
+    (proto/ParameterConfig.proto; python ParamAttr): shared name, init scheme,
+    per-param learning-rate scale, decay, static (frozen) flag."""
+
+    name: Optional[str] = None
+    initial_std: Optional[float] = None
+    initial_mean: float = 0.0
+    init: Optional[str] = None  # 'normal' | 'uniform' | 'xavier' | 'zeros' | 'ones'
+    learning_rate: float = 1.0
+    l2_decay: float = 0.0
+    is_static: bool = False
+    sparse_grad: bool = False
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    name: str
+    shape: Tuple[int, ...]
+    attr: ParamAttr
+    is_state: bool = False  # True for running stats etc. (not optimized)
+
+    def initializer(self) -> Callable:
+        attr = self.attr
+        kind = attr.init or ("normal" if attr.initial_std is not None else "xavier")
+
+        def init(key, shape, dtype):
+            if kind == "zeros":
+                return jnp.zeros(shape, dtype)
+            if kind == "ones":
+                return jnp.ones(shape, dtype)
+            if kind == "normal":
+                std = attr.initial_std if attr.initial_std is not None else 0.01
+                return attr.initial_mean + std * jax.random.normal(key, shape, dtype)
+            if kind == "uniform":
+                a = attr.initial_std if attr.initial_std is not None else 0.05
+                return jax.random.uniform(key, shape, dtype, -a, a)
+            # xavier/glorot: std = sqrt(2/(fan_in+fan_out)) — the reference's
+            # default weight init is N(0, 1/sqrt(fan_in)); xavier is the
+            # better modern default, selectable via attr.init='normal'.
+            fan_in = shape[0] if len(shape) > 1 else shape[0]
+            fan_out = shape[-1]
+            if len(shape) == 4:  # HWIO conv kernels
+                rf = shape[0] * shape[1]
+                fan_in, fan_out = rf * shape[2], rf * shape[3]
+            std = (2.0 / (fan_in + fan_out)) ** 0.5
+            return std * jax.random.normal(key, shape, dtype)
+
+        return init
+
+
+# ---------------------------------------------------------------------------
+# Symbolic layer node
+# ---------------------------------------------------------------------------
+
+_naming = threading.local()
+
+
+def next_name(prefix: str) -> str:
+    if not hasattr(_naming, "counters"):
+        _naming.counters = {}
+    c = _naming.counters.get(prefix, 0)
+    _naming.counters[prefix] = c + 1
+    return f"__{prefix}_{c}__"
+
+
+def reset_naming() -> None:
+    _naming.counters = {}
+
+
+@dataclass
+class LayerOutput:
+    """Symbolic node in the layer DAG (the config-time analog of the
+    reference's per-layer proto entry + the runtime Layer object)."""
+
+    name: str
+    layer_type: str
+    size: int
+    parents: List["LayerOutput"]
+    forward: Callable  # (ctx, params: Dict[str, Array], *parent_acts) -> Act
+    param_specs: List[ParamSpec] = field(default_factory=list)
+    is_data: bool = False
+    data_spec: Optional[dict] = None
+    # layer metadata: e.g. {'hw': (H, W)} for image layers so consumers can
+    # compute flattened sizes (the reference tracks this in the proto's
+    # img_size fields, config_parser.py)
+    meta: dict = field(default_factory=dict)
+
+    def __repr__(self) -> str:
+        return f"<{self.layer_type} {self.name} size={self.size}>"
+
+    # Arithmetic sugar on symbolic nodes
+    def __add__(self, other: "LayerOutput") -> "LayerOutput":
+        from paddle_tpu.nn.layers import addto
+
+        return addto(input=[self, other])
+
+
+class ApplyContext:
+    """Per-apply runtime context: train flag and a split-on-demand RNG."""
+
+    def __init__(self, train: bool, rng: Optional[jax.Array]):
+        self.train = train
+        self._rng = rng
+        self.updated_state: Dict[str, Any] = {}
+
+    def next_rng(self) -> jax.Array:
+        if self._rng is None:
+            self._rng = jax.random.PRNGKey(0)
+        self._rng, out = jax.random.split(self._rng)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Topology: DAG -> pure functions
+# ---------------------------------------------------------------------------
+
+
+class Topology:
+    """Compiled view of a layer DAG.
+
+    Analog of the reference's Topology over the ModelConfig proto
+    (python/paddle/v2/topology.py:48) + the C++ NeuralNetwork executor — but
+    compilation happens once at Python level and execution is a pure function
+    suitable for jit/pjit/grad.
+    """
+
+    def __init__(self, outputs: Sequence[LayerOutput] | LayerOutput):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs: List[LayerOutput] = list(outputs)
+        self.layers: List[LayerOutput] = self._toposort(self.outputs)
+        self.data_layers: List[LayerOutput] = [l for l in self.layers if l.is_data]
+        self.param_specs: Dict[str, ParamSpec] = {}
+        for layer in self.layers:
+            for spec in layer.param_specs:
+                prev = self.param_specs.get(spec.name)
+                if prev is not None and prev.shape != spec.shape:
+                    raise ConfigError(
+                        f"shared parameter {spec.name!r} has conflicting shapes "
+                        f"{prev.shape} vs {spec.shape}"
+                    )
+                self.param_specs.setdefault(spec.name, spec)
+
+    @staticmethod
+    def _toposort(outputs: Sequence[LayerOutput]) -> List[LayerOutput]:
+        order: List[LayerOutput] = []
+        seen: Dict[int, int] = {}  # id -> 0 visiting, 1 done
+
+        def visit(node: LayerOutput) -> None:
+            mark = seen.get(id(node))
+            if mark == 1:
+                return
+            if mark == 0:
+                raise ConfigError(f"cycle in layer graph at {node.name!r}")
+            seen[id(node)] = 0
+            for p in node.parents:
+                visit(p)
+            seen[id(node)] = 1
+            order.append(node)
+
+        for out in outputs:
+            visit(out)
+        names = {}
+        for l in order:
+            if l.name in names and names[l.name] is not l:
+                raise ConfigError(f"duplicate layer name {l.name!r}")
+            names[l.name] = l
+        return order
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, rng: jax.Array, dtype=None) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Create (params, state) pytrees."""
+        from paddle_tpu.ops.numerics import param_dtype
+
+        dtype = dtype or param_dtype()
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        specs = sorted(self.param_specs.values(), key=lambda s: s.name)
+        keys = jax.random.split(rng, max(len(specs), 1))
+        for key, spec in zip(keys, specs):
+            arr = spec.initializer()(key, spec.shape, dtype)
+            (state if spec.is_state else params)[spec.name] = arr
+        return params, state
+
+    # -- apply --------------------------------------------------------------
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        state: Dict[str, Any],
+        feed: Dict[str, Any],
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+        outputs: Optional[Sequence[str]] = None,
+    ) -> Tuple[Dict[str, Act], Dict[str, Any]]:
+        """Run the graph. ``feed`` maps data-layer name -> Act | array |
+        (value, lengths). Returns ({layer_name: Act}, new_state)."""
+        ctx = ApplyContext(train, rng)
+        env: Dict[str, Act] = {}
+        all_params = {**params, **state}
+        want = set(outputs) if outputs is not None else None
+        needed = self.layers if want is None else self._needed_layers(want)
+        for layer in needed:
+            with layer_scope(layer.name):
+                if layer.is_data:
+                    env[layer.name] = _coerce_feed(layer, feed)
+                else:
+                    parent_acts = [env[p.name] for p in layer.parents]
+                    local = {s.name: all_params[s.name] for s in layer.param_specs}
+                    env[layer.name] = layer.forward(ctx, local, *parent_acts)
+        new_state = {**state, **ctx.updated_state}
+        result = {l.name: env[l.name] for l in self.layers if l.name in env}
+        return result, new_state
+
+    def _needed_layers(self, want: set) -> List[LayerOutput]:
+        by_name = {l.name: l for l in self.layers}
+        missing = want - set(by_name)
+        if missing:
+            raise ConfigError(f"unknown output layers {sorted(missing)}")
+        return Topology._toposort([by_name[n] for n in want])
+
+    # -- convenience --------------------------------------------------------
+
+    def output_names(self) -> List[str]:
+        return [o.name for o in self.outputs]
+
+    def summary(self) -> str:
+        rows = ["%-28s %-20s %8s  %s" % ("name", "type", "size", "parents")]
+        for l in self.layers:
+            rows.append(
+                "%-28s %-20s %8d  %s"
+                % (l.name, l.layer_type, l.size, ",".join(p.name for p in l.parents))
+            )
+        n_params = sum(
+            int(jnp.prod(jnp.array(s.shape)))
+            for s in self.param_specs.values()
+            if not s.is_state
+        )
+        rows.append(f"total parameters: {n_params}")
+        return "\n".join(rows)
+
+
+def _coerce_feed(layer: LayerOutput, feed: Dict[str, Any]) -> Act:
+    if layer.name not in feed:
+        raise ConfigError(f"missing feed for data layer {layer.name!r}")
+    v = feed[layer.name]
+    if isinstance(v, Act):
+        act = v
+    elif isinstance(v, tuple):
+        value, lengths = v
+        act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths))
+    else:
+        act = Act(value=jnp.asarray(v))
+    if act.is_seq and act.mask is None:
+        from paddle_tpu.ops.sequence import mask_from_lengths
+
+        T = act.value.shape[1]
+        act = replace(act, mask=mask_from_lengths(act.lengths, T))
+    return act

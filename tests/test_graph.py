@@ -1,0 +1,184 @@
+"""Graph engine tests — network-level analog of test_LayerGrad /
+test_NetworkCompare (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.utils.error import ConfigError
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+def _mlp():
+    x = nn.data("x", size=8)
+    lab = nn.data("label", size=1, dtype="int32")
+    h = nn.fc(x, 16, act="relu")
+    out = nn.fc(h, 4, act="linear", name="logits")
+    cost = nn.classification_cost(out, lab, name="cost")
+    return nn.Topology([cost, out])
+
+
+def test_mlp_init_and_apply(rng):
+    topo = _mlp()
+    params, state = topo.init(jax.random.PRNGKey(0))
+    assert len(params) == 4  # 2 weights + 2 biases
+    feed = {"x": rng.randn(5, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (5, 1))}
+    outs, _ = topo.apply(params, state, feed)
+    assert outs["logits"].value.shape == (5, 4)
+    assert outs["cost"].value.shape == ()
+    assert np.isfinite(float(outs["cost"].value))
+
+
+def test_mlp_grad_and_jit(rng):
+    topo = _mlp()
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"x": jnp.asarray(rng.randn(5, 8).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 4, (5, 1)))}
+
+    @jax.jit
+    def loss_fn(p):
+        outs, _ = topo.apply(p, state, feed, train=True, rng=jax.random.PRNGKey(1))
+        return outs["cost"].value
+
+    g = jax.grad(loss_fn)(params)
+    assert set(g) == set(params)
+    total = sum(float(jnp.sum(jnp.abs(v))) for v in g.values())
+    assert total > 0
+
+
+def test_network_finite_difference(rng):
+    """Whole-network gradient check — the testLayerGrad analog."""
+    topo = _mlp()
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"x": jnp.asarray(rng.randn(3, 8).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 4, (3, 1)))}
+
+    def loss(p):
+        outs, _ = topo.apply(p, state, feed)
+        return outs["cost"].value
+
+    g = jax.grad(loss)(params)
+    wname = [k for k in params if k.endswith(".w0")][0]
+    eps = 1e-3
+    w = params[wname]
+    idx = (0, 0)
+    for sign in (1,):
+        pp = dict(params)
+        pp[wname] = w.at[idx].add(eps)
+        pm = dict(params)
+        pm[wname] = w.at[idx].add(-eps)
+        fd = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+    np.testing.assert_allclose(float(g[wname][idx]), fd, rtol=3e-2, atol=1e-4)
+
+
+def test_sequence_network(rng):
+    vocab, emb, H = 50, 12, 10
+    words = nn.data("words", size=vocab, is_seq=True, dtype="int32")
+    lab = nn.data("label", size=1, dtype="int32")
+    e = nn.embedding(words, emb)
+    l = nn.lstmemory(e, H)
+    p = nn.pooling(l, pooling_type="max")
+    logits = nn.fc(p, 2, act="linear", name="logits")
+    cost = nn.classification_cost(logits, lab, name="cost")
+    topo = nn.Topology([cost, logits])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    B, T = 4, 7
+    ids = rng.randint(0, vocab, (B, T)).astype(np.int32)
+    lengths = np.array([7, 3, 5, 1], np.int32)
+    feed = {"words": (ids, lengths), "label": rng.randint(0, 2, (B, 1))}
+    outs, _ = topo.apply(params, state, feed)
+    assert outs["logits"].value.shape == (B, 2)
+    assert np.isfinite(float(outs["cost"].value))
+    # padding invariance at network level
+    ids2 = np.concatenate([ids, rng.randint(0, vocab, (B, 4)).astype(np.int32)], 1)
+    outs2, _ = topo.apply(params, state, {"words": (ids2, lengths), "label": feed["label"]})
+    np.testing.assert_allclose(
+        np.asarray(outs2["logits"].value), np.asarray(outs["logits"].value), atol=1e-5
+    )
+
+
+def test_conv_network_shapes(rng):
+    img = nn.data("img", size=1, height=28, width=28)
+    lab = nn.data("label", size=1, dtype="int32")
+    c1 = nn.img_conv(img, filter_size=5, num_filters=8, padding="VALID")
+    p1 = nn.img_pool(c1, pool_size=2)
+    c2 = nn.img_conv(p1, filter_size=5, num_filters=16, padding="VALID")
+    p2 = nn.img_pool(c2, pool_size=2)
+    out = nn.fc(p2, 10, act="linear", name="logits")
+    cost = nn.classification_cost(out, lab, name="cost")
+    topo = nn.Topology(cost)
+    assert p2.meta["hw"] == (4, 4)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"img": rng.randn(2, 28, 28, 1).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1))}
+    outs, _ = topo.apply(params, state, feed)
+    assert np.isfinite(float(outs["cost"].value))
+
+
+def test_batch_norm_state_updates(rng):
+    img = nn.data("img", size=3, height=4, width=4)
+    bn = nn.batch_norm(nn.img_conv(img, filter_size=3, num_filters=6), name="bn")
+    topo = nn.Topology(bn)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    assert any("moving_mean" in k for k in state)
+    feed = {"img": rng.randn(8, 4, 4, 3).astype(np.float32) * 2 + 1}
+    _, new_state = topo.apply(params, state, feed, train=True)
+    mm = [k for k in state if "moving_mean" in k][0]
+    assert not np.allclose(np.asarray(new_state[mm]), np.asarray(state[mm]))
+    # eval mode leaves state untouched
+    _, s2 = topo.apply(params, state, feed, train=False)
+    np.testing.assert_array_equal(np.asarray(s2[mm]), np.asarray(state[mm]))
+
+
+def test_shared_parameters(rng):
+    x = nn.data("x", size=6)
+    shared = nn.ParamAttr(name="shared_w")
+    a = nn.fc(x, 6, act="linear", param_attr=shared, bias_attr=False, name="a")
+    b = nn.fc(a, 6, act="linear", param_attr=shared, bias_attr=False, name="b")
+    topo = nn.Topology(b)
+    params, _ = topo.init(jax.random.PRNGKey(0))
+    assert list(params) == ["shared_w"]
+
+
+def test_shared_param_shape_conflict():
+    x = nn.data("x", size=6)
+    shared = nn.ParamAttr(name="shared_w")
+    a = nn.fc(x, 6, act="linear", param_attr=shared, bias_attr=False, name="a")
+    b = nn.fc(a, 7, act="linear", param_attr=shared, bias_attr=False, name="b")
+    with pytest.raises(ConfigError, match="conflicting shapes"):
+        nn.Topology(b)
+
+
+def test_bidirectional_and_seq_layers(rng):
+    vocab = 20
+    words = nn.data("words", size=vocab, is_seq=True, dtype="int32")
+    e = nn.embedding(words, 8)
+    bi = nn.bidirectional_rnn(e, 6, cell="gru")
+    assert bi.size == 12
+    rev = nn.seq_reverse(e)
+    ctx = nn.context_projection(e, context_len=3)
+    assert ctx.size == 24
+    topo = nn.Topology([bi, rev, ctx])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    ids = rng.randint(0, vocab, (3, 5)).astype(np.int32)
+    lengths = np.array([5, 2, 4], np.int32)
+    outs, _ = topo.apply(params, state, {"words": (ids, lengths)})
+    assert outs[bi.name].value.shape == (3, 5, 12)
+    assert outs[ctx.name].value.shape == (3, 5, 24)
+
+
+def test_selective_outputs(rng):
+    topo = _mlp()
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"x": rng.randn(2, 8).astype(np.float32)}
+    # logits only — label feed not required
+    outs, _ = topo.apply(params, state, feed, outputs=["logits"])
+    assert outs["logits"].value.shape == (2, 4)

@@ -1,0 +1,12 @@
+from paddle_tpu.data.reader import (
+    batch,
+    shuffle,
+    buffered,
+    map_readers,
+    compose,
+    chain,
+    firstn,
+    cache,
+)
+from paddle_tpu.data.feeder import DataFeeder, bucket_length
+from paddle_tpu.data import datasets

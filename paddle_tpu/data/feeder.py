@@ -1,0 +1,89 @@
+"""DataFeeder: python samples -> padded device arrays.
+
+Analog of the reference's DataProviderConverter / py_paddle feeder
+(py_paddle/dataprovider_converter.py; Argument construction in
+paddle/api/Arguments.cpp): converts a minibatch of python rows into the feed
+dict ``Topology.apply`` expects.
+
+TPU-first: sequences are padded to a *bucketed* max length (next power-of-two
+style buckets by default) so XLA sees a small, finite set of shapes instead of
+one shape per batch (the reference's flat layout has no padding at all; on TPU
+bucketing is the shape-stability analog). Slot kinds mirror the reference's
+input types (dense_vector, integer_value, integer_value_sequence,
+dense_vector_sequence, sparse later).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DataFeeder", "bucket_length"]
+
+_DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = _DEFAULT_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+class DataFeeder:
+    """feeding: {data_layer_name: slot_index}; types: {name: kind} with kind in
+    'dense' | 'int' | 'ids_seq' | 'dense_seq'."""
+
+    def __init__(
+        self,
+        types: Dict[str, str],
+        feeding: Optional[Dict[str, int]] = None,
+        *,
+        buckets: Sequence[int] = _DEFAULT_BUCKETS,
+        max_len: Optional[int] = None,
+        dtype: str = "float32",
+    ) -> None:
+        self.types = types
+        self.feeding = feeding or {name: i for i, name in enumerate(types)}
+        self.buckets = tuple(buckets)
+        self.max_len = max_len
+        self.dtype = dtype
+
+    def __call__(self, batch_rows: List[Tuple]) -> Dict[str, Any]:
+        feed: Dict[str, Any] = {}
+        for name, kind in self.types.items():
+            col = [row[self.feeding[name]] for row in batch_rows]
+            if kind == "dense":
+                feed[name] = np.asarray(col, self.dtype)
+            elif kind == "int":
+                arr = np.asarray(col, np.int32)
+                if arr.ndim == 1:
+                    arr = arr[:, None]
+                feed[name] = arr
+            elif kind in ("ids_seq", "dense_seq"):
+                feed[name] = self._pad_seq(col, kind)
+            else:
+                raise ValueError(f"unknown slot kind {kind!r} for {name!r}")
+        return feed
+
+    def _pad_seq(self, col: List, kind: str) -> Tuple[np.ndarray, np.ndarray]:
+        lengths = np.asarray([len(s) for s in col], np.int32)
+        T = int(lengths.max()) if len(lengths) else 1
+        T = max(T, 1)
+        if self.max_len:
+            T = min(max(T, 1), self.max_len)
+            lengths = np.minimum(lengths, self.max_len)
+        T = bucket_length(T, self.buckets)
+        if kind == "ids_seq":
+            out = np.zeros((len(col), T), np.int32)
+            for i, s in enumerate(col):
+                s = list(s)[: lengths[i]]
+                out[i, : len(s)] = s
+        else:
+            D = len(col[0][0])
+            out = np.zeros((len(col), T, D), self.dtype)
+            for i, s in enumerate(col):
+                s = np.asarray(s, self.dtype)[: lengths[i]]
+                out[i, : len(s)] = s
+        return out, lengths

@@ -1,0 +1,339 @@
+"""Optimizers — analog of the reference's optimizer tier.
+
+Reference surface: SGD/momentum, SparseMomentum, AdaGrad, AdaDelta, RMSProp,
+DecayedAdagrad, Adam, AdaMax (paddle/parameter/FirstOrderOptimizer.h:23-331),
+gradient clipping (:331), regularizers (Regularizer.h), learning-rate
+schedulers (LearningRateScheduler.cpp), and parameter averaging
+(AverageOptimizer.cpp).  The same update rules also exist as device tensor
+expressions (paddle/math/TrainingAlgorithmOp.cu) — here each rule is a pure
+jnp expression tree-mapped over the params pytree, so it jits into the fused
+update kernel XLA builds anyway, on any device, and shards with the params
+under pjit.
+
+Per-parameter attributes (lr scale, L2 decay, static) come from the
+Topology's ParamSpecs — the analog of ParameterConfig fields.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.registry import Registry
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "AdaGrad",
+    "AdaDelta",
+    "RMSProp",
+    "DecayedAdaGrad",
+    "Adam",
+    "AdaMax",
+    "OPTIMIZERS",
+    "LR_SCHEDULES",
+    "lr_schedule",
+    "clip_by_global_norm",
+    "clip_by_value",
+    "ParameterAverager",
+]
+
+OPTIMIZERS: Registry = Registry("optimizer")
+LR_SCHEDULES: Registry = Registry("lr_schedule")
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (LearningRateScheduler.cpp analogs)
+# ---------------------------------------------------------------------------
+
+
+@LR_SCHEDULES.register("constant")
+def _const(base, step, **kw):
+    return base
+
+
+@LR_SCHEDULES.register("poly")
+def _poly(base, step, *, decay_a=1e-4, decay_b=0.75, **kw):
+    # base * (1 + a*step)^(-b) — the reference's default 'poly' schedule
+    return base * jnp.power(1.0 + decay_a * step, -decay_b)
+
+
+@LR_SCHEDULES.register("exp")
+def _exp(base, step, *, decay_a=0.99, decay_b=1000.0, **kw):
+    return base * jnp.power(decay_a, step / decay_b)
+
+
+@LR_SCHEDULES.register("discexp")
+def _discexp(base, step, *, decay_a=0.99, decay_b=1000.0, **kw):
+    return base * jnp.power(decay_a, jnp.floor(step / decay_b))
+
+
+@LR_SCHEDULES.register("linear")
+def _linear(base, step, *, decay_a=1e-6, decay_b=1e-4, **kw):
+    return jnp.maximum(base - decay_a * step, decay_b)
+
+
+@LR_SCHEDULES.register("warmup_cosine")
+def _warmup_cosine(base, step, *, warmup_steps=1000, total_steps=100000, **kw):
+    # modern addition (not in the reference): linear warmup + cosine decay
+    warm = base * step / jnp.maximum(warmup_steps, 1)
+    prog = jnp.clip((step - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1), 0, 1)
+    cos = base * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+    return jnp.where(step < warmup_steps, warm, cos)
+
+
+def lr_schedule(name: str, base: float, **kwargs) -> Callable:
+    fn = LR_SCHEDULES.get(name)
+    return lambda step: fn(base, step, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# gradient clipping (OptimizerWithGradientClipping analog)
+# ---------------------------------------------------------------------------
+
+
+def clip_by_value(grads, threshold: float):
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, -threshold, threshold), grads)
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), gnorm
+
+
+# ---------------------------------------------------------------------------
+# optimizers
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Optimizer:
+    """Base: holds learning-rate schedule + clipping + weight decay config.
+
+    ``update(step, params, grads, opt_state, lr_scales, decays)`` is pure and
+    jit/pjit-safe. lr_scales/decays are per-param-name dicts from ParamSpecs.
+    """
+
+    learning_rate: float = 0.01
+    learning_rate_schedule: str = "constant"
+    schedule_args: Dict[str, Any] = field(default_factory=dict)
+    gradient_clipping_threshold: float = 0.0  # 0 = off; clip by global norm
+    l2_rate: float = 0.0  # global L2 weight decay (Regularizer analog)
+    l1_rate: float = 0.0
+
+    def lr_at(self, step):
+        fn = LR_SCHEDULES.get(self.learning_rate_schedule)
+        return fn(self.learning_rate, step, **self.schedule_args)
+
+    # per-leaf rule: override in subclasses
+    def init_leaf(self, p):
+        return ()
+
+    def update_leaf(self, p, g, s, lr):
+        raise NotImplementedError
+
+    def init_state(self, params) -> Dict[str, Any]:
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {k: self.init_leaf(p) for k, p in params.items()},
+        }
+
+    def update(
+        self,
+        params: Dict[str, Any],
+        grads: Dict[str, Any],
+        opt_state: Dict[str, Any],
+        *,
+        lr_scales: Optional[Dict[str, float]] = None,
+        decays: Optional[Dict[str, float]] = None,
+        statics: Optional[Dict[str, bool]] = None,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        step = opt_state["step"] + 1
+        lr = self.lr_at(step)
+        if self.gradient_clipping_threshold > 0:
+            grads, _ = clip_by_global_norm(grads, self.gradient_clipping_threshold)
+        new_params, new_slots = {}, {}
+        for k, p in params.items():
+            g = grads[k]
+            if statics and statics.get(k):
+                new_params[k], new_slots[k] = p, opt_state["slots"][k]
+                continue
+            decay = (decays.get(k, 0.0) if decays else 0.0) + self.l2_rate
+            if decay:
+                g = g + decay * p
+            if self.l1_rate:
+                g = g + self.l1_rate * jnp.sign(p)
+            scale = lr_scales.get(k, 1.0) if lr_scales else 1.0
+            p2, s2 = self.update_leaf(p, g, opt_state["slots"][k], lr * scale, step)
+            new_params[k] = p2.astype(p.dtype)
+            new_slots[k] = s2
+        return new_params, {"step": step, "slots": new_slots}
+
+
+@OPTIMIZERS.register("sgd")
+@dataclass
+class SGD(Optimizer):
+    """Plain SGD (SgdOptimizer, FirstOrderOptimizer.h:23)."""
+
+    def update_leaf(self, p, g, s, lr, step):
+        return p - lr * g, s
+
+
+@OPTIMIZERS.register("momentum")
+@dataclass
+class Momentum(Optimizer):
+    """Heavy-ball momentum (the reference folds momentum into SGD via
+    ParameterConfig::momentum)."""
+
+    momentum: float = 0.9
+    use_nesterov: bool = False
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, p, g, v, lr, step):
+        v2 = self.momentum * v - lr * g
+        if self.use_nesterov:
+            return p + self.momentum * v2 - lr * g, v2
+        return p + v2, v2
+
+
+@OPTIMIZERS.register("adagrad")
+@dataclass
+class AdaGrad(Optimizer):
+    """AdaGrad (AdagradParameterOptimizer, FirstOrderOptimizer.h:100;
+    math/TrainingAlgorithmOp.cu adagradApply)."""
+
+    epsilon: float = 1e-6
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, p, g, acc, lr, step):
+        acc2 = acc + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc2) + self.epsilon), acc2
+
+
+@OPTIMIZERS.register("adadelta")
+@dataclass
+class AdaDelta(Optimizer):
+    """AdaDelta (AdaDeltaParameterOptimizer, FirstOrderOptimizer.h:130)."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_leaf(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))  # E[g^2], E[dx^2]
+
+    def update_leaf(self, p, g, s, lr, step):
+        eg, ed = s
+        eg2 = self.rho * eg + (1 - self.rho) * jnp.square(g)
+        dx = -jnp.sqrt((ed + self.epsilon) / (eg2 + self.epsilon)) * g
+        ed2 = self.rho * ed + (1 - self.rho) * jnp.square(dx)
+        return p + lr * dx, (eg2, ed2)
+
+
+@OPTIMIZERS.register("rmsprop")
+@dataclass
+class RMSProp(Optimizer):
+    """RMSProp with mean-centering (RMSPropParameterOptimizer,
+    FirstOrderOptimizer.h:156 — tracks E[g^2] and E[g])."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_leaf(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))  # E[g^2], E[g]
+
+    def update_leaf(self, p, g, s, lr, step):
+        eg2, eg = s
+        eg2n = self.rho * eg2 + (1 - self.rho) * jnp.square(g)
+        egn = self.rho * eg + (1 - self.rho) * g
+        denom = jnp.sqrt(eg2n - jnp.square(egn) + self.epsilon)
+        return p - lr * g / denom, (eg2n, egn)
+
+
+@OPTIMIZERS.register("decayed_adagrad")
+@dataclass
+class DecayedAdaGrad(Optimizer):
+    """Decayed AdaGrad (DecayedAdagradParameterOptimizer,
+    FirstOrderOptimizer.h:199)."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_leaf(self, p):
+        return jnp.zeros_like(p)
+
+    def update_leaf(self, p, g, acc, lr, step):
+        acc2 = self.rho * acc + (1 - self.rho) * jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc2) + self.epsilon), acc2
+
+
+@OPTIMIZERS.register("adam")
+@dataclass
+class Adam(Optimizer):
+    """Adam (AdamParameterOptimizer, FirstOrderOptimizer.h:244;
+    TrainingAlgorithmOp.cu adamApply) with bias correction."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_leaf(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def update_leaf(self, p, g, s, lr, step):
+        m, v = s
+        m2 = self.beta1 * m + (1 - self.beta1) * g
+        v2 = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        mhat = m2 / (1 - jnp.power(self.beta1, t))
+        vhat = v2 / (1 - jnp.power(self.beta2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m2, v2)
+
+
+@OPTIMIZERS.register("adamax")
+@dataclass
+class AdaMax(Optimizer):
+    """AdaMax (AdamaxParameterOptimizer, FirstOrderOptimizer.h:275)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+
+    def init_leaf(self, p):
+        return (jnp.zeros_like(p), jnp.zeros_like(p))
+
+    def update_leaf(self, p, g, s, lr, step):
+        m, u = s
+        m2 = self.beta1 * m + (1 - self.beta1) * g
+        u2 = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        t = step.astype(jnp.float32)
+        return p - lr / (1 - jnp.power(self.beta1, t)) * m2 / (u2 + 1e-12), (m2, u2)
+
+
+# ---------------------------------------------------------------------------
+# parameter averaging (AverageOptimizer analog)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParameterAverager:
+    """Maintains an EMA of parameters for evaluation — analog of the
+    reference's AverageOptimizer / SgdUpdaterWithCpuAverager
+    (paddle/parameter/AverageOptimizer.cpp)."""
+
+    average_window: float = 0.999
+
+    def init_state(self, params):
+        return jax.tree_util.tree_map(lambda p: p, params)
+
+    def update(self, avg, params):
+        w = self.average_window
+        return jax.tree_util.tree_map(lambda a, p: w * a + (1 - w) * p, avg, params)

@@ -1,0 +1,10 @@
+from paddle_tpu.trainer.trainer import SGDTrainer
+from paddle_tpu.trainer import events
+from paddle_tpu.trainer.checkpoint import (
+    save_checkpoint,
+    load_checkpoint,
+    save_pytree,
+    load_pytree,
+    latest_pass,
+)
+from paddle_tpu.trainer.checkgrad import check_gradients

@@ -1,0 +1,45 @@
+"""Training events — analog of python/paddle/v2/event.py.
+
+The v2 trainer invokes a user ``event_handler`` with BeginPass/EndPass/
+BeginIteration/EndIteration events carrying cost and evaluator results
+(reference: python/paddle/v2/trainer.py:108-173, event.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration", "TestResult"]
+
+
+@dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclass
+class EndPass:
+    pass_id: int
+    evaluator: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+@dataclass
+class EndIteration:
+    pass_id: int
+    batch_id: int
+    cost: float
+    evaluator: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class TestResult:
+    pass_id: int
+    cost: float
+    evaluator: Dict[str, float] = field(default_factory=dict)

@@ -1,0 +1,245 @@
+"""The training driver — analog of the reference's trainer tier.
+
+Reference: the v2 SGD trainer drives GradientMachine.forwardBackward +
+ParameterUpdater per batch from a Python loop
+(python/paddle/v2/trainer.py:30-175), over the C++ Trainer/TrainerInternal
+machinery (paddle/trainer/Trainer.cpp:261-576, TrainerInternal.cpp:66-172).
+
+TPU-native: the whole batch step — forward, backward (autodiff), optimizer
+update — is ONE jitted pure function; parameters, optimizer slots and BN state
+are donated so updates are in-place in HBM.  Data parallelism is not a
+separate "MultiGradientMachine": pass a ``Mesh`` and the same step function
+runs SPMD with the batch sharded over the 'data' axis — XLA inserts the ICI
+all-reduce for gradients (replacing both the reference's per-GPU TrainerThread
+ring and the pserver tier; SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.graph import LayerOutput, Topology
+from paddle_tpu.param.optimizers import Optimizer, ParameterAverager, SGD
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.trainer.checkpoint import load_checkpoint, save_checkpoint
+from paddle_tpu.utils import FLAGS, logger
+
+__all__ = ["SGDTrainer"]
+
+
+class SGDTrainer:
+    """v2-style trainer: ``SGDTrainer(cost=..., optimizer=...)``, then
+    ``.train(reader, num_passes, event_handler, feeder)``."""
+
+    def __init__(
+        self,
+        cost: LayerOutput,
+        optimizer: Optional[Optimizer] = None,
+        *,
+        extra_outputs: Sequence[LayerOutput] = (),
+        mesh=None,
+        data_axis: str = "data",
+        seed: Optional[int] = None,
+        averager: Optional[ParameterAverager] = None,
+    ) -> None:
+        self.cost_name = cost.name
+        self.extra_names = [e.name for e in extra_outputs]
+        self.topology = Topology([cost, *extra_outputs])
+        self.optimizer = optimizer or SGD(learning_rate=0.01)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.averager = averager
+
+        seed = FLAGS.seed if seed is None else seed
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_key = jax.random.split(self._rng)
+        self.params, self.state = self.topology.init(init_key)
+
+        # per-parameter attrs from specs (ParameterConfig analog)
+        self.lr_scales = {}
+        self.decays = {}
+        self.statics = {}
+        for name, spec in self.topology.param_specs.items():
+            if spec.is_state:
+                continue
+            if spec.attr.learning_rate != 1.0:
+                self.lr_scales[name] = spec.attr.learning_rate
+            if spec.attr.l2_decay:
+                self.decays[name] = spec.attr.l2_decay
+            if spec.attr.is_static:
+                self.statics[name] = True
+
+        self.opt_state = self.optimizer.init_state(self.params)
+        self.avg_params = self.averager.init_state(self.params) if self.averager else None
+        self._step = self._build_step()
+        self._eval_fns: Dict[str, Callable] = {}
+
+    # ------------------------------------------------------------------
+
+    def _build_step(self):
+        topo = self.topology
+        cost_name = self.cost_name
+        extra_names = list(self.extra_names)
+        opt = self.optimizer
+        lr_scales, decays, statics = self.lr_scales, self.decays, self.statics
+
+        def step(params, state, opt_state, rng, feed):
+            def loss_fn(p):
+                outs, new_state = topo.apply(p, state, feed, train=True, rng=rng)
+                extras = {k: outs[k].value for k in extra_names}
+                return outs[cost_name].value, (new_state, extras)
+
+            (loss, (new_state, extras)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            new_params, new_opt = opt.update(
+                params, grads, opt_state,
+                lr_scales=lr_scales, decays=decays, statics=statics,
+            )
+            return loss, new_params, new_state, new_opt, extras
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.mesh
+            repl = NamedSharding(mesh, P())
+
+            def sharded_step(params, state, opt_state, rng, feed):
+                return step(params, state, opt_state, rng, feed)
+
+            jitted = jax.jit(sharded_step, donate_argnums=(0, 2))
+
+            def run(params, state, opt_state, rng, feed):
+                feed = self._shard_feed(feed)
+                params = jax.device_put(params, repl)
+                opt_state = jax.device_put(opt_state, repl)
+                return jitted(params, state, opt_state, rng, feed)
+
+            return run
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _shard_feed(self, feed):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        axis = self.data_axis
+
+        def put(v):
+            v = jnp.asarray(v)
+            spec = P(axis, *([None] * (v.ndim - 1)))
+            return jax.device_put(v, NamedSharding(mesh, spec))
+
+        out = {}
+        for k, v in feed.items():
+            if isinstance(v, tuple):
+                out[k] = tuple(put(x) for x in v)
+            else:
+                out[k] = put(v)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def train_batch(self, feed: Dict[str, Any]) -> float:
+        """Run one optimizer step on a prepared feed dict; returns cost."""
+        self._rng, key = jax.random.split(self._rng)
+        loss, self.params, self.state, self.opt_state, extras = self._step(
+            self.params, self.state, self.opt_state, key, feed
+        )
+        if self.averager is not None:
+            self.avg_params = self.averager.update(self.avg_params, self.params)
+        self._last_extras = extras
+        return loss
+
+    def train(
+        self,
+        reader: Callable,
+        *,
+        num_passes: int = 1,
+        event_handler: Optional[Callable] = None,
+        feeder: Optional[Callable] = None,
+        test_reader: Optional[Callable] = None,
+    ) -> None:
+        """Pass/batch loop with events — trainer.py:108-173 analog."""
+        handler = event_handler or (lambda e: None)
+        log_period = FLAGS.log_period
+        for pass_id in range(FLAGS.start_pass, num_passes):
+            handler(ev.BeginPass(pass_id))
+            costs: List[float] = []
+            t0 = time.time()
+            for batch_id, data_batch in enumerate(reader()):
+                handler(ev.BeginIteration(pass_id, batch_id))
+                feed = feeder(data_batch) if feeder else data_batch
+                loss = self.train_batch(feed)
+                cost = float(loss)
+                costs.append(cost)
+                handler(ev.EndIteration(pass_id, batch_id, cost))
+                if log_period and (batch_id + 1) % log_period == 0:
+                    logger.info(
+                        "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
+                        pass_id, batch_id + 1, float(np.mean(costs[-log_period:])),
+                        log_period / max(time.time() - t0, 1e-9),
+                    )
+                    t0 = time.time()
+            result = {}
+            if test_reader is not None:
+                result = self.test(test_reader, feeder=feeder)
+            handler(ev.EndPass(pass_id, evaluator=result))
+            if FLAGS.save_dir and FLAGS.saving_period and (
+                (pass_id + 1) % FLAGS.saving_period == 0
+            ):
+                self.save(FLAGS.save_dir, pass_id)
+
+    # ------------------------------------------------------------------
+
+    def _infer_fn(self, output_names: Sequence[str], train: bool = False):
+        topo = self.topology
+
+        @jax.jit
+        def fn(params, state, feed):
+            outs, _ = topo.apply(params, state, feed, train=False)
+            return {k: outs[k].value for k in output_names}
+
+        return fn
+
+    def test(self, reader: Callable, *, feeder: Optional[Callable] = None) -> Dict[str, float]:
+        """Eval loop — Tester analog (paddle/trainer/Tester.h:40)."""
+        fn = getattr(self, "_test_fn", None)
+        if fn is None:
+            fn = self._test_fn = self._infer_fn([self.cost_name])
+        params = self.avg_params if self.avg_params is not None else self.params
+        costs = []
+        for data_batch in reader():
+            feed = feeder(data_batch) if feeder else data_batch
+            out = fn(params, self.state, feed)
+            costs.append(float(out[self.cost_name]))
+        return {"cost": float(np.mean(costs)) if costs else float("nan")}
+
+    def infer(self, output_layers, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """paddle.infer analog: run forward to the given layers."""
+        if isinstance(output_layers, LayerOutput):
+            output_layers = [output_layers]
+        names = [l.name for l in output_layers]
+        topo = self.topology
+
+        outs, _ = topo.apply(self.params, self.state, feed, train=False, outputs=names)
+        return {k: np.asarray(outs[k].value) for k in names}
+
+    # ------------------------------------------------------------------
+
+    def save(self, save_dir: str, pass_id: int) -> str:
+        return save_checkpoint(
+            save_dir, pass_id,
+            params=self.params, state=self.state, opt_state=self.opt_state,
+        )
+
+    def load(self, save_dir: str, pass_id: int) -> None:
+        self.params, self.state, self.opt_state = load_checkpoint(
+            save_dir, pass_id,
+            params=self.params, state=self.state, opt_state=self.opt_state,
+        )

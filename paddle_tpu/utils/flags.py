@@ -159,7 +159,7 @@ define_flag("log_period", 100, "log every N batches")
 define_flag("test_period", 0, "test every N batches (0 = per pass)")
 define_flag("show_parameter_stats_period", 0, "print param stats every N batches")
 define_flag("checkgrad_eps", 1e-2, "epsilon for finite-difference gradient checks")
-define_flag("save_dir", "./output", "checkpoint root; pass dirs saved under it")
+define_flag("save_dir", "", "checkpoint root; pass dirs saved under it ('' = no saving)")
 define_flag("start_pass", 0, "resume training from this pass")
 define_flag("saving_period", 1, "save checkpoint every N passes")
 

@@ -1,0 +1,32 @@
+"""Recommendation model — analog of demo/recommendation (MovieLens).
+
+Reference: demo/recommendation trains user/movie embedding towers combined by
+cos-sim / fc to regress ratings (dataset python/paddle/v2/dataset/movielens).
+High-dimensional sparse embeddings are the workload the reference serves with
+row-sparse pserver prefetch (SURVEY.md §2 item 4); on TPU the tables live
+sharded over the mesh (parallel/embedding.py) and gradients are scatter-adds.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["movielens_net"]
+
+
+def movielens_net(n_users: int = 6040, n_movies: int = 3706, *, emb_dim: int = 64,
+                  hid_dim: int = 64):
+    """Two embedding towers -> fc -> dot regression to rating. Returns
+    (cost, prediction)."""
+    uid = nn.data("user_id", size=n_users, dtype="int32")
+    mid = nn.data("movie_id", size=n_movies, dtype="int32")
+    rating = nn.data("score", size=1)
+    u_emb = nn.embedding(uid, emb_dim, name="user_emb")
+    m_emb = nn.embedding(mid, emb_dim, name="movie_emb")
+    u_fc = nn.fc(u_emb, hid_dim, act="relu", name="user_fc")
+    m_fc = nn.fc(m_emb, hid_dim, act="relu", name="movie_fc")
+    both = nn.concat([u_fc, m_fc], name="towers")
+    h = nn.fc(both, hid_dim, act="relu", name="merge_fc")
+    pred = nn.fc(h, 1, act="linear", name="prediction")
+    cost = nn.mse_cost(pred, rating, name="cost")
+    return cost, pred

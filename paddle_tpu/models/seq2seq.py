@@ -1,0 +1,193 @@
+"""Attention seq2seq (WMT14 NMT) — the flagship model.
+
+Capability analog of the reference's hardest path: demo/seqToseq attention NMT
+(reference: demo/seqToseq/api_train_v2.py:90-189 — 512-dim bidirectional GRU
+encoder, Bahdanau-attention GRU decoder, beam-search generation) built on
+RecurrentGradientMachine (gserver/gradientmachines/RecurrentGradientMachine.cpp:383
+generateSequence; beam callbacks .h:73-188) and simple_attention
+(trainer_config_helpers/networks.py).
+
+TPU-first re-design (SURVEY.md §7 hard part (a)): the dynamic per-sequence
+unroll becomes a static-shape ``lax.scan`` over bucketed padded targets with
+masking; beam search is a fixed-``max_len`` scan maintaining [B, K] beam state
+(no host round-trips — the whole decode jits onto the chip).  The encoder's
+input projections and the decoder's readout are big batched MXU matmuls; the
+per-step recurrent matmuls are [B*K, H] x [H, 3H].
+
+Special token ids follow the reference's wmt14 convention: <s>=0, <e>=1,
+<unk>=2 (python/paddle/v2/dataset/wmt14.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops as O
+
+__all__ = ["Seq2SeqAttention"]
+
+BOS, EOS, UNK = 0, 1, 2
+
+
+@dataclass
+class Seq2SeqAttention:
+    src_vocab: int = 30000
+    trg_vocab: int = 30000
+    emb_dim: int = 512
+    enc_dim: int = 512       # per-direction encoder GRU width
+    dec_dim: int = 512
+    att_dim: int = 512
+
+    # ------------------------------------------------------------------
+
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+        E, H, D, A = self.emb_dim, self.enc_dim, self.dec_dim, self.att_dim
+        ks = jax.random.split(rng, 16)
+
+        def nrm(k, shape, scale=None):
+            scale = scale or (2.0 / (shape[0] + shape[-1])) ** 0.5
+            return scale * jax.random.normal(k, shape, dtype)
+
+        return {
+            "src_emb": nrm(ks[0], (self.src_vocab, E), 0.01),
+            "trg_emb": nrm(ks[1], (self.trg_vocab, E), 0.01),
+            "enc_fw_wx": nrm(ks[2], (E, 3 * H)),
+            "enc_fw_wh": nrm(ks[3], (H, 3 * H)),
+            "enc_fw_b": jnp.zeros((3 * H,), dtype),
+            "enc_bw_wx": nrm(ks[4], (E, 3 * H)),
+            "enc_bw_wh": nrm(ks[5], (H, 3 * H)),
+            "enc_bw_b": jnp.zeros((3 * H,), dtype),
+            "boot_w": nrm(ks[6], (H, D)),
+            "boot_b": jnp.zeros((D,), dtype),
+            "enc_proj_w": nrm(ks[7], (2 * H, A)),
+            "enc_proj_b": jnp.zeros((A,), dtype),
+            "att_dec_w": nrm(ks[8], (D, A)),
+            "att_v": nrm(ks[9], (A,), 0.05),
+            "dec_wx": nrm(ks[10], (E + 2 * H, 3 * D)),
+            "dec_wh": nrm(ks[11], (D, 3 * D)),
+            "dec_b": jnp.zeros((3 * D,), dtype),
+            "out_w": nrm(ks[12], (D, self.trg_vocab)),
+            "out_b": jnp.zeros((self.trg_vocab,), dtype),
+        }
+
+    # ------------------------------------------------------------------
+
+    def encode(self, params, src_ids, src_mask):
+        """[B,S] ids -> (enc [B,S,2H], enc_proj [B,S,A], s0 [B,D])."""
+        emb = O.embedding_lookup(params["src_emb"], src_ids)
+        emb = emb * src_mask[..., None].astype(emb.dtype)
+        h_fw, _ = O.gru_layer(emb, src_mask, params["enc_fw_wx"],
+                              params["enc_fw_wh"], params["enc_fw_b"])
+        h_bw, h_bw_fin = O.gru_layer(emb, src_mask, params["enc_bw_wx"],
+                                     params["enc_bw_wh"], params["enc_bw_b"],
+                                     reverse=True)
+        enc = jnp.concatenate([h_fw, h_bw], axis=-1)
+        enc_proj = O.linear(enc, params["enc_proj_w"], params["enc_proj_b"])
+        s0 = jnp.tanh(O.linear(h_bw_fin, params["boot_w"], params["boot_b"]))
+        return enc, enc_proj, s0
+
+    def _dec_step(self, params, y_emb, s, enc, enc_proj, src_mask):
+        """One decoder step: attention with current state, GRU advance.
+        Returns (s_new [.., D], ctx [.., 2H])."""
+        scores = O.additive_attention_scores(enc_proj, s, params["att_dec_w"],
+                                             params["att_v"])
+        ctx, _ = O.attend(scores, enc, src_mask)
+        x = jnp.concatenate([y_emb, ctx], axis=-1)
+        xp = O.linear(x, params["dec_wx"], params["dec_b"])
+        s_new = O.gru_step(xp, s, params["dec_wh"])
+        return s_new, ctx
+
+    # ------------------------------------------------------------------
+
+    def loss(self, params, batch: Dict[str, Any]):
+        """Teacher-forced token CE. batch: src_ids [B,S], src_len [B],
+        trg_in [B,T] (starts with <s>), trg_next [B,T] (ends with <e>),
+        trg_len [B]."""
+        src_ids, src_len = batch["src_ids"], batch["src_len"]
+        trg_in, trg_next, trg_len = batch["trg_in"], batch["trg_next"], batch["trg_len"]
+        S, T = src_ids.shape[1], trg_in.shape[1]
+        src_mask = O.mask_from_lengths(src_len, S)
+        trg_mask = O.mask_from_lengths(trg_len, T)
+        enc, enc_proj, s0 = self.encode(params, src_ids, src_mask)
+        y_emb = O.embedding_lookup(params["trg_emb"], trg_in)  # [B,T,E]
+
+        def step(s, y_t):
+            s_new, _ = self._dec_step(params, y_t, s, enc, enc_proj, src_mask)
+            return s_new, s_new
+
+        _, states = O.scan_rnn(step, s0, y_emb, trg_mask)  # [B,T,D]
+        logits = O.linear(states, params["out_w"], params["out_b"])
+        return O.sequence_cross_entropy(logits, trg_next, trg_mask)
+
+    # ------------------------------------------------------------------
+
+    def greedy_decode(self, params, src_ids, src_len, *, max_len: int = 50):
+        """Argmax decode — returns (tokens [B, max_len], lengths [B])."""
+        toks, scores = self.beam_search(params, src_ids, src_len,
+                                        beam_size=1, max_len=max_len)
+        return toks[:, 0], scores[:, 0]
+
+    def beam_search(self, params, src_ids, src_len, *, beam_size: int = 3,
+                    max_len: int = 50, length_penalty: float = 0.0):
+        """Batched beam search, fully jitted: returns (tokens [B,K,max_len],
+        scores [B,K]) sorted best-first.  The analog of
+        RecurrentGradientMachine::generateSequence + --beam_size.
+        """
+        B, S = src_ids.shape
+        K, V = beam_size, self.trg_vocab
+        src_mask = O.mask_from_lengths(src_len, S)
+        enc, enc_proj, s0 = self.encode(params, src_ids, src_mask)
+
+        # tile per-beam: [B,K,...] flattened to [B*K,...]
+        def tile(x):
+            return jnp.repeat(x, K, axis=0)
+
+        enc_t, enc_proj_t, mask_t = tile(enc), tile(enc_proj), tile(src_mask)
+        state = tile(s0)                                   # [BK, D]
+        neg_inf = jnp.asarray(-1e9, jnp.float32)
+        logp = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32)[None], (B, 1))
+        tokens = jnp.full((B, K, max_len + 1), EOS, jnp.int32).at[:, :, 0].set(BOS)
+        finished = jnp.zeros((B, K), bool)
+
+        def step(carry, t):
+            tokens, logp, state, finished = carry
+            y = jax.lax.dynamic_index_in_dim(tokens, t, axis=2, keepdims=False)  # [B,K]
+            y_emb = O.embedding_lookup(params["trg_emb"], y.reshape(B * K))
+            s_new, _ = self._dec_step(params, y_emb, state, enc_t, enc_proj_t, mask_t)
+            step_logits = O.linear(s_new, params["out_w"], params["out_b"])
+            step_logp = jax.nn.log_softmax(step_logits.astype(jnp.float32), axis=-1)
+            step_logp = step_logp.reshape(B, K, V)
+            # finished beams may only emit EOS at zero cost
+            eos_only = jnp.full((V,), -1e9, jnp.float32).at[EOS].set(0.0)
+            step_logp = jnp.where(finished[..., None], eos_only[None, None, :], step_logp)
+            cand = logp[..., None] + step_logp                     # [B,K,V]
+            flat = cand.reshape(B, K * V)
+            new_logp, flat_idx = jax.lax.top_k(flat, K)            # [B,K]
+            beam_idx = flat_idx // V                               # [B,K]
+            tok = (flat_idx % V).astype(jnp.int32)
+            # reorder beam state
+            gather = lambda x: jnp.take_along_axis(x, beam_idx, axis=1)
+            tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+            tokens = tokens.at[:, :, t + 1].set(tok)
+            state_bk = s_new.reshape(B, K, -1)
+            state_bk = jnp.take_along_axis(state_bk, beam_idx[..., None], axis=1)
+            finished = gather(finished) | (tok == EOS)
+            return (tokens, new_logp, state_bk.reshape(B * K, -1), finished), None
+
+        (tokens, logp, _, finished), _ = jax.lax.scan(
+            step, (tokens, logp, state, finished), jnp.arange(max_len)
+        )
+        out = tokens[:, :, 1:]
+        if length_penalty > 0:
+            lengths = jnp.sum((out != EOS).astype(jnp.float32), axis=-1) + 1.0
+            scores = logp / jnp.power(lengths, length_penalty)
+        else:
+            scores = logp
+        order = jnp.argsort(-scores, axis=1)
+        out = jnp.take_along_axis(out, order[..., None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return out, scores

@@ -1,0 +1,69 @@
+"""Text-classification models — analogs of demo/sentiment and demo/quick_start.
+
+- stacked_lstm_net: the IMDB stacked-LSTM classifier
+  (reference: demo/sentiment/sentiment_net.py stacked_lstm_net — emb -> fc+lstm
+  stack with alternating directions -> [max-pool over seq of last fc, last lstm
+  state pooled] -> softmax).
+- convolution_net: the sequence-conv text classifier (demo/quick_start,
+  networks.py sequence_conv_pool) — emb -> context window fc -> max pool.
+- lstm_benchmark_net: the 2-layer LSTM config used for the published RNN
+  benchmark numbers (benchmark/paddle/rnn/rnn.py: seq len 100, 2 LSTM layers,
+  fc softmax over last pool).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["stacked_lstm_net", "convolution_net", "lstm_benchmark_net"]
+
+
+def stacked_lstm_net(vocab_size: int, *, emb_dim: int = 128, hid_dim: int = 512,
+                     stacked_num: int = 3, num_classes: int = 2):
+    """demo/sentiment stacked_lstm_net analog. Returns (cost, logits)."""
+    assert stacked_num % 2 == 1
+    words = nn.data("words", size=vocab_size, is_seq=True, dtype="int32")
+    label = nn.data("label", size=1, dtype="int32")
+    emb = nn.embedding(words, emb_dim, name="emb")
+    fc1 = nn.fc(emb, hid_dim, act="linear", name="fc0")
+    lstm1 = nn.lstmemory(fc1, hid_dim, act="relu", name="lstm0")
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        f = nn.fc(inputs, hid_dim, act="linear", name=f"fc{i-1}")
+        l = nn.lstmemory(f, hid_dim, act="relu", reverse=(i % 2 == 0), name=f"lstm{i-1}")
+        inputs = [f, l]
+    fc_last = nn.pooling(inputs[0], pooling_type="max", name="fc_pool")
+    lstm_last = nn.pooling(inputs[1], pooling_type="max", name="lstm_pool")
+    logits = nn.fc([fc_last, lstm_last], num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits
+
+
+def convolution_net(vocab_size: int, *, emb_dim: int = 128, hid_dim: int = 256,
+                    context_len: int = 3, num_classes: int = 2):
+    """Sequence conv + max-pool text classifier (sequence_conv_pool analog)."""
+    words = nn.data("words", size=vocab_size, is_seq=True, dtype="int32")
+    label = nn.data("label", size=1, dtype="int32")
+    emb = nn.embedding(words, emb_dim, name="emb")
+    ctx = nn.context_projection(emb, context_len=context_len, name="ctx")
+    conv = nn.fc(ctx, hid_dim, act="relu", name="seq_conv")
+    pool = nn.pooling(conv, pooling_type="max", name="pool")
+    logits = nn.fc(pool, num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits
+
+
+def lstm_benchmark_net(vocab_size: int = 30000, *, emb_dim: int = 128,
+                       hid_dim: int = 256, num_layers: int = 2,
+                       num_classes: int = 2):
+    """The benchmark RNN config (benchmark/paddle/rnn/rnn.py): embedding,
+    N stacked LSTM layers, max-pool, softmax."""
+    words = nn.data("words", size=vocab_size, is_seq=True, dtype="int32")
+    label = nn.data("label", size=1, dtype="int32")
+    h = nn.embedding(words, emb_dim, name="emb")
+    for i in range(num_layers):
+        h = nn.lstmemory(h, hid_dim, name=f"lstm{i}")
+    pool = nn.pooling(h, pooling_type="max", name="pool")
+    logits = nn.fc(pool, num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits

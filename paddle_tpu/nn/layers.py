@@ -214,7 +214,9 @@ def addto(input: Sequence[LayerOutput], *, act: str = "linear",
         ref = acts[0]
         return _seq_like(ref, out) if ref.is_seq else Act(value=out)
 
-    return LayerOutput(name, "addto", size, inputs, forward, specs)
+    node = LayerOutput(name, "addto", size, inputs, forward, specs)
+    node.meta.update(inputs[0].meta)
+    return node
 
 
 def concat(input: Sequence[LayerOutput], *, name: Optional[str] = None) -> LayerOutput:
@@ -239,7 +241,9 @@ def dropout(input: LayerOutput, rate: float, *, name: Optional[str] = None) -> L
         out = O.dropout(ctx.next_rng(), a.value, rate, train=ctx.train)
         return _seq_like(a, out) if a.is_seq else Act(value=out)
 
-    return LayerOutput(name, "dropout", input.size, [input], forward, [])
+    node = LayerOutput(name, "dropout", input.size, [input], forward, [])
+    node.meta.update(input.meta)
+    return node
 
 
 def mixed(input: Sequence[LayerOutput], size: int, **kw) -> LayerOutput:

@@ -1,0 +1,26 @@
+"""paddle_tpu.parallel — SPMD parallelism over jax.sharding meshes.
+
+Replaces the reference's MultiGradientMachine (single-node DP),
+ParameterServer2 tier (multi-node DP), and ParallelNeuralNetwork (layer-device
+model parallelism) with mesh shardings + XLA collectives, and adds the modern
+strategies the reference predates: tensor parallelism, sequence parallelism
+(ring attention), sharded embeddings. See SURVEY.md §2 parallelism map & §5.8.
+"""
+
+from paddle_tpu.parallel.sharding import (
+    ShardingRules,
+    replicated,
+    batch_sharding,
+    shard_params,
+    P,
+)
+from paddle_tpu.parallel.api import make_parallel_train_step, shard_batch
+from paddle_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
+from paddle_tpu.parallel.embedding import sharded_embedding_lookup, shard_table
+from paddle_tpu.parallel.distributed import (
+    initialize_distributed,
+    global_mesh,
+    is_multi_host,
+    resume_pass,
+)
+from paddle_tpu.utils.devices import make_mesh

@@ -1,0 +1,79 @@
+"""Multi-host (multi-slice) initialization and failure model.
+
+Reference equivalents: the pserver/trainer gflags topology (--pservers,
+--trainer_id, --num_gradient_servers, paddle/utils/Flags.cpp) and the fabric
+cluster launcher (paddle/scripts/cluster_train/paddle.py:101-175).  On TPU the
+launcher is the TPU runtime itself: every host runs the same program,
+``jax.distributed.initialize`` wires the DCN control plane, and
+``jax.devices()`` becomes the global chip list.  The failure model matches the
+reference's (SURVEY.md §5): no elastic scale-up — on failure, restart from the
+latest pass checkpoint (``latest_pass`` + ``--start_pass`` analog).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+from paddle_tpu.utils import FLAGS, logger
+
+__all__ = ["initialize_distributed", "global_mesh", "is_multi_host", "resume_pass"]
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotent jax.distributed.initialize wrapper. No-ops single-host.
+
+    Env-driven on TPU pods (the runtime sets everything); explicit args are
+    for CPU multi-process tests.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if coordinator_address is None and not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # single-host: nothing to do
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "distributed init: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def is_multi_host() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_mesh(shape: Optional[Sequence[int]] = None,
+                axis_names: Optional[Sequence[str]] = None):
+    """Mesh over ALL processes' devices. For pods, prefer putting the
+    DCN-crossing axis ('data') first: intra-slice axes ride ICI, the
+    slice-crossing axis rides DCN (scaling-book recipe)."""
+    from paddle_tpu.utils.devices import make_mesh
+
+    initialize_distributed()
+    return make_mesh(shape, axis_names)
+
+
+def resume_pass(save_dir: str) -> int:
+    """Pass id to resume from after restart (checkpoint-restart recovery)."""
+    from paddle_tpu.trainer.checkpoint import latest_pass
+
+    last = latest_pass(save_dir)
+    return last + 1 if last >= 0 else 0

@@ -1,0 +1,99 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference predates sequence parallelism entirely (SURVEY.md §5: its only
+long-sequence machinery is padding-free batching + fused RNN kernels).  For a
+first-class TPU framework long context is mandatory: this module implements
+blockwise ring attention (Liu et al. 2023 style): Q/K/V are sharded along the
+*sequence* dimension across a mesh axis; each device holds one Q block and the
+K/V blocks rotate around the ring via ``ppermute`` while a numerically-stable
+online-softmax accumulator folds in one block per step.  Peak memory per chip
+is O(T/n) and the K/V transfers overlap compute around the ICI ring.
+
+Layout: [B, H, T, D] with T sharded on ``axis``. Causal masking uses global
+positions derived from the device's ring index.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attn(q, k, v, mask_bias, m_prev, l_prev, acc_prev, scale):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    q: [B,H,Tq,D], k/v: [B,H,Tk,D], mask_bias: [B?,1,Tq,Tk] additive (-inf to
+    mask), accumulators: m [B,H,Tq,1], l [B,H,Tq,1], acc [B,H,Tq,D].
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    s = s + mask_bias
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (m_new = -inf): shift by 0 there
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - m_safe, -jnp.inf))
+    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = corr * acc_prev + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(p.dtype), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Inside-shard_map ring attention. q/k/v local blocks [B,H,Tl,D];
+    sequence is sharded over ``axis_name``. Returns local output block."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    scale = scale if scale is not None else (1.0 / (D ** 0.5))
+    qf = q.astype(jnp.float32)
+
+    q_pos = idx * Tl + jnp.arange(Tl)  # global positions of local q rows
+
+    m0 = jnp.full((B, H, Tl, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        # k_blk originated on device (idx - t) mod n
+        src = (idx - t) % n
+        k_pos = src * Tl + jnp.arange(Tl)
+        if causal:
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        else:
+            bias = jnp.zeros((Tl, Tl), jnp.float32)
+        bias = bias[None, None, :, :]
+        m, l, acc = _block_attn(qf, k_blk.astype(jnp.float32),
+                                v_blk.astype(jnp.float32), bias, m, l, acc, scale)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (k_fin, v_fin, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, a0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+                           causal: bool = False):
+    """User entry: q/k/v global [B,H,T,D]; runs ring attention with T sharded
+    over ``mesh`` axis ``seq_axis`` via shard_map."""
+    spec = P(None, None, seq_axis, None)
+
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )
+    return mapped(q, k, v)

@@ -1,0 +1,80 @@
+"""Sharding rules — the SPMD replacement for the reference's parallel tiers.
+
+What the reference does with explicit machinery, this framework does with
+sharding annotations compiled by XLA GSPMD (SURVEY.md §5.8):
+
+- MultiGradientMachine per-GPU threads + ring grad scatter/gather
+  (gserver/gradientmachines/MultiGradientMachine.h:44-94) -> batch sharded
+  over the 'data' mesh axis; XLA inserts the gradient all-reduce over ICI.
+- ParallelNeuralNetwork per-layer device pinning (ParallelNeuralNetwork.h:34)
+  -> parameter PartitionSpecs over the 'model' axis (tensor parallelism —
+  strictly more general than layer pinning).
+- pserver block-sharded parameter store (pserver/ParameterServer2.h:147-166)
+  -> parameters simply *live* sharded on the mesh; there is no separate
+  parameter tier to talk to.
+
+``ShardingRules`` maps param-name glob patterns to PartitionSpecs; apply to a
+params pytree to get NamedShardings for device_put / jit in_shardings.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "replicated", "batch_sharding", "shard_params", "P"]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``; replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+class ShardingRules:
+    """Ordered (pattern, PartitionSpec) rules; first match wins.
+
+    Patterns are fnmatch globs over parameter names, e.g.::
+
+        rules = ShardingRules([
+            ("*emb*",   P(None, "model")),   # embedding: shard feature dim
+            ("*out_w",  P(None, "model")),   # readout: shard vocab dim
+            ("*_wx",    P(None, "model")),   # input projections: column-wise
+            ("*",       P()),                # everything else replicated
+        ])
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]):
+        self.rules = list(rules)
+
+    def spec_for(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if fnmatch.fnmatch(name, pat):
+                if len(spec) > ndim:
+                    return P(*spec[:ndim])
+                return spec
+        return P()
+
+    def shardings(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, NamedSharding]:
+        out = {}
+        for name, p in params.items():
+            ndim = getattr(p, "ndim", 0)
+            out[name] = NamedSharding(mesh, self.spec_for(name, ndim))
+        return out
+
+
+def shard_params(mesh: Mesh, params: Dict[str, Any],
+                 rules: Optional[ShardingRules] = None) -> Dict[str, Any]:
+    """device_put every param to its (rule-derived or replicated) sharding."""
+    if rules is None:
+        repl = replicated(mesh)
+        return {k: jax.device_put(v, repl) for k, v in params.items()}
+    sh = rules.shardings(mesh, params)
+    return {k: jax.device_put(v, sh[k]) for k, v in params.items()}

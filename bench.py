@@ -1,0 +1,109 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Benchmarks the reference's published RNN benchmark config on this framework:
+2-layer LSTM text classifier, hidden 256, batch 64, seq len 100, vocab 30k
+(reference: benchmark/paddle/rnn/rnn.py + benchmark/README.md:112-119 —
+83 ms/batch on 1x Tesla K40m).  The full train step (fwd + bwd + Adam update)
+runs on one TPU chip; ``iters`` steps are chained inside a single jitted
+``lax.fori_loop`` so host<->device round-trip latency (large through the
+remote tunnel, where block_until_ready does not synchronize) is amortized and
+subtracted via a null-program calibration.
+
+value = ms/batch (lower is better); vs_baseline = 83 / value (speedup x).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _fetch(x) -> float:
+    """Force a device->host sync (block_until_ready is async on the tunnel)."""
+    return float(np.asarray(x).ravel()[0])
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import lstm_benchmark_net
+    from paddle_tpu.param.optimizers import Adam
+
+    VOCAB, B, T, HID = 30000, 64, 100, 256
+    nn.reset_naming()
+    cost, _ = lstm_benchmark_net(VOCAB, emb_dim=128, hid_dim=HID, num_layers=2)
+    topo = nn.Topology(cost)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    opt = Adam(learning_rate=1e-3)
+    opt_state = opt.init_state(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(3, VOCAB, (B, T)).astype(np.int32))
+    lengths = jnp.asarray(rng.randint(T // 2, T + 1, B).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (B, 1)))
+    feed = {"words": (ids, lengths), "label": labels}
+
+    def one_step(carry):
+        params, state, opt_state = carry
+
+        def loss_fn(p):
+            outs, new_state = topo.apply(p, state, feed, train=True,
+                                         rng=jax.random.PRNGKey(0))
+            return outs[cost.name].value, new_state
+
+        (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = opt.update(params, grads, opt_state)
+        return (new_params, new_state, new_opt), loss
+
+    ITERS = 50
+
+    @jax.jit
+    def run_chain(params, state, opt_state):
+        def body(i, c):
+            c2, loss = one_step(c)
+            return c2
+        params, state, opt_state = jax.lax.fori_loop(
+            0, ITERS, body, (params, state, opt_state))
+        _, loss = one_step((params, state, opt_state))
+        return loss
+
+    @jax.jit
+    def null_prog(x):
+        return x + 1.0
+
+    # compile both
+    _fetch(run_chain(params, state, opt_state))
+    _fetch(null_prog(jnp.zeros(())))
+
+    # calibrate round-trip overhead
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _fetch(null_prog(jnp.zeros(())))
+        rtts.append(time.perf_counter() - t0)
+    rtt = float(np.median(rtts))
+
+    reps = 3
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _fetch(run_chain(params, state, opt_state))
+        times.append(time.perf_counter() - t0)
+    total = float(np.median(times))
+    ms = max(total - rtt, 1e-9) / (ITERS + 1) * 1e3
+
+    baseline_ms = 83.0
+    print(json.dumps({
+        "metric": "lstm_textclf_train_ms_per_batch(b64,h256,T100,vocab30k)",
+        "value": round(ms, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(baseline_ms / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,458 @@
+"""Evaluators — analog of the reference's metric framework.
+
+Reference: 14 registered evaluator types accumulated across batches and
+printed per pass (paddle/gserver/evaluators/Evaluator.cpp:995-1046 —
+classification_error :46, sum :503, column_sum :584, rankauc, auc :862,
+precision_recall, pnpair; ChunkEvaluator.cpp; CTCErrorEvaluator.cpp; printer
+evaluators) driven by Evaluator::start/eval/finish.
+
+TPU-native split: the *per-batch statistic* is a pure jnp function (can run
+inside the jitted step and on sharded data — a psum away from global); the
+*accumulation* across batches is a tiny host-side state machine.  Each
+evaluator implements ``batch_stats(**kw) -> dict of arrays`` (pure) and
+``update(stats)`` / ``result()`` (host).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+try:  # jnp only needed for the pure parts; numpy fallback keeps host tools light
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jnp = np
+
+from paddle_tpu.utils.registry import Registry
+
+__all__ = [
+    "EVALUATORS",
+    "Evaluator",
+    "ClassificationError",
+    "SumEvaluator",
+    "ColumnSumEvaluator",
+    "Auc",
+    "RankAuc",
+    "PrecisionRecall",
+    "PnpairEvaluator",
+    "ChunkEvaluator",
+    "CTCErrorEvaluator",
+    "SeqClassificationError",
+    "ValuePrinter",
+    "GradientPrinter",
+    "MaxIdPrinter",
+    "MaxFramePrinter",
+]
+
+EVALUATORS: Registry = Registry("evaluator")
+
+
+class Evaluator:
+    name = "evaluator"
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def batch_stats(self, **kw) -> Dict[str, Any]:
+        """Pure per-batch statistic(s); safe to call inside jit."""
+        raise NotImplementedError
+
+    def update(self, stats: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def result(self) -> float:
+        raise NotImplementedError
+
+    # convenience: one-shot eval on host arrays
+    def eval_batch(self, **kw) -> None:
+        self.update({k: np.asarray(v) for k, v in self.batch_stats(**kw).items()})
+
+
+@EVALUATORS.register("classification_error")
+class ClassificationError(Evaluator):
+    """Top-1 error rate (Evaluator.cpp ClassificationErrorEvaluator)."""
+
+    name = "classification_error"
+
+    def start(self):
+        self.err, self.total = 0.0, 0.0
+
+    def batch_stats(self, *, logits, labels, mask=None):
+        pred = jnp.argmax(logits, axis=-1)
+        labels = labels.reshape(pred.shape)
+        wrong = (pred != labels).astype(jnp.float32)
+        if mask is not None:
+            wrong = wrong * mask
+            return {"err": jnp.sum(wrong), "total": jnp.sum(mask)}
+        return {"err": jnp.sum(wrong), "total": jnp.asarray(float(np.prod(pred.shape)))}
+
+    def update(self, s):
+        self.err += float(s["err"])
+        self.total += float(s["total"])
+
+    def result(self):
+        return self.err / max(self.total, 1.0)
+
+
+@EVALUATORS.register("sum")
+class SumEvaluator(Evaluator):
+    name = "sum"
+
+    def start(self):
+        self.sum, self.n = 0.0, 0
+
+    def batch_stats(self, *, value, mask=None):
+        if mask is not None:
+            value = value * mask
+        return {"sum": jnp.sum(value)}
+
+    def update(self, s):
+        self.sum += float(s["sum"])
+        self.n += 1
+
+    def result(self):
+        return self.sum
+
+
+@EVALUATORS.register("column_sum")
+class ColumnSumEvaluator(Evaluator):
+    name = "column_sum"
+
+    def start(self):
+        self.sum = None
+        self.total = 0.0
+
+    def batch_stats(self, *, value):
+        return {"col": jnp.sum(value, axis=0), "n": jnp.asarray(float(value.shape[0]))}
+
+    def update(self, s):
+        col = np.asarray(s["col"])
+        self.sum = col if self.sum is None else self.sum + col
+        self.total += float(s["n"])
+
+    def result(self):
+        if self.sum is None:
+            return 0.0
+        return float(np.mean(self.sum / max(self.total, 1.0)))
+
+
+@EVALUATORS.register("auc")
+class Auc(Evaluator):
+    """ROC AUC via fixed binning (the reference uses the same trick to stay
+    streaming: AucEvaluator bins scores, Evaluator.cpp:862)."""
+
+    name = "auc"
+
+    def __init__(self, num_bins: int = 4096):
+        self.num_bins = num_bins
+
+    def start(self):
+        self.pos = np.zeros(self.num_bins)
+        self.neg = np.zeros(self.num_bins)
+
+    def batch_stats(self, *, prob, labels):
+        """prob: [B] or [B,2] (positive-class prob); labels: [B] in {0,1}."""
+        if prob.ndim == 2:
+            prob = prob[:, -1]
+        labels = labels.reshape(prob.shape)
+        idx = jnp.clip((prob * self.num_bins).astype(jnp.int32), 0, self.num_bins - 1)
+        pos = jnp.zeros(self.num_bins).at[idx].add(labels.astype(jnp.float32))
+        neg = jnp.zeros(self.num_bins).at[idx].add(1.0 - labels.astype(jnp.float32))
+        return {"pos": pos, "neg": neg}
+
+    def update(self, s):
+        self.pos += np.asarray(s["pos"])
+        self.neg += np.asarray(s["neg"])
+
+    def result(self):
+        # sum over bins high->low of TPR/FPR trapezoid
+        pos = self.pos[::-1]
+        neg = self.neg[::-1]
+        tp = np.cumsum(pos)
+        fp = np.cumsum(neg)
+        P, N = tp[-1], fp[-1]
+        if P == 0 or N == 0:
+            return 0.5
+        tpr = np.concatenate([[0.0], tp / P])
+        fpr = np.concatenate([[0.0], fp / N])
+        return float(np.trapezoid(tpr, fpr))
+
+
+@EVALUATORS.register("rankauc")
+class RankAuc(Evaluator):
+    """Pairwise ranking AUC on (score, label) lists (RankAucEvaluator)."""
+
+    name = "rankauc"
+
+    def start(self):
+        self.concordant, self.pairs = 0.0, 0.0
+
+    def batch_stats(self, *, score, labels):
+        s = score.reshape(-1)
+        y = labels.reshape(-1).astype(jnp.float32)
+        ds = s[:, None] - s[None, :]
+        dy = y[:, None] - y[None, :]
+        valid = dy > 0
+        conc = jnp.sum(((ds > 0) & valid).astype(jnp.float32))
+        ties = 0.5 * jnp.sum(((ds == 0) & valid).astype(jnp.float32))
+        return {"conc": conc + ties, "pairs": jnp.sum(valid.astype(jnp.float32))}
+
+    def update(self, st):
+        self.concordant += float(st["conc"])
+        self.pairs += float(st["pairs"])
+
+    def result(self):
+        return self.concordant / max(self.pairs, 1.0)
+
+
+@EVALUATORS.register("precision_recall")
+class PrecisionRecall(Evaluator):
+    """Per-class precision/recall/F1 (PrecisionRecallEvaluator)."""
+
+    name = "precision_recall"
+
+    def __init__(self, num_classes: int = 2, positive_label: Optional[int] = None):
+        self.num_classes = num_classes
+        self.positive_label = positive_label
+
+    def start(self):
+        self.tp = np.zeros(self.num_classes)
+        self.fp = np.zeros(self.num_classes)
+        self.fn = np.zeros(self.num_classes)
+
+    def batch_stats(self, *, logits, labels):
+        pred = jnp.argmax(logits, axis=-1).reshape(-1)
+        lab = labels.reshape(-1)
+        C = self.num_classes
+        oh_p = jnp.eye(C)[pred]
+        oh_l = jnp.eye(C)[lab]
+        tp = jnp.sum(oh_p * oh_l, axis=0)
+        return {"tp": tp, "fp": jnp.sum(oh_p, 0) - tp, "fn": jnp.sum(oh_l, 0) - tp}
+
+    def update(self, s):
+        self.tp += np.asarray(s["tp"])
+        self.fp += np.asarray(s["fp"])
+        self.fn += np.asarray(s["fn"])
+
+    def result(self):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1.0)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1.0)
+        f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+        if self.positive_label is not None:
+            return float(f1[self.positive_label])
+        return float(np.mean(f1))
+
+    def detail(self):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1.0)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1.0)
+        return {"precision": prec, "recall": rec}
+
+
+@EVALUATORS.register("pnpair")
+class PnpairEvaluator(Evaluator):
+    """Positive/negative pair ratio grouped by query (PnpairEvaluator):
+    for each query id, counts concordant score pairs between pos & neg."""
+
+    name = "pnpair"
+
+    def start(self):
+        self.rows: List[np.ndarray] = []
+
+    def batch_stats(self, *, score, labels, query_id):
+        return {"score": score.reshape(-1), "labels": labels.reshape(-1),
+                "qid": query_id.reshape(-1)}
+
+    def update(self, s):
+        self.rows.append(np.stack([
+            np.asarray(s["score"], np.float64),
+            np.asarray(s["labels"], np.float64),
+            np.asarray(s["qid"], np.float64),
+        ], 1))
+
+    def result(self):
+        if not self.rows:
+            return 0.0
+        data = np.concatenate(self.rows, 0)
+        better = worse = ties = 0.0
+        for q in np.unique(data[:, 2]):
+            rows = data[data[:, 2] == q]
+            for i in range(len(rows)):
+                for j in range(len(rows)):
+                    if rows[i, 1] > rows[j, 1]:
+                        if rows[i, 0] > rows[j, 0]:
+                            better += 1
+                        elif rows[i, 0] < rows[j, 0]:
+                            worse += 1
+                        else:
+                            ties += 1
+        return (better + 0.5 * ties) / max(better + worse + ties, 1.0)
+
+
+@EVALUATORS.register("seq_classification_error")
+class SeqClassificationError(ClassificationError):
+    """Sequence-level error: a sequence counts wrong if ANY token is wrong
+    (SequenceClassificationErrorEvaluator)."""
+
+    name = "seq_classification_error"
+
+    def batch_stats(self, *, logits, labels, mask):
+        pred = jnp.argmax(logits, axis=-1)
+        wrong_tok = (pred != labels).astype(jnp.float32) * mask
+        seq_wrong = (jnp.sum(wrong_tok, axis=1) > 0).astype(jnp.float32)
+        return {"err": jnp.sum(seq_wrong), "total": jnp.asarray(float(pred.shape[0]))}
+
+
+def _extract_chunks(tags: np.ndarray, scheme: str = "IOB") -> set:
+    """Decode chunk spans from an IOB tag sequence: tag 2k = B-type k,
+    2k+1 = I-type k, last id = O (the ChunkEvaluator convention)."""
+    chunks = set()
+    # convention: num_chunk_types*2 tags (B-k=2k, I-k=2k+1) then O = max id
+    O = int(max(tags.max(initial=0), 0))
+    start = ctype = None
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t == O or t < 0:
+            if start is not None:
+                chunks.add((start, i - 1, ctype))
+                start = ctype = None
+            continue
+        typ, is_inside = t // 2, (t % 2 == 1)
+        if not is_inside:  # B- tag
+            if start is not None:
+                chunks.add((start, i - 1, ctype))
+            start, ctype = i, typ
+        else:  # I- tag
+            if start is None or ctype != typ:
+                if start is not None:
+                    chunks.add((start, i - 1, ctype))
+                start, ctype = i, typ
+    if start is not None:
+        chunks.add((start, len(tags) - 1, ctype))
+    return chunks
+
+
+@EVALUATORS.register("chunk")
+class ChunkEvaluator(Evaluator):
+    """Chunking F1 over IOB tag sequences (ChunkEvaluator.cpp) — host-side
+    decode (string-ish logic has no place on the MXU)."""
+
+    name = "chunk"
+
+    def start(self):
+        self.correct = self.pred = self.label = 0.0
+
+    def batch_stats(self, *, pred_tags, label_tags, lengths):
+        return {"pred_tags": pred_tags, "label_tags": label_tags, "lengths": lengths}
+
+    def update(self, s):
+        preds = np.asarray(s["pred_tags"])
+        labs = np.asarray(s["label_tags"])
+        lens = np.asarray(s["lengths"])
+        for i in range(preds.shape[0]):
+            L = int(lens[i])
+            pc = _extract_chunks(preds[i, :L])
+            lc = _extract_chunks(labs[i, :L])
+            self.correct += len(pc & lc)
+            self.pred += len(pc)
+            self.label += len(lc)
+
+    def result(self):
+        p = self.correct / max(self.pred, 1.0)
+        r = self.correct / max(self.label, 1.0)
+        return 2 * p * r / max(p + r, 1e-12)
+
+
+def _edit_distance(a, b) -> int:
+    la, lb = len(a), len(b)
+    dp = list(range(lb + 1))
+    for i in range(1, la + 1):
+        prev = dp[0]
+        dp[0] = i
+        for j in range(1, lb + 1):
+            cur = dp[j]
+            dp[j] = min(dp[j] + 1, dp[j - 1] + 1, prev + (a[i - 1] != b[j - 1]))
+            prev = cur
+    return dp[lb]
+
+
+@EVALUATORS.register("ctc_edit_distance")
+class CTCErrorEvaluator(Evaluator):
+    """Edit-distance error rate after CTC best-path collapse
+    (CTCErrorEvaluator.cpp)."""
+
+    name = "ctc_edit_distance"
+
+    def __init__(self, blank: int = 0):
+        self.blank = blank
+
+    def start(self):
+        self.dist = self.total = 0.0
+
+    def batch_stats(self, *, log_probs, labels, in_lengths, label_lengths):
+        return {"path": jnp.argmax(log_probs, axis=-1), "labels": labels,
+                "in_lengths": in_lengths, "label_lengths": label_lengths}
+
+    def update(self, s):
+        paths = np.asarray(s["path"])
+        labels = np.asarray(s["labels"])
+        in_lens = np.asarray(s["in_lengths"])
+        lab_lens = np.asarray(s["label_lengths"])
+        for i in range(paths.shape[0]):
+            raw = paths[i, : int(in_lens[i])]
+            collapsed = []
+            prev = None
+            for t in raw:
+                if t != self.blank and t != prev:
+                    collapsed.append(int(t))
+                prev = t
+            ref = [int(x) for x in labels[i, : int(lab_lens[i])]]
+            self.dist += _edit_distance(collapsed, ref)
+            self.total += max(len(ref), 1)
+
+    def result(self):
+        return self.dist / max(self.total, 1.0)
+
+
+class _Printer(Evaluator):
+    def start(self):
+        self.lines: List[str] = []
+
+    def update(self, s):
+        self.lines.append(str({k: np.asarray(v) for k, v in s.items()}))
+
+    def result(self):
+        return float(len(self.lines))
+
+
+@EVALUATORS.register("value_printer")
+class ValuePrinter(_Printer):
+    name = "value_printer"
+
+    def batch_stats(self, *, value):
+        return {"value": value}
+
+
+@EVALUATORS.register("gradient_printer")
+class GradientPrinter(_Printer):
+    name = "gradient_printer"
+
+    def batch_stats(self, *, grad):
+        return {"grad": grad}
+
+
+@EVALUATORS.register("maxid_printer")
+class MaxIdPrinter(_Printer):
+    name = "maxid_printer"
+
+    def batch_stats(self, *, logits):
+        return {"maxid": jnp.argmax(logits, -1)}
+
+
+@EVALUATORS.register("maxframe_printer")
+class MaxFramePrinter(_Printer):
+    name = "maxframe_printer"
+
+    def batch_stats(self, *, value):
+        return {"frame": jnp.argmax(jnp.linalg.norm(value, axis=-1), axis=-1)}

@@ -1,0 +1,138 @@
+"""Evaluator tests vs hand-computed/sklearn-style references."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.evaluators as E
+
+
+def test_registry_has_reference_set():
+    expect = {"classification_error", "sum", "column_sum", "auc", "rankauc",
+              "precision_recall", "pnpair", "chunk", "ctc_edit_distance",
+              "seq_classification_error", "value_printer", "gradient_printer",
+              "maxid_printer", "maxframe_printer"}
+    assert expect <= set(E.EVALUATORS.names())
+
+
+def test_classification_error(rng):
+    ev = E.ClassificationError()
+    ev.start()
+    logits = np.zeros((4, 3), np.float32)
+    logits[np.arange(4), [0, 1, 2, 0]] = 1.0
+    labels = np.array([0, 1, 0, 0])  # one wrong
+    ev.eval_batch(logits=jnp.asarray(logits), labels=jnp.asarray(labels))
+    assert ev.result() == pytest.approx(0.25)
+
+
+def test_classification_error_masked():
+    ev = E.ClassificationError()
+    ev.start()
+    logits = np.zeros((1, 4, 2), np.float32)
+    logits[0, :, 1] = 1.0  # predict 1 everywhere
+    labels = np.array([[1, 0, 1, 0]])
+    mask = np.array([[1, 1, 1, 0]], np.float32)
+    ev.eval_batch(logits=jnp.asarray(logits), labels=jnp.asarray(labels),
+                  mask=jnp.asarray(mask))
+    assert ev.result() == pytest.approx(1 / 3)
+
+
+def test_auc_perfect_and_random(rng):
+    ev = E.Auc()
+    ev.start()
+    prob = np.concatenate([rng.rand(500) * 0.4, 0.6 + rng.rand(500) * 0.4])
+    labels = np.concatenate([np.zeros(500), np.ones(500)])
+    ev.eval_batch(prob=jnp.asarray(prob), labels=jnp.asarray(labels))
+    assert ev.result() > 0.99
+    ev2 = E.Auc()
+    ev2.start()
+    prob = rng.rand(2000)
+    labels = (rng.rand(2000) > 0.5).astype(np.float32)
+    ev2.eval_batch(prob=jnp.asarray(prob), labels=jnp.asarray(labels))
+    assert 0.45 < ev2.result() < 0.55
+
+
+def test_rankauc():
+    ev = E.RankAuc()
+    ev.start()
+    ev.eval_batch(score=jnp.asarray([0.1, 0.5, 0.9]), labels=jnp.asarray([0, 1, 1]))
+    assert ev.result() == pytest.approx(1.0)
+
+
+def test_precision_recall():
+    ev = E.PrecisionRecall(num_classes=2, positive_label=1)
+    ev.start()
+    logits = np.zeros((6, 2), np.float32)
+    logits[:4, 1] = 1.0  # predict 1 for first four
+    logits[4:, 0] = 1.0
+    labels = np.array([1, 1, 1, 0, 0, 1])
+    ev.eval_batch(logits=jnp.asarray(logits), labels=jnp.asarray(labels))
+    d = ev.detail()
+    assert d["precision"][1] == pytest.approx(3 / 4)
+    assert d["recall"][1] == pytest.approx(3 / 4)
+
+
+def test_pnpair():
+    ev = E.PnpairEvaluator()
+    ev.start()
+    ev.eval_batch(score=jnp.asarray([0.9, 0.1, 0.8, 0.2]),
+                  labels=jnp.asarray([1, 0, 0, 1]),
+                  query_id=jnp.asarray([0, 0, 1, 1]))
+    # q0 concordant, q1 discordant
+    assert ev.result() == pytest.approx(0.5)
+
+
+def test_chunk_evaluator():
+    ev = E.ChunkEvaluator()
+    ev.start()
+    # tags: B-0=0, I-0=1, O=2
+    label = np.array([[0, 1, 2, 0, 2]])
+    pred_perfect = label.copy()
+    ev.eval_batch(pred_tags=pred_perfect, label_tags=label, lengths=np.array([5]))
+    assert ev.result() == pytest.approx(1.0)
+    ev.start()
+    pred_half = np.array([[0, 1, 2, 2, 2]])  # misses second chunk
+    ev.eval_batch(pred_tags=pred_half, label_tags=label, lengths=np.array([5]))
+    p, r = 1.0, 0.5
+    assert ev.result() == pytest.approx(2 * p * r / (p + r))
+
+
+def test_ctc_error():
+    ev = E.CTCErrorEvaluator(blank=0)
+    ev.start()
+    # path: 0 1 1 0 2 -> collapse -> [1, 2]; ref [1, 2] -> 0 errors
+    lp = np.full((1, 5, 4), -5.0, np.float32)
+    for t, c in enumerate([0, 1, 1, 0, 2]):
+        lp[0, t, c] = 0.0
+    ev.eval_batch(log_probs=jnp.asarray(lp), labels=np.array([[1, 2]]),
+                  in_lengths=np.array([5]), label_lengths=np.array([2]))
+    assert ev.result() == pytest.approx(0.0)
+    ev.start()
+    ev.eval_batch(log_probs=jnp.asarray(lp), labels=np.array([[1, 3]]),
+                  in_lengths=np.array([5]), label_lengths=np.array([2]))
+    assert ev.result() == pytest.approx(0.5)
+
+
+def test_seq_classification_error():
+    ev = E.SeqClassificationError()
+    ev.start()
+    logits = np.zeros((2, 3, 2), np.float32)
+    logits[:, :, 0] = 1.0  # predict 0 everywhere
+    labels = np.array([[0, 0, 0], [0, 1, 0]])
+    mask = np.ones((2, 3), np.float32)
+    ev.eval_batch(logits=jnp.asarray(logits), labels=jnp.asarray(labels),
+                  mask=jnp.asarray(mask))
+    assert ev.result() == pytest.approx(0.5)
+
+
+def test_printers():
+    for cls, kw in [
+        (E.ValuePrinter, {"value": jnp.ones((2, 2))}),
+        (E.GradientPrinter, {"grad": jnp.ones((2, 2))}),
+        (E.MaxIdPrinter, {"logits": jnp.ones((2, 3))}),
+        (E.MaxFramePrinter, {"value": jnp.ones((2, 3, 4))}),
+    ]:
+        ev = cls()
+        ev.start()
+        ev.eval_batch(**kw)
+        assert ev.result() == 1.0 and ev.lines

@@ -14,4 +14,5 @@ from paddle_tpu.nn.graph import (
     reset_naming,
 )
 from paddle_tpu.nn.layers import *  # noqa: F401,F403
+from paddle_tpu.nn.layers_extra import *  # noqa: F401,F403
 from paddle_tpu.nn import layers as layer

@@ -1,0 +1,344 @@
+"""Structured-prediction and sampling layers: CRF, CTC, NCE, hsigmoid,
+sampling/multiplex/pad/rotate utility layers — the rest of the reference's
+layer inventory (SURVEY.md §2 item 26)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops as O
+from paddle_tpu.ops.crf import crf_decode, crf_nll
+from paddle_tpu.ops.ctc import ctc_loss
+from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_name
+
+__all__ = [
+    "crf_cost",
+    "crf_decoding",
+    "ctc_cost",
+    "nce_cost",
+    "hsigmoid_cost",
+    "sampling_id",
+    "multiplex",
+    "pad",
+    "rotate",
+    "featmap_expand",
+    "block_expand",
+    "sub_seq",
+    "seq_reshape",
+    "eos_trim",
+]
+
+
+# ---------------------------------------------------------------------------
+# CRF (CRFLayer.cpp / CRFDecodingLayer.cpp)
+# ---------------------------------------------------------------------------
+
+
+def _crf_specs(name: str, C: int):
+    mk = lambda suffix, shape: ParamSpec(
+        name=f"_{name}.{suffix}", shape=shape,
+        attr=ParamAttr(name=f"_{name}.{suffix}", init="zeros"),
+    )
+    return mk("start", (C,)), mk("end", (C,)), mk("trans", (C, C))
+
+
+def crf_cost(input: LayerOutput, label: LayerOutput, *, size: Optional[int] = None,
+             name: Optional[str] = None, param_attr=None) -> LayerOutput:
+    """Linear-chain CRF NLL over a tag sequence. ``input``: per-step emission
+    logits [B,T,C] (sequence), ``label``: int tags [B,T]."""
+    name = name or next_name("crf_cost")
+    C = size or input.size
+    s_start, s_end, s_trans = _crf_specs(name, C)
+
+    def forward(ctx, params, emis: Act, lab: Act) -> Act:
+        nll = crf_nll(emis.value, lab.value, emis.mask,
+                      params[s_start.name], params[s_end.name], params[s_trans.name])
+        return Act(value=nll)
+
+    return LayerOutput(name, "crf_cost", 1, [input, label], forward,
+                       [s_start, s_end, s_trans])
+
+
+def crf_decoding(input: LayerOutput, *, size: Optional[int] = None,
+                 name: Optional[str] = None, share_with: Optional[str] = None) -> LayerOutput:
+    """Viterbi decode; shares CRF params with a ``crf_cost`` layer when
+    ``share_with`` gives that layer's name."""
+    name = name or next_name("crf_decoding")
+    C = size or input.size
+    base = share_with or name
+    s_start, s_end, s_trans = _crf_specs(base, C)
+
+    def forward(ctx, params, emis: Act) -> Act:
+        tags, score = crf_decode(emis.value, emis.mask,
+                                 params[s_start.name], params[s_end.name],
+                                 params[s_trans.name])
+        return Act(value=tags, lengths=emis.lengths, mask=emis.mask,
+                   state={"score": score})
+
+    return LayerOutput(name, "crf_decoding", 1, [input], forward,
+                       [s_start, s_end, s_trans])
+
+
+# ---------------------------------------------------------------------------
+# CTC (CTCLayer.cpp / WarpCTCLayer.cpp)
+# ---------------------------------------------------------------------------
+
+
+def ctc_cost(input: LayerOutput, label: LayerOutput, *, blank: int = 0,
+             norm_by_times: bool = False, name: Optional[str] = None) -> LayerOutput:
+    """CTC NLL. ``input``: per-step class logits [B,T,C] (sequence, linear
+    act); ``label``: int label sequence [B,L] with its own lengths."""
+    name = name or next_name("ctc_cost")
+
+    def forward(ctx, params, logits: Act, lab: Act) -> Act:
+        lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)
+        in_len = logits.lengths
+        lab_len = lab.lengths
+        losses = ctc_loss(lp, lab.value, in_len, lab_len, blank=blank,
+                          norm_by_times=norm_by_times)
+        return Act(value=jnp.mean(losses))
+
+    return LayerOutput(name, "ctc_cost", 1, [input, label], forward, [])
+
+
+# ---------------------------------------------------------------------------
+# NCE (NCELayer.cpp) and hierarchical sigmoid (HierarchicalSigmoidLayer.cpp)
+# ---------------------------------------------------------------------------
+
+
+def nce_cost(input: LayerOutput, label: LayerOutput, *, num_classes: int,
+             num_neg_samples: int = 10, name: Optional[str] = None,
+             param_attr=None) -> LayerOutput:
+    """Noise-contrastive estimation cost over a big softmax
+    (gserver/layers/NCELayer.cpp; layers.py:4926 nce_layer).  Uniform noise
+    distribution; samples drawn fresh per batch from the framework RNG."""
+    name = name or next_name("nce")
+    D = input.size
+    wspec = ParamSpec(name=f"_{name}.w0", shape=(num_classes, D),
+                      attr=ParamAttr(name=f"_{name}.w0"))
+    bspec = ParamSpec(name=f"_{name}.wbias", shape=(num_classes,),
+                      attr=ParamAttr(name=f"_{name}.wbias", init="zeros"))
+
+    def forward(ctx, params, feat: Act, lab: Act) -> Act:
+        x = feat.value  # [B, D]
+        B = x.shape[0]
+        y = lab.value.reshape(B)
+        k = num_neg_samples
+        noise = jax.random.randint(ctx.next_rng(), (B, k), 0, num_classes)
+        # logit(class c) = x @ w[c] + b[c]; noise log-prob uniform = -log(C)
+        ln_noise = -jnp.log(float(num_classes))
+
+        def score(classes):
+            w = jnp.take(params[wspec.name], classes, axis=0)  # [..., D]
+            b = jnp.take(params[bspec.name], classes)
+            return jnp.einsum("bd,b...d->b...", x, w) + b
+
+        pos_logit = score(y[:, None])[:, 0] - (jnp.log(float(k)) + ln_noise)
+        neg_logit = score(noise) - (jnp.log(float(k)) + ln_noise)
+        pos_loss = O.binary_cross_entropy(pos_logit, jnp.ones_like(pos_logit))
+        neg_loss = O.binary_cross_entropy(neg_logit, jnp.zeros_like(neg_logit))
+        return Act(value=jnp.mean(pos_loss + jnp.sum(neg_loss, axis=-1)))
+
+    return LayerOutput(name, "nce_cost", 1, [input, label], forward, [wspec, bspec])
+
+
+def hsigmoid_cost(input: LayerOutput, label: LayerOutput, *, num_classes: int,
+                  name: Optional[str] = None) -> LayerOutput:
+    """Hierarchical sigmoid over an implicit balanced binary tree
+    (HierarchicalSigmoidLayer.cpp; layers.py hsigmoid).  Internal nodes are
+    addressed heap-style; class id bits choose left/right."""
+    name = name or next_name("hsigmoid")
+    D = input.size
+    depth = max(int(jnp.ceil(jnp.log2(max(num_classes, 2)))), 1)
+    n_internal = 2 ** depth - 1
+    wspec = ParamSpec(name=f"_{name}.w0", shape=(n_internal, D),
+                      attr=ParamAttr(name=f"_{name}.w0"))
+    bspec = ParamSpec(name=f"_{name}.wbias", shape=(n_internal,),
+                      attr=ParamAttr(name=f"_{name}.wbias", init="zeros"))
+
+    def forward(ctx, params, feat: Act, lab: Act) -> Act:
+        x = feat.value
+        B = x.shape[0]
+        y = lab.value.reshape(B).astype(jnp.int32)
+        # path: leaf id y + 2^depth viewed as heap index; ancestors = idx>>1...
+        idx = y + (1 << depth)
+        losses = jnp.zeros((B,), jnp.float32)
+        for level in range(depth):
+            child = idx >> level
+            node = (child >> 1) - 1          # internal node heap index, 0-based
+            go_right = (child & 1).astype(jnp.float32)
+            w = jnp.take(params[wspec.name], node, axis=0)
+            b = jnp.take(params[bspec.name], node)
+            logit = jnp.sum(x * w, axis=-1) + b
+            losses = losses + O.binary_cross_entropy(logit, go_right)
+        return Act(value=jnp.mean(losses))
+
+    return LayerOutput(name, "hsigmoid_cost", 1, [input, label], forward,
+                       [wspec, bspec])
+
+
+# ---------------------------------------------------------------------------
+# utility layers
+# ---------------------------------------------------------------------------
+
+
+def sampling_id(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
+    """Sample an id from a softmax distribution per row (SamplingIdLayer —
+    used for stochastic generation)."""
+    name = name or next_name("sampling_id")
+
+    def forward(ctx, params, a: Act) -> Act:
+        ids = jax.random.categorical(ctx.next_rng(), a.value, axis=-1)
+        return Act(value=ids.astype(jnp.int32))
+
+    return LayerOutput(name, "sampling_id", 1, [input], forward, [])
+
+
+def multiplex(index: LayerOutput, inputs: Sequence[LayerOutput], *,
+              name: Optional[str] = None) -> LayerOutput:
+    """Row-wise select among N inputs by integer index (MultiplexLayer)."""
+    name = name or next_name("multiplex")
+    ins = list(inputs)
+
+    def forward(ctx, params, idx: Act, *acts: Act) -> Act:
+        stacked = jnp.stack([a.value for a in acts], axis=1)  # [B, N, D]
+        sel = idx.value.reshape(-1)[:, None, None]
+        out = jnp.take_along_axis(stacked, sel, axis=1)[:, 0]
+        return Act(value=out)
+
+    return LayerOutput(name, "multiplex", ins[0].size, [index, *ins], forward, [])
+
+
+def pad(input: LayerOutput, *, pad_h=(0, 0), pad_w=(0, 0), pad_c=(0, 0),
+        name: Optional[str] = None) -> LayerOutput:
+    """Zero-pad NHWC image tensor (PadLayer / function/Pad)."""
+    name = name or next_name("pad")
+
+    def forward(ctx, params, a: Act) -> Act:
+        return Act(value=jnp.pad(a.value, ((0, 0), tuple(pad_h), tuple(pad_w),
+                                           tuple(pad_c))))
+
+    node = LayerOutput(name, "pad", input.size + pad_c[0] + pad_c[1], [input],
+                       forward, [])
+    if "hw" in input.meta:
+        h, w = input.meta["hw"]
+        node.meta["hw"] = (h + pad_h[0] + pad_h[1], w + pad_w[0] + pad_w[1])
+    return node
+
+
+def rotate(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
+    """Rotate feature map 90 degrees (RotateLayer)."""
+    name = name or next_name("rotate")
+
+    def forward(ctx, params, a: Act) -> Act:
+        return Act(value=jnp.rot90(a.value, k=1, axes=(1, 2)))
+
+    node = LayerOutput(name, "rotate", input.size, [input], forward, [])
+    if "hw" in input.meta:
+        h, w = input.meta["hw"]
+        node.meta["hw"] = (w, h)
+    return node
+
+
+def featmap_expand(input: LayerOutput, *, num_filters: int,
+                   name: Optional[str] = None) -> LayerOutput:
+    """Tile a feature map across new channels (FeatureMapExpandLayer)."""
+    name = name or next_name("featmap_expand")
+
+    def forward(ctx, params, a: Act) -> Act:
+        return Act(value=jnp.repeat(a.value, num_filters, axis=-1))
+
+    node = LayerOutput(name, "featmap_expand", input.size * num_filters,
+                       [input], forward, [])
+    node.meta.update(input.meta)
+    return node
+
+
+def block_expand(input: LayerOutput, *, block_x: int, block_y: int,
+                 stride_x: int, stride_y: int, name: Optional[str] = None) -> LayerOutput:
+    """im2col into a sequence of patches (BlockExpandLayer): NHWC image ->
+    sequence [B, n_blocks, block_y*block_x*C] with full-length mask."""
+    name = name or next_name("block_expand")
+    h, w = input.meta.get("hw", (None, None))
+    C = input.size
+    oh = (h - block_y) // stride_y + 1
+    ow = (w - block_x) // stride_x + 1
+
+    def forward(ctx, params, a: Act) -> Act:
+        x = a.value
+        B = x.shape[0]
+        patches = jax.lax.conv_general_dilated_patches(
+            jnp.moveaxis(x, -1, 1), (block_y, block_x), (stride_y, stride_x),
+            "VALID",
+        )  # [B, C*by*bx, oh, ow]
+        seq = patches.reshape(B, -1, oh * ow)
+        seq = jnp.moveaxis(seq, 1, 2)  # [B, n_blocks, C*by*bx]
+        n = oh * ow
+        lengths = jnp.full((B,), n, jnp.int32)
+        return Act(value=seq, lengths=lengths,
+                   mask=jnp.ones((B, n), jnp.float32))
+
+    return LayerOutput(name, "block_expand", C * block_x * block_y,
+                       [input], forward, [])
+
+
+def sub_seq(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput, *,
+            name: Optional[str] = None) -> LayerOutput:
+    """Per-row subsequence [offset, offset+size) repadded (SubSequenceLayer)."""
+    name = name or next_name("sub_seq")
+
+    def forward(ctx, params, a: Act, off: Act, sz: Act) -> Act:
+        T = a.value.shape[1]
+        o = off.value.reshape(-1).astype(jnp.int32)
+        s = sz.value.reshape(-1).astype(jnp.int32)
+        out = O.sequence.seq_slice_window(a.value, o, T) if False else None
+        # gather window of full T then mask to size
+        pos = o[:, None] + jnp.arange(T)[None, :]
+        pos_c = jnp.clip(pos, 0, T - 1)
+        v = jnp.take_along_axis(a.value, pos_c[..., None], axis=1)
+        mask = (jnp.arange(T)[None, :] < s[:, None]).astype(jnp.float32)
+        return Act(value=v * mask[..., None], lengths=s, mask=mask)
+
+    return LayerOutput(name, "sub_seq", input.size, [input, offsets, sizes],
+                       forward, [])
+
+
+def seq_reshape(input: LayerOutput, reshape_size: int, *,
+                name: Optional[str] = None) -> LayerOutput:
+    """Reshape [B,T,D] -> [B, T*D/reshape, reshape] (SequenceReshapeLayer);
+    only valid when every row is full-length (checked against mask upstream)."""
+    name = name or next_name("seq_reshape")
+
+    def forward(ctx, params, a: Act) -> Act:
+        B, T, D = a.value.shape
+        T2 = T * D // reshape_size
+        v = a.value.reshape(B, T2, reshape_size)
+        factor = D / reshape_size
+        lengths = (a.lengths.astype(jnp.float32) * factor).astype(jnp.int32)
+        mask = O.mask_from_lengths(lengths, T2)
+        return Act(value=v * mask[..., None], lengths=lengths, mask=mask)
+
+    return LayerOutput(name, "seq_reshape", reshape_size, [input], forward, [])
+
+
+def eos_trim(input: LayerOutput, *, eos_id: int = 1,
+             name: Optional[str] = None) -> LayerOutput:
+    """Truncate each id sequence at the first EOS (EosIdCheckLayer analog)."""
+    name = name or next_name("eos_trim")
+
+    def forward(ctx, params, a: Act) -> Act:
+        ids = a.value
+        T = ids.shape[1]
+        is_eos = (ids == eos_id)
+        # length = index of first eos, or existing length
+        first = jnp.argmax(is_eos, axis=1)
+        has = jnp.any(is_eos, axis=1)
+        new_len = jnp.where(has, first, a.lengths).astype(jnp.int32)
+        new_len = jnp.minimum(new_len, a.lengths)
+        mask = O.mask_from_lengths(new_len, T)
+        return Act(value=ids * mask.astype(ids.dtype), lengths=new_len, mask=mask)
+
+    return LayerOutput(name, "eos_trim", input.size, [input], forward, [])

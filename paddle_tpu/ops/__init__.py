@@ -51,6 +51,8 @@ from paddle_tpu.ops.attention import (
     dot_product_attention,
 )
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
+from paddle_tpu.ops.crf import crf_log_likelihood, crf_nll, crf_decode
+from paddle_tpu.ops.ctc import ctc_loss
 from paddle_tpu.ops.misc import (
     row_sum,
     row_max,

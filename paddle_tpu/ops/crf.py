@@ -1,0 +1,112 @@
+"""Linear-chain CRF — analog of the reference's CRF layers.
+
+Reference: LinearChainCRF forward/backward/decode
+(paddle/gserver/layers/LinearChainCRF.{h,cpp}; CRFLayer.cpp cost,
+CRFDecodingLayer.cpp viterbi) with weight layout: start transition a[C],
+end transition b[C], pairwise w[C,C].
+
+TPU-first: forward algorithm and Viterbi are ``lax.scan`` over time on padded
+[B,T,C] emissions with masks (carry-through past each row's length), entirely
+batched — no per-sequence host loop.  All in f32 log-space.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["crf_log_likelihood", "crf_nll", "crf_decode"]
+
+
+def _scan_alpha(emissions, mask, start, trans):
+    """log-alpha recursion; returns final alpha [B,C] (at each row's last
+    real step, via carry-through)."""
+    B, T, C = emissions.shape
+    e_tb = jnp.moveaxis(emissions, 1, 0)
+    m_tb = jnp.moveaxis(mask, 1, 0)
+    alpha0 = start[None, :] + e_tb[0]
+
+    def step(alpha, inp):
+        e_t, m_t = inp
+        # [B, C_prev, 1] + [C_prev, C_next] -> logsumexp over prev
+        nxt = jax.scipy.special.logsumexp(alpha[:, :, None] + trans[None], axis=1)
+        new = nxt + e_t
+        keep = m_t[:, None] > 0
+        return jnp.where(keep, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, (e_tb[1:], m_tb[1:]))
+    return alpha
+
+
+def crf_log_likelihood(emissions, tags, mask, start, end, trans):
+    """Per-sequence log P(tags | emissions). emissions [B,T,C] (f32 logits),
+    tags [B,T] int, mask [B,T]. Returns [B]."""
+    emissions = emissions.astype(jnp.float32)
+    B, T, C = emissions.shape
+    tags = tags.astype(jnp.int32)
+    m = mask.astype(jnp.float32)
+
+    # --- numerator: score of the given path ---
+    emit_sc = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]
+    emit_score = jnp.sum(emit_sc * m, axis=1)
+    start_score = jnp.take(start, tags[:, 0])
+    # transitions where both positions are real
+    pair_m = m[:, 1:] * m[:, :-1]
+    tr = trans[tags[:, :-1], tags[:, 1:]]
+    trans_score = jnp.sum(tr * pair_m, axis=1)
+    lengths = jnp.sum(m, axis=1).astype(jnp.int32)
+    last_tags = jnp.take_along_axis(tags, jnp.maximum(lengths - 1, 0)[:, None], axis=1)[:, 0]
+    end_score = jnp.take(end, last_tags)
+    score = emit_score + start_score + trans_score + end_score
+
+    # --- partition function ---
+    alpha = _scan_alpha(emissions, m, start, trans)
+    logz = jax.scipy.special.logsumexp(alpha + end[None, :], axis=-1)
+    return score - logz
+
+
+def crf_nll(emissions, tags, mask, start, end, trans):
+    """Mean negative log-likelihood over the batch (CRFLayer cost analog)."""
+    ll = crf_log_likelihood(emissions, tags, mask, start, end, trans)
+    return -jnp.mean(ll)
+
+
+def crf_decode(emissions, mask, start, end, trans) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Viterbi decode. Returns (best_tags [B,T] int32, best_score [B]).
+    Padded positions get tag 0. (CRFDecodingLayer analog.)"""
+    emissions = emissions.astype(jnp.float32)
+    B, T, C = emissions.shape
+    m = mask.astype(jnp.float32)
+    e_tb = jnp.moveaxis(emissions, 1, 0)
+    m_tb = jnp.moveaxis(m, 1, 0)
+    delta0 = start[None, :] + e_tb[0]
+
+    def fwd(delta, inp):
+        e_t, m_t = inp
+        cand = delta[:, :, None] + trans[None]          # [B, prev, next]
+        best_prev = jnp.argmax(cand, axis=1)            # [B, next]
+        new = jnp.max(cand, axis=1) + e_t
+        keep = m_t[:, None] > 0
+        delta_out = jnp.where(keep, new, delta)
+        # identity backpointer on padded steps keeps backtrace consistent
+        bp = jnp.where(keep, best_prev, jnp.arange(C)[None, :])
+        return delta_out, bp
+
+    delta, bps = lax.scan(fwd, delta0, (e_tb[1:], m_tb[1:]))  # bps [T-1,B,C]
+    final = delta + end[None, :]
+    best_last = jnp.argmax(final, axis=-1).astype(jnp.int32)  # [B]
+    best_score = jnp.max(final, axis=-1)
+
+    def back(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    first_tag, rest = lax.scan(back, best_last, bps, reverse=True)
+    tags = jnp.concatenate([first_tag[None], jnp.moveaxis(rest, 0, 0)], axis=0)
+    # rest is [T-1, B] of tags for positions 1..T-1 (scan emits carry pre-update,
+    # reversed); first_tag is position 0
+    tags_bt = jnp.moveaxis(tags, 0, 1)
+    return (tags_bt * m.astype(jnp.int32)), best_score

@@ -1,0 +1,77 @@
+"""CTC loss — analog of the reference's CTC tier.
+
+Reference: native CTCLayer (gserver/layers/CTCLayer.cpp) and the dlopen'd
+warp-ctc wrapper (paddle/cuda/src/hl_warpctc_wrap.cc, WarpCTCLayer.cpp).
+
+TPU-first: the standard alpha (forward) recursion in log space over the
+extended label sequence [blank, l1, blank, ..., lL, blank], as a ``lax.scan``
+over time — fully batched on padded [B,T,C] log-probs with per-row input and
+label lengths; no cuDNN/warpctc dependency.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ctc_loss"]
+
+_NEG = -1e30
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, *, blank: int = 0,
+             norm_by_times: bool = False):
+    """Per-sequence CTC negative log-likelihood.
+
+    log_probs: [B, T, C] log-softmax outputs; labels: [B, L] int (padded);
+    input_lengths: [B]; label_lengths: [B]. Returns [B] losses.
+    """
+    log_probs = log_probs.astype(jnp.float32)
+    B, T, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    labels = labels.astype(jnp.int32)
+
+    # extended sequence e: [B, S] = blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # allowed skip (s-2 -> s): e[s] != blank and e[s] != e[s-2]
+    can_skip = jnp.zeros((B, S), bool)
+    can_skip = can_skip.at[:, 2:].set((ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    lp_tb = jnp.moveaxis(log_probs, 1, 0)  # [T, B, C]
+    t_mask = (jnp.arange(T)[:, None] < input_lengths[None, :]).astype(jnp.float32)  # [T,B]
+
+    def emit(lp_t):
+        # lp_t [B, C] -> [B, S] log-prob of each extended symbol
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), _NEG)
+    e0 = emit(lp_tb[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, e0[:, 1], _NEG))
+
+    def step(alpha, inp):
+        lp_t, m_t = inp
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(can_skip, a_shift2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        new = merged + emit(lp_t)
+        keep = m_t[:, None] > 0
+        return jnp.where(keep, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, (lp_tb[1:], t_mask[1:]))
+
+    # final: logsumexp of alpha at s = 2*lab_len (trailing blank) and 2*lab_len-1
+    sl = 2 * label_lengths
+    a_end = jnp.take_along_axis(alpha, sl[:, None], axis=1)[:, 0]
+    a_end2 = jnp.take_along_axis(alpha, jnp.maximum(sl - 1, 0)[:, None], axis=1)[:, 0]
+    a_end2 = jnp.where(label_lengths > 0, a_end2, _NEG)
+    ll = jnp.logaddexp(a_end, a_end2)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    return loss

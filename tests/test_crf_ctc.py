@@ -1,0 +1,228 @@
+"""CRF / CTC correctness vs brute-force enumeration (the strongest possible
+golden test), plus layer-level training smoke tests."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.ops as O
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+def _brute_force_crf(emis, start, end, trans, L):
+    """Enumerate all tag paths of length L; return (logZ, best_path, best_score)."""
+    C = emis.shape[1]
+    scores = {}
+    for path in itertools.product(range(C), repeat=L):
+        s = start[path[0]] + emis[0, path[0]]
+        for t in range(1, L):
+            s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+        s += end[path[-1]]
+        scores[path] = s
+    logz = np.logaddexp.reduce(list(scores.values()))
+    best = max(scores, key=scores.get)
+    return logz, best, scores[best]
+
+
+class TestCRF:
+    def _setup(self, rng, B=3, T=4, C=3):
+        emis = rng.randn(B, T, C).astype(np.float32)
+        start = rng.randn(C).astype(np.float32) * 0.5
+        end = rng.randn(C).astype(np.float32) * 0.5
+        trans = rng.randn(C, C).astype(np.float32) * 0.5
+        lengths = np.array([4, 2, 3], np.int32)[:B]
+        mask = np.asarray(O.mask_from_lengths(jnp.asarray(lengths), T))
+        tags = rng.randint(0, C, (B, T)).astype(np.int32)
+        return emis, start, end, trans, lengths, mask, tags
+
+    def test_log_likelihood_vs_brute_force(self, rng):
+        emis, start, end, trans, lengths, mask, tags = self._setup(rng)
+        ll = np.asarray(O.crf_log_likelihood(
+            jnp.asarray(emis), jnp.asarray(tags), jnp.asarray(mask),
+            jnp.asarray(start), jnp.asarray(end), jnp.asarray(trans)))
+        for b in range(emis.shape[0]):
+            L = int(lengths[b])
+            logz, _, _ = _brute_force_crf(emis[b], start, end, trans, L)
+            path = tuple(tags[b, :L])
+            s = start[path[0]] + emis[b, 0, path[0]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+            s += end[path[-1]]
+            np.testing.assert_allclose(ll[b], s - logz, rtol=1e-4, atol=1e-5)
+
+    def test_viterbi_vs_brute_force(self, rng):
+        emis, start, end, trans, lengths, mask, _ = self._setup(rng)
+        tags, score = O.crf_decode(
+            jnp.asarray(emis), jnp.asarray(mask),
+            jnp.asarray(start), jnp.asarray(end), jnp.asarray(trans))
+        tags, score = np.asarray(tags), np.asarray(score)
+        for b in range(emis.shape[0]):
+            L = int(lengths[b])
+            _, best, best_score = _brute_force_crf(emis[b], start, end, trans, L)
+            np.testing.assert_array_equal(tags[b, :L], list(best))
+            np.testing.assert_allclose(score[b], best_score, rtol=1e-4)
+
+    def test_crf_layer_trains(self, rng):
+        C = 4
+        feats = nn.data("feats", size=8, is_seq=True)
+        labels = nn.data("tags", size=C, is_seq=True, dtype="int32")
+        emis = nn.fc(feats, C, act="linear", name="emissions")
+        cost = nn.crf_cost(emis, labels, name="crf")
+        trainer = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+        # learnable synthetic tagging: tag = argmax of first C features
+        x = rng.randn(16, 6, 8).astype(np.float32)
+        y = x[:, :, :C].argmax(-1).astype(np.int32)
+        lengths = np.full(16, 6, np.int32)
+        feed = {"feats": (x, lengths), "tags": (y, lengths)}
+        l0 = float(trainer.train_batch(feed))
+        for _ in range(60):
+            l = float(trainer.train_batch(feed))
+        assert l < l0 * 0.5
+
+
+def _brute_force_ctc(lp, label, T, blank=0):
+    """Sum probability over all alignments of length T collapsing to label."""
+    C = lp.shape[1]
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse
+        col, prev = [], None
+        for c in path:
+            if c != blank and c != prev:
+                col.append(c)
+            prev = c
+        if col == list(label):
+            total = np.logaddexp(total, sum(lp[t, path[t]] for t in range(T)))
+    return -total
+
+
+class TestCTC:
+    def test_vs_brute_force(self, rng):
+        B, T, C = 2, 4, 3
+        logits = rng.randn(B, T, C).astype(np.float32)
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+        labels = np.array([[1, 2], [2, 0]], np.int32)
+        in_len = np.array([4, 3], np.int32)
+        lab_len = np.array([2, 1], np.int32)
+        loss = np.asarray(O.ctc_loss(jnp.asarray(lp), jnp.asarray(labels),
+                                     jnp.asarray(in_len), jnp.asarray(lab_len)))
+        for b in range(B):
+            ref = _brute_force_ctc(lp[b, : in_len[b]], labels[b, : lab_len[b]],
+                                   int(in_len[b]))
+            np.testing.assert_allclose(loss[b], ref, rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows_and_layer(self, rng):
+        B, T, C, L = 2, 6, 5, 2
+        feats = nn.data("feats", size=8, is_seq=True)
+        labels = nn.data("labels", size=C, is_seq=True, dtype="int32")
+        logits = nn.fc(feats, C, act="linear", name="logits")
+        cost = nn.ctc_cost(logits, labels, name="ctc")
+        trainer = SGDTrainer(cost, Adam(learning_rate=0.02), seed=0)
+        x = rng.randn(B, T, 8).astype(np.float32)
+        y = rng.randint(1, C, (B, L)).astype(np.int32)
+        feed = {"feats": (x, np.full(B, T, np.int32)),
+                "labels": (y, np.full(B, L, np.int32))}
+        l0 = float(trainer.train_batch(feed))
+        for _ in range(40):
+            l = float(trainer.train_batch(feed))
+        assert np.isfinite(l) and l < l0
+
+
+class TestSamplingCosts:
+    def test_nce_cost_trains(self, rng):
+        V = 50
+        x = nn.data("x", size=16)
+        lab = nn.data("label", size=1, dtype="int32")
+        h = nn.fc(x, 16, act="tanh")
+        cost = nn.nce_cost(h, lab, num_classes=V, num_neg_samples=5, name="nce")
+        trainer = SGDTrainer(cost, Adam(learning_rate=0.01), seed=0)
+        xs = rng.randn(32, 16).astype(np.float32)
+        ys = rng.randint(0, V, (32, 1))
+        l0 = float(trainer.train_batch({"x": xs, "label": ys}))
+        for _ in range(30):
+            l = float(trainer.train_batch({"x": xs, "label": ys}))
+        assert l < l0
+
+    def test_hsigmoid_cost_trains(self, rng):
+        V = 16
+        x = nn.data("x", size=8)
+        lab = nn.data("label", size=1, dtype="int32")
+        cost = nn.hsigmoid_cost(x, lab, num_classes=V, name="hs")
+        trainer = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+        xs = rng.randn(64, 8).astype(np.float32)
+        ys = (xs[:, 0] > 0).astype(np.int32)[:, None] * 8
+        l0 = float(trainer.train_batch({"x": xs, "label": ys}))
+        for _ in range(50):
+            l = float(trainer.train_batch({"x": xs, "label": ys}))
+        assert l < l0 * 0.7
+
+
+class TestUtilityLayers:
+    def test_multiplex(self, rng):
+        idx = nn.data("idx", size=1, dtype="int32")
+        a = nn.data("a", size=4)
+        b = nn.data("b", size=4)
+        m = nn.multiplex(idx, [a, b], name="mux")
+        topo = nn.Topology(m)
+        params, state = topo.init(jax.random.PRNGKey(0))
+        av = rng.randn(3, 4).astype(np.float32)
+        bv = rng.randn(3, 4).astype(np.float32)
+        outs, _ = topo.apply(params, state, {"idx": np.array([[0], [1], [0]]),
+                                             "a": av, "b": bv})
+        got = np.asarray(outs["mux"].value)
+        np.testing.assert_allclose(got[0], av[0], atol=1e-6)
+        np.testing.assert_allclose(got[1], bv[1], atol=1e-6)
+
+    def test_pad_rotate(self, rng):
+        img = nn.data("img", size=3, height=4, width=5)
+        p = nn.pad(img, pad_h=(1, 1), pad_w=(0, 2), name="pad")
+        r = nn.rotate(img, name="rot")
+        topo = nn.Topology([p, r])
+        params, state = topo.init(jax.random.PRNGKey(0))
+        x = rng.randn(2, 4, 5, 3).astype(np.float32)
+        outs, _ = topo.apply(params, state, {"img": x})
+        assert outs["pad"].value.shape == (2, 6, 7, 3)
+        assert outs["rot"].value.shape == (2, 5, 4, 3)
+        assert p.meta["hw"] == (6, 7)
+
+    def test_eos_trim(self):
+        ids = nn.data("ids", size=10, is_seq=True, dtype="int32")
+        t = nn.eos_trim(ids, eos_id=1, name="trim")
+        topo = nn.Topology(t)
+        params, state = topo.init(jax.random.PRNGKey(0))
+        v = np.array([[5, 3, 1, 7, 7], [4, 4, 4, 4, 4]], np.int32)
+        lengths = np.array([5, 4], np.int32)
+        outs, _ = topo.apply(params, state, {"ids": (v, lengths)})
+        np.testing.assert_array_equal(np.asarray(outs["trim"].lengths), [2, 4])
+
+    def test_block_expand(self, rng):
+        img = nn.data("img", size=2, height=4, width=4)
+        be = nn.block_expand(img, block_x=2, block_y=2, stride_x=2, stride_y=2,
+                             name="blocks")
+        topo = nn.Topology(be)
+        params, state = topo.init(jax.random.PRNGKey(0))
+        x = rng.randn(1, 4, 4, 2).astype(np.float32)
+        outs, _ = topo.apply(params, state, {"img": x})
+        assert outs["blocks"].value.shape == (1, 4, 8)
+
+    def test_sampling_id(self, rng):
+        x = nn.data("x", size=5)
+        s = nn.sampling_id(x, name="sid")
+        topo = nn.Topology(s)
+        params, state = topo.init(jax.random.PRNGKey(0))
+        logits = np.full((4, 5), -20.0, np.float32)
+        logits[:, 2] = 10.0
+        outs, _ = topo.apply(params, state, {"x": logits},
+                             rng=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(outs["sid"].value), [2, 2, 2, 2])

@@ -1,0 +1,213 @@
+"""Recurrent groups — the RecurrentGradientMachine analog.
+
+Reference: a "recurrent layer group" runs an arbitrary sub-network
+frame-by-frame over a sequence with ``memory`` edges carrying state across
+frames, plus boot layers for t=0, and beam-search generation over the same
+step net (gserver/gradientmachines/RecurrentGradientMachine.{h,cpp};
+config DSL recurrent_group / memory in
+python/paddle/trainer_config_helpers/layers.py:3298, config_parser.py:393-427;
+agent/gather/scatter layers route tensors in/out of the group).
+
+TPU-native: the step sub-network is *itself a Topology* built from the same
+layer DSL, with per-frame inputs declared as non-sequence data layers; the
+group compiles to one ``lax.scan`` whose body applies the sub-topology.  The
+reference's per-frame dynamic batching (shrinking active set, SequenceToBatch)
+is replaced by masking: finished rows carry state through unchanged — same
+semantics, static shapes, and the whole unroll is one XLA program.
+
+``SequenceGenerator`` provides generation (greedy/beam) over a functional step
+protocol; any recurrent_group whose step ends in a vocab softmax can be
+wrapped into it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu.ops as O
+from paddle_tpu.nn.graph import Act, LayerOutput, Topology, next_name
+from paddle_tpu.nn.layers import data as data_layer
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = ["Memory", "StaticInput", "recurrent_group", "SequenceGenerator"]
+
+
+@dataclass
+class Memory:
+    """Recurrent state slot: carries the step output named ``link`` (or the
+    step's returned memory-update layer) from frame t to t+1.  ``boot``
+    (a LayerOutput producing [B, size]) seeds t=0; default zeros."""
+
+    name: str
+    size: int
+    boot: Optional[LayerOutput] = None
+
+
+@dataclass
+class StaticInput:
+    """Per-sequence (not per-frame) input visible to every step — the analog
+    of the reference's StaticInput (layers.py)."""
+
+    input: LayerOutput
+
+
+def recurrent_group(
+    step: Callable[..., Sequence[LayerOutput]],
+    input: Sequence[LayerOutput | StaticInput],
+    memories: Sequence[Memory],
+    *,
+    reverse: bool = False,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Run ``step`` over the frames of the sequence inputs.
+
+    ``step(*frame_layers, *static_layers, *memory_layers) -> [out, *mem_updates]``
+    builds the per-frame sub-network symbolically; it is called ONCE at config
+    time.  ``mem_updates[i]`` is the new value of ``memories[i]``.  The group's
+    output is the sequence of ``out`` frames.
+    """
+    name = name or next_name("recurrent_group")
+    seq_inputs = [i for i in input if isinstance(i, LayerOutput)]
+    static_inputs = [i.input for i in input if isinstance(i, StaticInput)]
+    if not seq_inputs:
+        raise ConfigError("recurrent_group needs at least one sequence input")
+
+    # ---- build the step sub-topology (config time) ----
+    frame_layers = [
+        data_layer(f"__{name}_frame{i}__", size=l.size) for i, l in enumerate(seq_inputs)
+    ]
+    static_layers = [
+        data_layer(f"__{name}_static{i}__", size=l.size)
+        for i, l in enumerate(static_inputs)
+    ]
+    mem_layers = [data_layer(f"__{name}_mem_{m.name}__", size=m.size) for m in memories]
+    result = step(*frame_layers, *static_layers, *mem_layers)
+    if isinstance(result, LayerOutput):
+        result = [result]
+    out_layer, mem_updates = result[0], list(result[1:])
+    if len(mem_updates) != len(memories):
+        raise ConfigError(
+            f"step returned {len(mem_updates)} memory updates for "
+            f"{len(memories)} memories"
+        )
+    sub_topo = Topology([out_layer, *mem_updates])
+
+    # hoist sub-net parameters into the group layer
+    specs = list(sub_topo.param_specs.values())
+    parents = seq_inputs + static_inputs + [m.boot for m in memories if m.boot is not None]
+    boot_ix: Dict[int, int] = {}
+    k = len(seq_inputs) + len(static_inputs)
+    for mi, m in enumerate(memories):
+        if m.boot is not None:
+            boot_ix[mi] = k
+            k += 1
+
+    def forward(ctx, params, *acts: Act) -> Act:
+        seq_acts = acts[: len(seq_inputs)]
+        static_acts = acts[len(seq_inputs) : len(seq_inputs) + len(static_inputs)]
+        ref = seq_acts[0]
+        B = ref.value.shape[0]
+        mem0 = []
+        for mi, m in enumerate(memories):
+            if mi in boot_ix:
+                mem0.append(acts[boot_ix[mi]].value)
+            else:
+                mem0.append(jnp.zeros((B, m.size), ref.value.dtype))
+
+        def step_fn(mems, frames):
+            feed = {}
+            for fl, f_t in zip(frame_layers, frames):
+                feed[fl.name] = Act(value=f_t)
+            for sl, sa in zip(static_layers, static_acts):
+                feed[sl.name] = Act(value=sa.value)
+            for ml, mv in zip(mem_layers, mems):
+                feed[ml.name] = Act(value=mv)
+            outs, _ = sub_topo.apply(params, {}, feed, train=ctx.train,
+                                     rng=None)
+            new_mems = tuple(outs[u.name].value for u in mem_updates)
+            return new_mems, outs[out_layer.name].value
+
+        xs = tuple(a.value for a in seq_acts)
+        _, out_seq = O.scan_rnn(step_fn, tuple(mem0), xs, ref.mask, reverse=reverse)
+        return Act(value=out_seq, lengths=ref.lengths, mask=ref.mask)
+
+    return LayerOutput(name, "recurrent_group", out_layer.size, parents, forward, specs)
+
+
+# ---------------------------------------------------------------------------
+# generation
+# ---------------------------------------------------------------------------
+
+
+class SequenceGenerator:
+    """Greedy/beam generation over a functional step protocol — the analog of
+    RecurrentGradientMachine::generateSequence + SWIG SequenceGenerator
+    (paddle/api/PaddleAPI.h:1002).
+
+    ``step_fn(params, tokens [N], mems) -> (logits [N, V], new_mems)`` where
+    ``mems`` is a pytree with leading dim N.  ``init_fn(params, context) ->
+    mems`` seeds per-sequence state from arbitrary context (e.g. encoder
+    output).  Everything jits; beams live on-device.
+    """
+
+    def __init__(self, step_fn, *, vocab_size: int, bos_id: int = 0,
+                 eos_id: int = 1):
+        self.step_fn = step_fn
+        self.V = vocab_size
+        self.bos = bos_id
+        self.eos = eos_id
+
+    def generate(self, params, mems0, *, batch_size: int, beam_size: int = 3,
+                 max_len: int = 50, length_penalty: float = 0.0):
+        """mems0: pytree with leading dim B. Returns (tokens [B,K,max_len],
+        scores [B,K]) best-first."""
+        B, K, V = batch_size, beam_size, self.V
+        step_fn = self.step_fn
+
+        def tile(x):
+            return jnp.repeat(x, K, axis=0)
+
+        mems = jax.tree_util.tree_map(tile, mems0)
+        logp = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32)[None], (B, 1))
+        tokens = jnp.full((B, K, max_len + 1), self.eos, jnp.int32)
+        tokens = tokens.at[:, :, 0].set(self.bos)
+        finished = jnp.zeros((B, K), bool)
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[self.eos].set(0.0)
+
+        def scan_step(carry, t):
+            tokens, logp, mems, finished = carry
+            y = lax.dynamic_index_in_dim(tokens, t, axis=2, keepdims=False)
+            logits, mems_new = step_fn(params, y.reshape(B * K), mems)
+            step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1).reshape(B, K, V)
+            step_logp = jnp.where(finished[..., None], eos_only[None, None], step_logp)
+            flat = (logp[..., None] + step_logp).reshape(B, K * V)
+            new_logp, idx = lax.top_k(flat, K)
+            beam_idx, tok = idx // V, (idx % V).astype(jnp.int32)
+
+            def reorder(x):
+                xb = x.reshape(B, K, *x.shape[1:])
+                ix = beam_idx.reshape(B, K, *([1] * (xb.ndim - 2)))
+                return jnp.take_along_axis(xb, ix, axis=1).reshape(B * K, *x.shape[1:])
+
+            mems_new = jax.tree_util.tree_map(reorder, mems_new)
+            tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+            tokens = tokens.at[:, :, t + 1].set(tok)
+            finished = jnp.take_along_axis(finished, beam_idx, axis=1) | (tok == self.eos)
+            return (tokens, new_logp, mems_new, finished), None
+
+        (tokens, logp, _, _), _ = lax.scan(
+            scan_step, (tokens, logp, mems, finished), jnp.arange(max_len))
+        out = tokens[:, :, 1:]
+        if length_penalty > 0:
+            lengths = jnp.sum((out != self.eos).astype(jnp.float32), -1) + 1.0
+            scores = logp / jnp.power(lengths, length_penalty)
+        else:
+            scores = logp
+        order = jnp.argsort(-scores, axis=1)
+        out = jnp.take_along_axis(out, order[..., None], axis=1)
+        return out, jnp.take_along_axis(scores, order, axis=1)

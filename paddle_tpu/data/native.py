@@ -1,0 +1,187 @@
+"""ctypes binding for the native data-pipeline core (csrc/dataio.cc).
+
+The library is compiled on first use with g++ (cached under
+``paddle_tpu/_native/``); every entry point has a numpy fallback so the
+framework works without a toolchain.  This is the runtime-native tier the
+reference implements in paddle/gserver/dataproviders (SURVEY.md §2 item 34).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.utils import logger
+
+__all__ = [
+    "native_available",
+    "shuffle_indices",
+    "bucket_by_length",
+    "argsort_by_length",
+    "pad_batch_i32",
+    "pack_sequences",
+    "count_tokens",
+]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_repo_root(), "csrc", "dataio.cc")
+        out_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                               "_native")
+        so = os.path.join(out_dir, "libpaddletpu_dataio.so")
+        try:
+            if (not os.path.exists(so)) or (
+                os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(so)
+            ):
+                os.makedirs(out_dir, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", so, src],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(so)
+        except Exception as e:  # toolchain absent or compile failure
+            logger.warning("native dataio unavailable (%s); using numpy fallback", e)
+            return None
+
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.ptd_shuffle_indices.argtypes = [ctypes.c_int32, ctypes.c_uint64, i32p]
+        lib.ptd_bucket_by_length.argtypes = [i32p, ctypes.c_int32, i32p,
+                                             ctypes.c_int32, i32p]
+        lib.ptd_argsort_by_length.argtypes = [i32p, ctypes.c_int32, i32p]
+        lib.ptd_pad_batch_i32.argtypes = [i32p, i64p, ctypes.c_int32,
+                                          ctypes.c_int32, i32p, i32p]
+        lib.ptd_pack_sequences.argtypes = [i32p, i64p, ctypes.c_int32,
+                                           ctypes.c_int32, ctypes.c_int32,
+                                           i32p, i32p, i32p]
+        lib.ptd_pack_sequences.restype = ctypes.c_int32
+        lib.ptd_count_tokens.argtypes = [i32p, ctypes.c_int64, ctypes.c_int32, i64p]
+        lib.ptd_version.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _flatten(seqs: Sequence[Sequence[int]]) -> Tuple[np.ndarray, np.ndarray]:
+    lens = np.asarray([len(s) for s in seqs], np.int64)
+    offsets = np.zeros(len(seqs) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    flat = np.empty(int(offsets[-1]), np.int32)
+    for i, s in enumerate(seqs):
+        flat[offsets[i] : offsets[i + 1]] = s
+    return flat, offsets
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(n, np.int32)
+    if lib is not None:
+        lib.ptd_shuffle_indices(n, seed, _i32(out))
+        return out
+    rng = np.random.RandomState(seed % (2**31))
+    return rng.permutation(n).astype(np.int32)
+
+
+def bucket_by_length(lens: np.ndarray, buckets: Sequence[int]) -> np.ndarray:
+    lens = np.ascontiguousarray(lens, np.int32)
+    bk = np.ascontiguousarray(buckets, np.int32)
+    out = np.empty(len(lens), np.int32)
+    lib = _load()
+    if lib is not None:
+        lib.ptd_bucket_by_length(_i32(lens), len(lens), _i32(bk), len(bk), _i32(out))
+        return out
+    idx = np.searchsorted(bk, lens)
+    return np.minimum(idx, len(bk) - 1).astype(np.int32)
+
+
+def argsort_by_length(lens: np.ndarray) -> np.ndarray:
+    lens = np.ascontiguousarray(lens, np.int32)
+    out = np.empty(len(lens), np.int32)
+    lib = _load()
+    if lib is not None:
+        lib.ptd_argsort_by_length(_i32(lens), len(lens), _i32(out))
+        return out
+    return np.argsort(lens, kind="stable").astype(np.int32)
+
+
+def pad_batch_i32(seqs: Sequence[Sequence[int]], max_t: int) -> Tuple[np.ndarray, np.ndarray]:
+    flat, offsets = _flatten(seqs)
+    n = len(seqs)
+    out = np.zeros((n, max_t), np.int32)
+    lens = np.empty(n, np.int32)
+    lib = _load()
+    if lib is not None:
+        lib.ptd_pad_batch_i32(_i32(flat), _i64(offsets), n, max_t, _i32(out), _i32(lens))
+        return out, lens
+    for i, s in enumerate(seqs):
+        L = min(len(s), max_t)
+        out[i, :L] = list(s)[:L]
+        lens[i] = L
+    return out, lens
+
+
+def pack_sequences(seqs: Sequence[Sequence[int]], n_rows: int, T: int):
+    """Greedy-pack sequences into [n_rows, T] with 1-based segment ids
+    (0 = pad). Returns (ids, seg_ids, row_used, n_placed)."""
+    flat, offsets = _flatten(seqs)
+    ids = np.zeros((n_rows, T), np.int32)
+    seg = np.zeros((n_rows, T), np.int32)
+    used = np.zeros(n_rows, np.int32)
+    lib = _load()
+    if lib is not None:
+        placed = lib.ptd_pack_sequences(_i32(flat), _i64(offsets), len(seqs),
+                                        n_rows, T, _i32(ids), _i32(seg), _i32(used))
+        return ids, seg, used, int(placed)
+    placed = 0
+    for s in seqs:
+        L = len(s)
+        if L > T:
+            continue
+        for r in range(n_rows):
+            if used[r] + L <= T:
+                ids[r, used[r] : used[r] + L] = s
+                seg[r, used[r] : used[r] + L] = placed + 1
+                used[r] += L
+                placed += 1
+                break
+    return ids, seg, used, placed
+
+
+def count_tokens(seqs: Sequence[Sequence[int]], vocab_cap: int) -> np.ndarray:
+    flat, _ = _flatten(seqs)
+    counts = np.zeros(vocab_cap, np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.ptd_count_tokens(_i32(flat), len(flat), vocab_cap, _i64(counts))
+        return counts
+    np.add.at(counts, flat[(flat >= 0) & (flat < vocab_cap)], 1)
+    return counts

@@ -1,0 +1,261 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's performance tier is hand-written CUDA: fused LSTM cell with
+intra-sequence parallelism (paddle/cuda/src/hl_cuda_lstm.cu:26-58, PTX
+bar.sync), fused GRU (hl_gru_ops.cuh).  The TPU analog: the *whole* LSTM/GRU
+time loop runs inside ONE Pallas kernel — the grid's sequential dimension is
+time, recurrent weights stay resident in VMEM across all timesteps, and the
+h/c state lives in VMEM scratch, so per-step HBM traffic is just the input
+projection block in and the hidden block out.
+
+Forward-only kernels wrapped in ``jax.custom_vjp``: the backward pass
+recomputes via the pure-JAX scan implementation (rematerialization trades
+FLOPs for memory, and keeps one numerics source of truth for gradients).
+
+All kernels are shape-gated: ``lstm_layer``/``gru_layer`` in ops.rnn call
+these automatically on TPU when dims are tile-aligned; otherwise the lax.scan
+path runs.  CPU tests run both paths and compare (interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pallas_available", "lstm_forward_pallas", "gru_forward_pallas"]
+
+
+def pallas_available() -> bool:
+    try:
+        import jax.experimental.pallas  # noqa: F401
+
+        return jax.default_backend() in ("tpu", "cpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# LSTM: one kernel over the whole sequence
+# ---------------------------------------------------------------------------
+
+
+def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
+                 h_scr, c_scr, *, hidden: int):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr)
+        c_scr[...] = jnp.zeros_like(c_scr)
+
+    h = h_scr[...]
+    c = c_scr[...]
+    xp = xp_ref[0]                          # [B, 4H]
+    z = xp + jnp.dot(h, wh_ref[...], preferred_element_type=jnp.float32)
+    H = hidden
+    i = jax.nn.sigmoid(z[:, :H])
+    f = jax.nn.sigmoid(z[:, H : 2 * H])
+    o = jax.nn.sigmoid(z[:, 2 * H : 3 * H])
+    g = jnp.tanh(z[:, 3 * H :])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    m = m_ref[0]                            # [B, 1]
+    keep = m > 0
+    h_new = jnp.where(keep, h_new, h)
+    c_new = jnp.where(keep, c_new, c)
+    h_scr[...] = h_new
+    c_scr[...] = c_new
+    hseq_ref[0] = h_new
+
+    @pl.when(t == T - 1)
+    def _fin():
+        hfin_ref[...] = h_new
+        cfin_ref[...] = c_new
+
+
+def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, H4 = xp_tb.shape
+    H = H4 // 4
+    kernel = functools.partial(_lstm_kernel, hidden=H)
+    return pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp_tb, mask_tb[..., None], w_h)
+
+
+def _lstm_reference(xp, mask, w_h):
+    """Pure-JAX twin (same math) used for the custom_vjp backward."""
+    from paddle_tpu.ops.rnn import lstm_step, scan_rnn
+
+    def step(carry, xp_t):
+        h, c = carry
+        h2, c2 = lstm_step(xp_t, h, c, w_h)
+        return (h2, c2), h2
+
+    B = xp.shape[0]
+    H = w_h.shape[0]
+    z = jnp.zeros((B, H), xp.dtype)
+    (h_f, c_f), h_seq = scan_rnn(step, (z, z), xp, mask)
+    return h_seq, h_f, c_f
+
+
+@jax.custom_vjp
+def lstm_forward_pallas(xp, mask, w_h):
+    """xp: [B,T,4H] input projection (+bias), mask [B,T], w_h [H,4H].
+    Returns (h_seq [B,T,H], h_final, c_final). No peepholes (gated upstream)."""
+    xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
+    m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
+    h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
+    return jnp.moveaxis(h_tb, 0, 1), h_f, c_f
+
+
+def _lstm_fwd(xp, mask, w_h):
+    out = lstm_forward_pallas(xp, mask, w_h)
+    return out, (xp, mask, w_h)
+
+
+def _lstm_bwd(res, ct):
+    xp, mask, w_h = res
+    _, vjp = jax.vjp(lambda xp, w_h: _lstm_reference(xp, mask, w_h), xp, w_h)
+    d_xp, d_wh = vjp(ct)
+    return d_xp, None, d_wh
+
+
+lstm_forward_pallas.defvjp(_lstm_fwd, _lstm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# GRU: same structure
+# ---------------------------------------------------------------------------
+
+
+def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *, hidden: int):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr)
+
+    h = h_scr[...]
+    H = hidden
+    xp = xp_ref[0]                                      # [B, 3H]
+    w = wh_ref[...]                                     # [H, 3H]
+    zr = xp[:, : 2 * H] + jnp.dot(h, w[:, : 2 * H],
+                                  preferred_element_type=jnp.float32)
+    r = jax.nn.sigmoid(zr[:, :H])
+    u = jax.nn.sigmoid(zr[:, H:])
+    cand = jnp.tanh(xp[:, 2 * H :] + jnp.dot(r * h, w[:, 2 * H :],
+                                             preferred_element_type=jnp.float32))
+    h_new = u * h + (1.0 - u) * cand
+    m = m_ref[0]
+    h_new = jnp.where(m > 0, h_new, h)
+    h_scr[...] = h_new
+    hseq_ref[0] = h_new
+
+    @pl.when(t == T - 1)
+    def _fin():
+        hfin_ref[...] = h_new
+
+
+def _gru_pallas_raw(xp_tb, mask_tb, w_h):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, H3 = xp_tb.shape
+    H = H3 // 3
+    kernel = functools.partial(_gru_kernel, hidden=H)
+    return pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        interpret=_interpret(),
+    )(xp_tb, mask_tb[..., None], w_h)
+
+
+def _gru_reference(xp, mask, w_h):
+    from paddle_tpu.ops.rnn import gru_step, scan_rnn
+
+    def step(h, xp_t):
+        h2 = gru_step(xp_t, h, w_h)
+        return h2, h2
+
+    B = xp.shape[0]
+    H = w_h.shape[0]
+    h_f, h_seq = scan_rnn(step, jnp.zeros((B, H), xp.dtype), xp, mask)
+    return h_seq, h_f
+
+
+@jax.custom_vjp
+def gru_forward_pallas(xp, mask, w_h):
+    """xp: [B,T,3H], mask [B,T], w_h [H,3H] -> (h_seq [B,T,H], h_final)."""
+    xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
+    m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
+    h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
+    return jnp.moveaxis(h_tb, 0, 1), h_f
+
+
+def _gru_fwd(xp, mask, w_h):
+    out = gru_forward_pallas(xp, mask, w_h)
+    return out, (xp, mask, w_h)
+
+
+def _gru_bwd(res, ct):
+    xp, mask, w_h = res
+    _, vjp = jax.vjp(lambda xp, w_h: _gru_reference(xp, mask, w_h), xp, w_h)
+    d_xp, d_wh = vjp(ct)
+    return d_xp, None, d_wh
+
+
+gru_forward_pallas.defvjp(_gru_fwd, _gru_bwd)

@@ -39,6 +39,22 @@ __all__ = [
 ]
 
 
+def _use_pallas_rnn(h0, c0, peep_i, peep_f, peep_o, act, gate_act, state_act,
+                    reverse) -> bool:
+    """Fused Pallas time-loop kernel is used on TPU for the default cell
+    (no peepholes/boot state/custom activations/reverse — those take the
+    general lax.scan path)."""
+    if any(p is not None for p in (h0, c0, peep_i, peep_f, peep_o)) or reverse:
+        return False
+    if (act, gate_act, state_act) != ("tanh", "sigmoid", "tanh"):
+        return False
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not FLAGS.use_pallas_rnn:
+        return False
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def lstm_step(xp, h, c, w_h, *, peep_i=None, peep_f=None, peep_o=None,
               act="tanh", gate_act="sigmoid", state_act="tanh"):
     """One LSTM step. xp: [B, 4H] precomputed input projection (+bias),
@@ -121,6 +137,13 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 4H]
+    if _use_pallas_rnn(h0, c0, peep_i, peep_f, peep_o, act, gate_act, state_act,
+                       reverse):
+        from paddle_tpu.ops.pallas_kernels import lstm_forward_pallas
+
+        h_seq, h_fin, c_fin = lstm_forward_pallas(xp, mask, w_h)
+        h_seq = h_seq * mask[..., None].astype(h_seq.dtype)
+        return h_seq, (h_fin, c_fin)
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
     c0 = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
 
@@ -146,6 +169,12 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 3H]
+    if _use_pallas_rnn(h0, None, None, None, None, act, gate_act, "tanh", reverse):
+        from paddle_tpu.ops.pallas_kernels import gru_forward_pallas
+
+        h_seq, h_fin = gru_forward_pallas(xp, mask, w_h)
+        h_seq = h_seq * mask[..., None].astype(h_seq.dtype)
+        return h_seq, h_fin
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
 
     def step(h, xp_t):

@@ -172,6 +172,12 @@ define_flag("num_virtual_devices", 0, "force N virtual CPU devices (tests/dry-ru
 define_flag("beam_size", 3, "default beam width for sequence generation")
 define_flag("max_gen_length", 100, "max generated sequence length")
 
+# Kernel selection
+# Measured on v5e (B=64,T=100,H=256): XLA's compiled lax.scan beats the fused
+# Pallas time-loop kernel (3.8 vs 5.8 ms/layer), so the scan path is default;
+# flip on to experiment per-model.
+define_flag("use_pallas_rnn", False, "use fused Pallas LSTM/GRU time-loop kernels on TPU")
+
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")
 define_flag("prefetch_batches", 2, "data provider background prefetch depth")

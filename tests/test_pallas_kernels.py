@@ -1,0 +1,87 @@
+"""Pallas fused RNN kernels (interpret mode on CPU) vs the lax.scan reference —
+the device-equivalence pattern of the reference's math tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops as O
+from paddle_tpu.ops.pallas_kernels import (
+    gru_forward_pallas,
+    lstm_forward_pallas,
+    pallas_available,
+)
+
+pytestmark = pytest.mark.skipif(not pallas_available(), reason="pallas unavailable")
+
+
+def _data(rng, B=4, T=6, H=8, gates=4):
+    xp = jnp.asarray(rng.randn(B, T, gates * H).astype(np.float32) * 0.3)
+    lengths = jnp.asarray(np.array([6, 3, 5, 1], np.int32)[:B])
+    mask = O.mask_from_lengths(lengths, T)
+    w_h = jnp.asarray(rng.randn(H, gates * H).astype(np.float32) * 0.2)
+    return xp, mask, w_h
+
+
+def test_lstm_pallas_matches_scan(rng):
+    xp, mask, w_h = _data(rng)
+    h_seq_p, h_f_p, c_f_p = lstm_forward_pallas(xp, mask, w_h)
+
+    from paddle_tpu.ops.rnn import lstm_step, scan_rnn
+
+    def step(carry, xp_t):
+        h, c = carry
+        h2, c2 = lstm_step(xp_t, h, c, w_h)
+        return (h2, c2), h2
+
+    B, H = xp.shape[0], w_h.shape[0]
+    z = jnp.zeros((B, H))
+    (h_f, c_f), h_seq = scan_rnn(step, (z, z), xp, mask)
+    np.testing.assert_allclose(np.asarray(h_seq_p) * np.asarray(mask)[..., None],
+                               np.asarray(h_seq), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_f_p), np.asarray(h_f), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_f_p), np.asarray(c_f), rtol=1e-5, atol=1e-6)
+
+
+def test_gru_pallas_matches_scan(rng):
+    xp, mask, w_h = _data(rng, gates=3)
+    h_seq_p, h_f_p = gru_forward_pallas(xp, mask, w_h)
+
+    from paddle_tpu.ops.rnn import gru_step, scan_rnn
+
+    def step(h, xp_t):
+        h2 = gru_step(xp_t, h, w_h)
+        return h2, h2
+
+    B, H = xp.shape[0], w_h.shape[0]
+    h_f, h_seq = scan_rnn(step, jnp.zeros((B, H)), xp, mask)
+    np.testing.assert_allclose(np.asarray(h_seq_p) * np.asarray(mask)[..., None],
+                               np.asarray(h_seq), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_f_p), np.asarray(h_f), rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_pallas_grad_matches_scan(rng):
+    xp, mask, w_h = _data(rng)
+
+    def loss_p(xp, w_h):
+        h_seq, h_f, _ = lstm_forward_pallas(xp, mask, w_h)
+        return jnp.sum(h_seq * jnp.cos(jnp.arange(h_seq.size).reshape(h_seq.shape))) + jnp.sum(h_f)
+
+    from paddle_tpu.ops.rnn import lstm_step, scan_rnn
+
+    def loss_s(xp, w_h):
+        def step(carry, xp_t):
+            h, c = carry
+            h2, c2 = lstm_step(xp_t, h, c, w_h)
+            return (h2, c2), h2
+
+        B, H = xp.shape[0], w_h.shape[0]
+        z = jnp.zeros((B, H))
+        (h_f, _), h_seq = scan_rnn(step, (z, z), xp, mask)
+        return jnp.sum(h_seq * jnp.cos(jnp.arange(h_seq.size).reshape(h_seq.shape))) + jnp.sum(h_f)
+
+    gp = jax.grad(loss_p, argnums=(0, 1))(xp, w_h)
+    gs = jax.grad(loss_s, argnums=(0, 1))(xp, w_h)
+    for a, b in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

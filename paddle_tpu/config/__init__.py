@@ -1,0 +1,35 @@
+"""Config serialization — analog of python/paddle/trainer/config_parser.py
+plus proto/ModelConfig.proto (SURVEY.md §1.10, §2 items 44/49).
+
+Serialize a built ``Topology`` to a ModelConfig protobuf, golden-test its
+deterministic text form, and rebuild an equivalent Topology in a fresh
+process — the basis of the deploy bundle (config + params in one file).
+"""
+
+from paddle_tpu.config.deploy import (
+    InferenceModel,
+    load_inference_model,
+    merge_model,
+)
+from paddle_tpu.config.config_parser import (
+    SerializationError,
+    build_optimizer,
+    build_topology,
+    dump_model_config,
+    dump_trainer_config,
+    parse_protostr,
+    protostr,
+)
+
+__all__ = [
+    "InferenceModel",
+    "load_inference_model",
+    "merge_model",
+    "SerializationError",
+    "build_optimizer",
+    "build_topology",
+    "dump_model_config",
+    "dump_trainer_config",
+    "parse_protostr",
+    "protostr",
+]

@@ -1,0 +1,84 @@
+"""Record layer-constructor calls so a built Topology can be serialized.
+
+The reference's DSL functions *are* the serializer: each call appends a typed
+proto entry to a global TrainerConfig (python/paddle/trainer/config_parser.py:
+166-184). Our DSL builds live LayerOutput closures instead, so serialization
+needs the constructor call recorded on the node: ``wrap_module`` wraps every
+public layer function to attach ``meta['config'] = {fn, kwargs, call_id,
+out}`` to the LayerOutput(s) it returns.
+
+The *innermost* wrapped call that returned a node wins (composite helpers
+like ``bidirectional_rnn`` expand into primitive calls, mirroring how the
+reference's composites expand into primitive layer protos).  Raw kwargs are
+stored as-is — JSON canonicalization happens at serialize time in
+config_parser, so building graphs stays zero-overhead and unrestricted.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import itertools
+from typing import Any, Dict
+
+from paddle_tpu.nn.graph import LayerOutput
+
+__all__ = ["configurable", "wrap_module"]
+
+_call_counter = itertools.count()
+
+
+def configurable(fn):
+    """Wrap a layer constructor so returned LayerOutputs carry their config."""
+    sig = None
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        nonlocal sig
+        out = fn(*args, **kwargs)
+        call_id = next(_call_counter)
+        if sig is None:
+            try:
+                sig = inspect.signature(fn)
+            except (TypeError, ValueError):
+                sig = False
+        raw: Dict[str, Any]
+        if sig:
+            try:
+                bound = sig.bind(*args, **kwargs)
+                raw = dict(bound.arguments)
+                # flatten **kw catch-alls so decode can re-pass them
+                for p in sig.parameters.values():
+                    if p.kind is inspect.Parameter.VAR_KEYWORD and p.name in raw:
+                        raw.update(raw.pop(p.name))
+            except TypeError:
+                raw = dict(kwargs)
+        else:
+            raw = dict(kwargs)
+
+        def attach(node, idx):
+            if isinstance(node, LayerOutput) and "config" not in node.meta:
+                node.meta["config"] = {
+                    "fn": fn.__name__,
+                    "kwargs": raw,
+                    "call_id": call_id,
+                    "out": idx,
+                }
+
+        if isinstance(out, LayerOutput):
+            attach(out, -1)
+        elif isinstance(out, (tuple, list)):
+            for i, o in enumerate(out):
+                attach(o, i)
+        return out
+
+    wrapper.__wrapped_layer_fn__ = fn
+    return wrapper
+
+
+def wrap_module(namespace: Dict[str, Any], names) -> None:
+    """Wrap every function in ``names`` inside a module's globals()."""
+    for n in names:
+        fn = namespace.get(n)
+        if callable(fn) and not hasattr(fn, "__wrapped_layer_fn__"):
+            namespace[n] = configurable(fn)

@@ -1,0 +1,317 @@
+"""Topology ⇄ ModelConfig proto — analog of the reference's config_parser.
+
+Reference: python/paddle/trainer/config_parser.py turns the layer DSL into a
+serialized TrainerConfig/ModelConfig proto that C++ rebuilds the network from
+(TrainerConfigHelper.cpp:33-54); golden `.protostr` files regression-test the
+DSL (python/paddle/trainer_config_helpers/tests).
+
+Here the same round-trip is: DSL builds a live ``Topology``; each node carries
+its recorded constructor call (config/capture.py); ``dump_model_config``
+serializes calls + parameter specs into the proto
+(paddle_tpu/proto/model_config.proto); ``build_topology`` replays the calls to
+rebuild an equivalent Topology in a fresh process — the deploy path (a bundle
+is config proto + checkpointed params; see paddle_tpu/config/deploy.py).
+``protostr`` gives the deterministic text form used by golden tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from google.protobuf import text_format
+
+import paddle_tpu
+from paddle_tpu.nn.graph import LayerOutput, ParamAttr, ParamSpec, Topology
+from paddle_tpu.proto import model_config_pb2 as pb
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = [
+    "SerializationError",
+    "dump_model_config",
+    "build_topology",
+    "protostr",
+    "parse_protostr",
+    "dump_trainer_config",
+    "build_optimizer",
+]
+
+
+class SerializationError(ConfigError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# kwargs JSON encoding
+# ---------------------------------------------------------------------------
+
+_PA_DEFAULTS = ParamAttr()
+
+
+def _encode(v: Any, where: str) -> Any:
+    if isinstance(v, LayerOutput):
+        return {"__ref__": v.name}
+    if isinstance(v, ParamAttr):
+        d = {
+            f.name: getattr(v, f.name)
+            for f in dataclasses.fields(ParamAttr)
+            if getattr(v, f.name) != getattr(_PA_DEFAULTS, f.name)
+        }
+        return {"__param_attr__": d}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode(x, where) for x in v]}
+    if isinstance(v, list):
+        return [_encode(x, where) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _encode(x, where) for k, x in v.items()}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray) or type(v).__module__.startswith("jax"):
+        arr = np.asarray(v)
+        return {"__array__": {"dtype": str(arr.dtype), "data": arr.tolist()}}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    raise SerializationError(
+        f"layer {where!r}: cannot serialize constructor argument of type "
+        f"{type(v).__name__} — pass serializable values (or rebuild this "
+        f"graph programmatically instead of from config)"
+    )
+
+
+def _decode(v: Any, env: Dict[str, LayerOutput]) -> Any:
+    if isinstance(v, dict):
+        if "__ref__" in v:
+            try:
+                return env[v["__ref__"]]
+            except KeyError:
+                raise ConfigError(f"config references unknown layer {v['__ref__']!r}")
+        if "__param_attr__" in v:
+            return ParamAttr(**v["__param_attr__"])
+        if "__tuple__" in v:
+            return tuple(_decode(x, env) for x in v["__tuple__"])
+        if "__array__" in v:
+            a = v["__array__"]
+            return np.asarray(a["data"], dtype=a["dtype"])
+        return {k: _decode(x, env) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode(x, env) for x in v]
+    return v
+
+
+def _canonical_json(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# dump
+# ---------------------------------------------------------------------------
+
+
+def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig:
+    """Serialize a Topology into a ModelConfig proto."""
+    mc = pb.ModelConfig(name=name, framework_version=paddle_tpu.__version__)
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    mc.dtype_policy = str(np.dtype(compute_dtype())) if compute_dtype() else ""
+    call_renumber: Dict[int, int] = {}  # process-global call ids -> dump-local
+    for node in topology.layers:
+        cfg = node.meta.get("config")
+        if cfg is None and not node.is_data:
+            raise SerializationError(
+                f"layer {node.name!r} (type {node.layer_type!r}) was not built "
+                "by a recorded DSL constructor and cannot be serialized "
+                "(recurrent_group step networks are rebuilt programmatically)"
+            )
+        lc = mc.layers.add(
+            name=node.name,
+            type=(cfg["fn"] if cfg else node.layer_type),
+            size=int(node.size),
+            inputs=[p.name for p in node.parents],
+        )
+        if cfg:
+            kwargs = dict(cfg["kwargs"])
+            # force the recorded name so replay regenerates identical
+            # node/parameter names even if it was auto-generated
+            if cfg["out"] == -1:
+                kwargs["name"] = node.name
+            lc.config_json = _canonical_json(
+                {k: _encode(v, node.name) for k, v in kwargs.items()}
+            )
+            lc.output_index = cfg["out"]
+            lc.call_id = call_renumber.setdefault(
+                cfg["call_id"], len(call_renumber)
+            )
+        if "device" in node.meta:
+            lc.device = str(node.meta["device"])
+    for pname in sorted(topology.param_specs):
+        spec = topology.param_specs[pname]
+        a = spec.attr
+        mc.parameters.add(
+            name=spec.name,
+            shape=list(spec.shape),
+            init=a.init or "",
+            initial_mean=a.initial_mean,
+            initial_std=a.initial_std or 0.0,
+            learning_rate=a.learning_rate,
+            l2_decay=a.l2_decay,
+            is_static=a.is_static,
+            sparse_grad=a.sparse_grad,
+            is_state=spec.is_state,
+        )
+    mc.input_layer_names.extend(l.name for l in topology.data_layers)
+    mc.output_layer_names.extend(topology.output_names())
+    return mc
+
+
+# ---------------------------------------------------------------------------
+# rebuild
+# ---------------------------------------------------------------------------
+
+
+def _constructor(fn_name: str) -> Callable:
+    import paddle_tpu.nn as nn
+
+    fn = getattr(nn, fn_name, None)
+    if fn is None or not callable(fn):
+        raise ConfigError(f"unknown layer constructor {fn_name!r} in config")
+    return fn
+
+
+def build_topology(mc: pb.ModelConfig) -> Topology:
+    """Rebuild a Topology by replaying the recorded constructor calls."""
+    from paddle_tpu.nn.graph import reset_naming
+
+    reset_naming()
+    env: Dict[str, LayerOutput] = {}
+    # group multi-output calls so each constructor runs once
+    done_calls: Dict[int, Any] = {}
+    for lc in mc.layers:
+        if lc.name in env:
+            continue
+        if not lc.config_json:
+            raise ConfigError(f"layer {lc.name!r} has no recorded constructor")
+        if lc.output_index >= 0 and lc.call_id in done_calls:
+            out = done_calls[lc.call_id][lc.output_index]
+            _check_rebuilt(lc, out)
+            env[lc.name] = out
+            continue
+        kwargs = {
+            k: _decode(v, env) for k, v in json.loads(lc.config_json).items()
+        }
+        fn = _constructor(lc.type)
+        out = fn(**kwargs)
+        if lc.output_index >= 0:
+            done_calls[lc.call_id] = out
+            out = out[lc.output_index]
+        _check_rebuilt(lc, out)
+        env[lc.name] = out
+        if lc.device:
+            out.meta["device"] = lc.device
+    missing = [n for n in mc.output_layer_names if n not in env]
+    if missing:
+        raise ConfigError(f"config outputs {missing} were not rebuilt")
+    topo = Topology([env[n] for n in mc.output_layer_names])
+    _check_params(mc, topo)
+    return topo
+
+
+def _check_rebuilt(lc, out: LayerOutput) -> None:
+    if out.name != lc.name:
+        raise ConfigError(
+            f"replaying {lc.type!r} produced node {out.name!r}, expected "
+            f"{lc.name!r} — constructor does not honor the name argument"
+        )
+    if out.size != lc.size:
+        raise ConfigError(
+            f"layer {lc.name!r}: rebuilt size {out.size} != recorded {lc.size}"
+        )
+
+
+def _check_params(mc: pb.ModelConfig, topo: Topology) -> None:
+    rebuilt = {n: tuple(s.shape) for n, s in topo.param_specs.items()}
+    recorded = {p.name: tuple(p.shape) for p in mc.parameters}
+    if rebuilt != recorded:
+        only_new = sorted(set(rebuilt) - set(recorded))
+        only_old = sorted(set(recorded) - set(rebuilt))
+        diff = [
+            f"{n}: {recorded[n]} -> {rebuilt[n]}"
+            for n in recorded
+            if n in rebuilt and rebuilt[n] != recorded[n]
+        ]
+        raise ConfigError(
+            "rebuilt parameters disagree with config: "
+            f"missing={only_old} extra={only_new} reshaped={diff}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# text form (golden tests) + trainer config
+# ---------------------------------------------------------------------------
+
+
+def protostr(msg) -> str:
+    return text_format.MessageToString(msg)
+
+
+def parse_protostr(text: str, msg_cls=pb.ModelConfig):
+    msg = msg_cls()
+    text_format.Parse(text, msg)
+    return msg
+
+
+def dump_trainer_config(
+    topology: Topology,
+    optimizer,
+    *,
+    batch_size: int = 0,
+    num_passes: int = 0,
+    seed: int = 0,
+    save_dir: str = "",
+    mesh=None,
+    name: str = "model",
+) -> pb.TrainerConfig:
+    tc = pb.TrainerConfig(
+        batch_size=batch_size, num_passes=num_passes, seed=seed, save_dir=save_dir
+    )
+    tc.model.CopyFrom(dump_model_config(topology, name))
+    oc = tc.optimizer
+    oc.type = type(optimizer).__name__
+    hyper = {}
+    for f in dataclasses.fields(optimizer):
+        v = getattr(optimizer, f.name)
+        if f.name in ("learning_rate_schedule", "schedule_args"):
+            continue
+        if isinstance(v, (bool, int, float, str)) :
+            hyper[f.name] = v
+    oc.config_json = _canonical_json(hyper)
+    oc.schedule = optimizer.learning_rate_schedule
+    oc.schedule_json = _canonical_json(optimizer.schedule_args)
+    oc.clip = "global_norm" if optimizer.gradient_clipping_threshold > 0 else ""
+    oc.clip_threshold = optimizer.gradient_clipping_threshold
+    if mesh is not None:
+        tc.mesh_axes.extend(mesh.axis_names)
+        tc.mesh_shape.extend(mesh.devices.shape)
+    return tc
+
+
+def build_optimizer(oc: pb.OptimizerConf):
+    from paddle_tpu.param.optimizers import OPTIMIZERS
+
+    cls = None
+    for name in OPTIMIZERS.names():
+        c = OPTIMIZERS.get(name)
+        if c.__name__ == oc.type:
+            cls = c
+            break
+    if cls is None:
+        raise ConfigError(f"unknown optimizer type {oc.type!r}")
+    kwargs = json.loads(oc.config_json) if oc.config_json else {}
+    opt = cls(**kwargs)
+    opt.learning_rate_schedule = oc.schedule or "constant"
+    opt.schedule_args = json.loads(oc.schedule_json) if oc.schedule_json else {}
+    return opt

@@ -815,3 +815,10 @@ def sum_cost(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
         return jnp.sum(a.value)
 
     return _cost_layer(name, "sum_cost", [input], fn)
+
+
+# record constructor calls on returned nodes so Topologies serialize to
+# ModelConfig protos (paddle_tpu/config) — the config_parser analog
+from paddle_tpu.config.capture import wrap_module as _wrap_module
+
+_wrap_module(globals(), __all__)

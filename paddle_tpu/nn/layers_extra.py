@@ -342,3 +342,8 @@ def eos_trim(input: LayerOutput, *, eos_id: int = 1,
         return Act(value=ids * mask.astype(ids.dtype), lengths=new_len, mask=mask)
 
     return LayerOutput(name, "eos_trim", input.size, [input], forward, [])
+
+
+from paddle_tpu.config.capture import wrap_module as _wrap_module
+
+_wrap_module(globals(), __all__)

@@ -1,0 +1,9 @@
+"""Wire/config schemas (protobuf) — analog of the reference's paddle/proto
+(ModelConfig.proto, TrainerConfig.proto, ParameterConfig.proto).
+
+`model_config_pb2` is generated from `model_config.proto`; regenerate with
+``protoc --python_out=. paddle_tpu/proto/model_config.proto`` from the repo
+root.
+"""
+
+from paddle_tpu.proto import model_config_pb2  # noqa: F401

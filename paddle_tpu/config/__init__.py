@@ -4,32 +4,39 @@ plus proto/ModelConfig.proto (SURVEY.md §1.10, §2 items 44/49).
 Serialize a built ``Topology`` to a ModelConfig protobuf, golden-test its
 deterministic text form, and rebuild an equivalent Topology in a fresh
 process — the basis of the deploy bundle (config + params in one file).
+
+Submodules are loaded lazily (PEP 562): ``paddle_tpu.nn`` imports
+``config.capture`` at module-bottom, and an eager package __init__ would drag
+config_parser/deploy (protobuf, zipfile) into that import and create a real
+nn ⇄ config cycle.
 """
 
-from paddle_tpu.config.deploy import (
-    InferenceModel,
-    load_inference_model,
-    merge_model,
-)
-from paddle_tpu.config.config_parser import (
-    SerializationError,
-    build_optimizer,
-    build_topology,
-    dump_model_config,
-    dump_trainer_config,
-    parse_protostr,
-    protostr,
-)
+_EXPORTS = {
+    "SerializationError": "config_parser",
+    "build_optimizer": "config_parser",
+    "build_topology": "config_parser",
+    "dump_model_config": "config_parser",
+    "dump_trainer_config": "config_parser",
+    "parse_protostr": "config_parser",
+    "protostr": "config_parser",
+    "InferenceModel": "deploy",
+    "load_inference_model": "deploy",
+    "merge_model": "deploy",
+    "configurable": "capture",
+    "wrap_module": "capture",
+}
 
-__all__ = [
-    "InferenceModel",
-    "load_inference_model",
-    "merge_model",
-    "SerializationError",
-    "build_optimizer",
-    "build_topology",
-    "dump_model_config",
-    "dump_trainer_config",
-    "parse_protostr",
-    "protostr",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'paddle_tpu.config' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"paddle_tpu.config.{mod}"), name)
+
+
+def __dir__():
+    return __all__

@@ -12,6 +12,14 @@ like ``bidirectional_rnn`` expand into primitive calls, mirroring how the
 reference's composites expand into primitive layer protos).  Raw kwargs are
 stored as-is — JSON canonicalization happens at serialize time in
 config_parser, so building graphs stays zero-overhead and unrestricted.
+
+Known limitation: for constructors that return SEVERAL nodes, recorded names
+cannot be forced back through a ``name=`` kwarg on replay, so rebuild relies
+on the constructor regenerating the same auto-names under fresh counters
+(build_topology replays inside a naming_scope).  All current serializable
+constructors are single-output; a multi-output one whose auto-names were
+offset at record time will fail rebuild with a clear ConfigError rather than
+mis-wire.
 """
 
 from __future__ import annotations

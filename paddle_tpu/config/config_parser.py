@@ -117,11 +117,11 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
     mc = pb.ModelConfig(name=name, framework_version=paddle_tpu.__version__)
     from paddle_tpu.ops.numerics import compute_dtype
 
-    mc.dtype_policy = str(np.dtype(compute_dtype())) if compute_dtype() else ""
+    mc.dtype_policy = str(np.dtype(compute_dtype()))
     call_renumber: Dict[int, int] = {}  # process-global call ids -> dump-local
     for node in topology.layers:
         cfg = node.meta.get("config")
-        if cfg is None and not node.is_data:
+        if cfg is None:
             raise SerializationError(
                 f"layer {node.name!r} (type {node.layer_type!r}) was not built "
                 "by a recorded DSL constructor and cannot be serialized "
@@ -129,23 +129,20 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
             )
         lc = mc.layers.add(
             name=node.name,
-            type=(cfg["fn"] if cfg else node.layer_type),
+            type=cfg["fn"],
             size=int(node.size),
             inputs=[p.name for p in node.parents],
         )
-        if cfg:
-            kwargs = dict(cfg["kwargs"])
-            # force the recorded name so replay regenerates identical
-            # node/parameter names even if it was auto-generated
-            if cfg["out"] == -1:
-                kwargs["name"] = node.name
-            lc.config_json = _canonical_json(
-                {k: _encode(v, node.name) for k, v in kwargs.items()}
-            )
-            lc.output_index = cfg["out"]
-            lc.call_id = call_renumber.setdefault(
-                cfg["call_id"], len(call_renumber)
-            )
+        kwargs = dict(cfg["kwargs"])
+        # force the recorded name so replay regenerates identical
+        # node/parameter names even if it was auto-generated
+        if cfg["out"] == -1:
+            kwargs["name"] = node.name
+        lc.config_json = _canonical_json(
+            {k: _encode(v, node.name) for k, v in kwargs.items()}
+        )
+        lc.output_index = cfg["out"]
+        lc.call_id = call_renumber.setdefault(cfg["call_id"], len(call_renumber))
         if "device" in node.meta:
             lc.device = str(node.meta["device"])
     for pname in sorted(topology.param_specs):
@@ -183,39 +180,45 @@ def _constructor(fn_name: str) -> Callable:
 
 
 def build_topology(mc: pb.ModelConfig) -> Topology:
-    """Rebuild a Topology by replaying the recorded constructor calls."""
-    from paddle_tpu.nn.graph import reset_naming
+    """Rebuild a Topology by replaying the recorded constructor calls.
 
-    reset_naming()
-    env: Dict[str, LayerOutput] = {}
-    # group multi-output calls so each constructor runs once
-    done_calls: Dict[int, Any] = {}
-    for lc in mc.layers:
-        if lc.name in env:
-            continue
-        if not lc.config_json:
-            raise ConfigError(f"layer {lc.name!r} has no recorded constructor")
-        if lc.output_index >= 0 and lc.call_id in done_calls:
-            out = done_calls[lc.call_id][lc.output_index]
+    Replay runs inside a ``naming_scope`` so the caller's in-progress
+    auto-name counters are untouched.
+    """
+    from paddle_tpu.nn.graph import naming_scope
+
+    with naming_scope():
+        env: Dict[str, LayerOutput] = {}
+        # group multi-output calls so each constructor runs once
+        done_calls: Dict[int, Any] = {}
+        for lc in mc.layers:
+            if lc.name in env:
+                continue
+            if not lc.config_json:
+                raise ConfigError(f"layer {lc.name!r} has no recorded constructor")
+            if lc.output_index >= 0 and lc.call_id in done_calls:
+                out = done_calls[lc.call_id][lc.output_index]
+                _check_rebuilt(lc, out)
+                env[lc.name] = out
+                if lc.device:
+                    out.meta["device"] = lc.device
+                continue
+            kwargs = {
+                k: _decode(v, env) for k, v in json.loads(lc.config_json).items()
+            }
+            fn = _constructor(lc.type)
+            out = fn(**kwargs)
+            if lc.output_index >= 0:
+                done_calls[lc.call_id] = out
+                out = out[lc.output_index]
             _check_rebuilt(lc, out)
             env[lc.name] = out
-            continue
-        kwargs = {
-            k: _decode(v, env) for k, v in json.loads(lc.config_json).items()
-        }
-        fn = _constructor(lc.type)
-        out = fn(**kwargs)
-        if lc.output_index >= 0:
-            done_calls[lc.call_id] = out
-            out = out[lc.output_index]
-        _check_rebuilt(lc, out)
-        env[lc.name] = out
-        if lc.device:
-            out.meta["device"] = lc.device
-    missing = [n for n in mc.output_layer_names if n not in env]
-    if missing:
-        raise ConfigError(f"config outputs {missing} were not rebuilt")
-    topo = Topology([env[n] for n in mc.output_layer_names])
+            if lc.device:
+                out.meta["device"] = lc.device
+        missing = [n for n in mc.output_layer_names if n not in env]
+        if missing:
+            raise ConfigError(f"config outputs {missing} were not rebuilt")
+        topo = Topology([env[n] for n in mc.output_layer_names])
     _check_params(mc, topo)
     return topo
 

@@ -20,6 +20,7 @@ import zipfile
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.config.config_parser import build_topology, dump_model_config
@@ -32,8 +33,10 @@ _MAGIC = "paddle_tpu.bundle.v1"
 
 
 def _npz_bytes(tree: Dict[str, Any]) -> bytes:
+    from paddle_tpu.trainer.checkpoint import npz_safe
+
     buf = io.BytesIO()
-    np.savez_compressed(buf, **{k: np.asarray(v) for k, v in tree.items()})
+    np.savez_compressed(buf, **{k: npz_safe(v) for k, v in tree.items()})
     return buf.getvalue()
 
 
@@ -52,12 +55,21 @@ def merge_model(
 ) -> str:
     """Write config + parameters as one deployable file."""
     mc = dump_model_config(topology, name)
+    need = {n for n, s in topology.param_specs.items() if not s.is_state}
+    missing = sorted(need - set(params))
+    if missing:
+        raise ValueError(f"merge_model: params dict is missing {missing}")
+    need_state = {n for n, s in topology.param_specs.items() if s.is_state}
+    missing_state = sorted(need_state - set(state or {}))
+    if missing_state:
+        raise ValueError(f"merge_model: state dict is missing {missing_state}")
     manifest = {
+        **(meta or {}),
+        # reserved keys win over user meta
         "magic": _MAGIC,
         "name": name,
         "outputs": list(mc.output_layer_names),
         "inputs": list(mc.input_layer_names),
-        **(meta or {}),
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
@@ -76,14 +88,42 @@ class InferenceModel:
         self.model_config = mc
         self.topology = build_topology(mc)
         self.manifest = manifest
-        # cast to the topology's parameter dtype so bf16 policies hold
-        init_p, init_s = self.topology.init(jax.random.PRNGKey(0))
+        if mc.dtype_policy:
+            from paddle_tpu.ops.numerics import compute_dtype
+            from paddle_tpu.utils import logger
+
+            local = str(np.dtype(compute_dtype()))
+            if local != mc.dtype_policy:
+                logger.warning(
+                    "model bundle %r was exported under compute_dtype=%s but "
+                    "this process uses %s — predictions may differ from "
+                    "training; set FLAGS.compute_dtype=%r to match",
+                    manifest.get("name", "?"), mc.dtype_policy, local,
+                    mc.dtype_policy,
+                )
+        # abstract init: learn names/dtypes without materializing random
+        # weights, then place loaded arrays on device once (resident across
+        # infer() calls), cast to the topology's parameter dtype
+        init_p, init_s = jax.eval_shape(
+            lambda k: self.topology.init(k), jax.random.PRNGKey(0)
+        )
+        missing = sorted(set(init_p) - set(params))
+        if missing:
+            raise ValueError(
+                f"model bundle is missing parameters {missing} — was it "
+                "written by an older/incompatible build?"
+            )
+        missing_state = sorted(set(init_s) - set(state))
+        if missing_state:
+            raise ValueError(
+                f"model bundle is missing state arrays {missing_state}"
+            )
         self.params = {
-            k: np.asarray(params[k], dtype=np.asarray(v).dtype)
+            k: jax.device_put(jnp.asarray(params[k], dtype=v.dtype))
             for k, v in init_p.items()
         }
         self.state = {
-            k: np.asarray(state.get(k, np.asarray(v)), dtype=np.asarray(v).dtype)
+            k: jax.device_put(jnp.asarray(state[k], dtype=v.dtype))
             for k, v in init_s.items()
         }
         self._fns: Dict[tuple, Any] = {}

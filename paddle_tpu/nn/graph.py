@@ -162,6 +162,21 @@ def reset_naming() -> None:
     _naming.counters = {}
 
 
+class naming_scope:
+    """Context manager: fresh auto-name counters inside, caller's counters
+    restored on exit — so config replay (build_topology) can't perturb a
+    user's in-progress graph building."""
+
+    def __enter__(self):
+        self._saved = getattr(_naming, "counters", {})
+        _naming.counters = {}
+        return self
+
+    def __exit__(self, *exc):
+        _naming.counters = self._saved
+        return False
+
+
 @dataclass
 class LayerOutput:
     """Symbolic node in the layer DAG (the config-time analog of the

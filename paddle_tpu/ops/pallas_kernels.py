@@ -50,7 +50,7 @@ def _interpret() -> bool:
 
 
 def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
-                 h_scr, c_scr, *, hidden: int):
+                 h_scr, c_scr, *, hidden: int, mxu_dtype):
     from jax.experimental import pallas as pl
 
     t = pl.program_id(0)
@@ -64,7 +64,11 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
     h = h_scr[...]
     c = c_scr[...]
     xp = xp_ref[0]                          # [B, 4H]
-    z = xp + jnp.dot(h, wh_ref[...], preferred_element_type=jnp.float32)
+    # matmul operands follow the framework's compute-dtype policy (bf16 by
+    # default) so this kernel computes the same function as the lax.scan
+    # path (linear()/mxu_cast) that the custom_vjp backward differentiates
+    z = xp + jnp.dot(h.astype(mxu_dtype), wh_ref[...].astype(mxu_dtype),
+                     preferred_element_type=jnp.float32)
     H = hidden
     i = jax.nn.sigmoid(z[:, :H])
     f = jax.nn.sigmoid(z[:, H : 2 * H])
@@ -78,7 +82,10 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
     c_new = jnp.where(keep, c_new, c)
     h_scr[...] = h_new
     c_scr[...] = c_new
-    hseq_ref[0] = h_new
+    # padded steps emit zeros (carry is held in scratch) — identical output
+    # semantics to scan_rnn, so the recompute-backward differentiates the
+    # same function the forward computes
+    hseq_ref[0] = h_new * m
 
     @pl.when(t == T - 1)
     def _fin():
@@ -90,9 +97,12 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from paddle_tpu.ops.numerics import compute_dtype
+
     T, B, H4 = xp_tb.shape
     H = H4 // 4
-    kernel = functools.partial(_lstm_kernel, hidden=H)
+    kernel = functools.partial(_lstm_kernel, hidden=H,
+                               mxu_dtype=compute_dtype())
     return pl.pallas_call(
         kernel,
         grid=(T,),
@@ -120,8 +130,13 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
 
 
 def _lstm_reference(xp, mask, w_h):
-    """Pure-JAX twin (same math) used for the custom_vjp backward."""
+    """Pure-JAX twin (same math, same f32 compute dtype) used for the
+    custom_vjp backward; differentiating through the entry casts yields
+    gradients in the caller's original dtypes."""
     from paddle_tpu.ops.rnn import lstm_step, scan_rnn
+
+    xp = xp.astype(jnp.float32)
+    w_h = w_h.astype(jnp.float32)
 
     def step(carry, xp_t):
         h, c = carry
@@ -130,7 +145,7 @@ def _lstm_reference(xp, mask, w_h):
 
     B = xp.shape[0]
     H = w_h.shape[0]
-    z = jnp.zeros((B, H), xp.dtype)
+    z = jnp.zeros((B, H), jnp.float32)
     (h_f, c_f), h_seq = scan_rnn(step, (z, z), xp, mask)
     return h_seq, h_f, c_f
 
@@ -138,7 +153,9 @@ def _lstm_reference(xp, mask, w_h):
 @jax.custom_vjp
 def lstm_forward_pallas(xp, mask, w_h):
     """xp: [B,T,4H] input projection (+bias), mask [B,T], w_h [H,4H].
-    Returns (h_seq [B,T,H], h_final, c_final). No peepholes (gated upstream)."""
+    Returns (h_seq [B,T,H], h_final, c_final), always float32; h_seq is zero
+    at padded timesteps (same semantics as the scan path). No peepholes
+    (gated upstream)."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
     h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
@@ -165,7 +182,8 @@ lstm_forward_pallas.defvjp(_lstm_fwd, _lstm_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *, hidden: int):
+def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *,
+                hidden: int, mxu_dtype):
     from jax.experimental import pallas as pl
 
     t = pl.program_id(0)
@@ -178,18 +196,20 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *, hidden: int
     h = h_scr[...]
     H = hidden
     xp = xp_ref[0]                                      # [B, 3H]
-    w = wh_ref[...]                                     # [H, 3H]
-    zr = xp[:, : 2 * H] + jnp.dot(h, w[:, : 2 * H],
+    w = wh_ref[...].astype(mxu_dtype)                   # [H, 3H]
+    hc = h.astype(mxu_dtype)
+    zr = xp[:, : 2 * H] + jnp.dot(hc, w[:, : 2 * H],
                                   preferred_element_type=jnp.float32)
     r = jax.nn.sigmoid(zr[:, :H])
     u = jax.nn.sigmoid(zr[:, H:])
-    cand = jnp.tanh(xp[:, 2 * H :] + jnp.dot(r * h, w[:, 2 * H :],
+    cand = jnp.tanh(xp[:, 2 * H :] + jnp.dot((r * h).astype(mxu_dtype),
+                                             w[:, 2 * H :],
                                              preferred_element_type=jnp.float32))
     h_new = u * h + (1.0 - u) * cand
     m = m_ref[0]
     h_new = jnp.where(m > 0, h_new, h)
     h_scr[...] = h_new
-    hseq_ref[0] = h_new
+    hseq_ref[0] = h_new * m
 
     @pl.when(t == T - 1)
     def _fin():
@@ -200,9 +220,12 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from paddle_tpu.ops.numerics import compute_dtype
+
     T, B, H3 = xp_tb.shape
     H = H3 // 3
-    kernel = functools.partial(_gru_kernel, hidden=H)
+    kernel = functools.partial(_gru_kernel, hidden=H,
+                               mxu_dtype=compute_dtype())
     return pl.pallas_call(
         kernel,
         grid=(T,),
@@ -227,19 +250,23 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h):
 def _gru_reference(xp, mask, w_h):
     from paddle_tpu.ops.rnn import gru_step, scan_rnn
 
+    xp = xp.astype(jnp.float32)
+    w_h = w_h.astype(jnp.float32)
+
     def step(h, xp_t):
         h2 = gru_step(xp_t, h, w_h)
         return h2, h2
 
     B = xp.shape[0]
     H = w_h.shape[0]
-    h_f, h_seq = scan_rnn(step, jnp.zeros((B, H), xp.dtype), xp, mask)
+    h_f, h_seq = scan_rnn(step, jnp.zeros((B, H), jnp.float32), xp, mask)
     return h_seq, h_f
 
 
 @jax.custom_vjp
 def gru_forward_pallas(xp, mask, w_h):
-    """xp: [B,T,3H], mask [B,T], w_h [H,3H] -> (h_seq [B,T,H], h_final)."""
+    """xp: [B,T,3H], mask [B,T], w_h [H,3H] -> (h_seq [B,T,H], h_final),
+    always float32; h_seq is zero at padded timesteps."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
     h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))

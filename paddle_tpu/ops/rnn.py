@@ -39,14 +39,18 @@ __all__ = [
 ]
 
 
-def _use_pallas_rnn(h0, c0, peep_i, peep_f, peep_o, act, gate_act, state_act,
-                    reverse) -> bool:
+def _use_pallas_rnn(batch, hidden, h0, c0, peep_i, peep_f, peep_o, act,
+                    gate_act, state_act, reverse) -> bool:
     """Fused Pallas time-loop kernel is used on TPU for the default cell
     (no peepholes/boot state/custom activations/reverse — those take the
-    general lax.scan path)."""
+    general lax.scan path) and only for tile-aligned shapes: the kernel
+    slices gate blocks out of [B, gates*H], so H must fill whole 128-lane
+    tiles and B whole 8-sublane tiles or Mosaic rejects the lowering."""
     if any(p is not None for p in (h0, c0, peep_i, peep_f, peep_o)) or reverse:
         return False
     if (act, gate_act, state_act) != ("tanh", "sigmoid", "tanh"):
+        return False
+    if hidden % 128 != 0 or batch % 8 != 0:
         return False
     from paddle_tpu.utils.flags import FLAGS
 
@@ -137,12 +141,11 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 4H]
-    if _use_pallas_rnn(h0, c0, peep_i, peep_f, peep_o, act, gate_act, state_act,
-                       reverse):
+    if _use_pallas_rnn(B, H, h0, c0, peep_i, peep_f, peep_o, act, gate_act,
+                       state_act, reverse):
         from paddle_tpu.ops.pallas_kernels import lstm_forward_pallas
 
         h_seq, h_fin, c_fin = lstm_forward_pallas(xp, mask, w_h)
-        h_seq = h_seq * mask[..., None].astype(h_seq.dtype)
         return h_seq, (h_fin, c_fin)
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
     c0 = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
@@ -169,11 +172,11 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 3H]
-    if _use_pallas_rnn(h0, None, None, None, None, act, gate_act, "tanh", reverse):
+    if _use_pallas_rnn(B, H, h0, None, None, None, None, act, gate_act,
+                       "tanh", reverse):
         from paddle_tpu.ops.pallas_kernels import gru_forward_pallas
 
         h_seq, h_fin = gru_forward_pallas(xp, mask, w_h)
-        h_seq = h_seq * mask[..., None].astype(h_seq.dtype)
         return h_seq, h_fin
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
 

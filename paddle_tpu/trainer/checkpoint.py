@@ -21,14 +21,25 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-__all__ = ["save_pytree", "load_pytree", "save_checkpoint", "load_checkpoint", "latest_pass"]
+__all__ = ["save_pytree", "load_pytree", "save_checkpoint", "load_checkpoint",
+           "latest_pass", "npz_safe"]
+
+
+def npz_safe(a) -> np.ndarray:
+    """npz cannot represent ml_dtypes (bfloat16 etc. round-trip as raw void
+    bytes and fail to load) — store such arrays as float32; loaders cast back
+    to the target dtype, and bf16 -> f32 is lossless."""
+    arr = np.asarray(a)
+    if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
+        return arr.astype(np.float32)
+    return arr
 
 
 def _flatten(tree) -> Dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = jax.tree_util.keystr(path)
-        flat[key] = np.asarray(leaf)
+        flat[key] = npz_safe(leaf)
     return flat
 
 

@@ -1,0 +1,22 @@
+"""Regenerate golden protostr files. Run deliberately, review the git diff:
+
+    JAX_PLATFORMS=cpu PADDLE_TPU_COMPUTE_DTYPE=float32 python tests/golden/regen.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from test_config import _simple_net  # noqa: E402
+
+from paddle_tpu.config import dump_model_config, protostr  # noqa: E402
+
+mc = dump_model_config(_simple_net(), "simple_net")
+mc.framework_version = ""
+mc.dtype_policy = ""
+out = os.path.join(os.path.dirname(__file__), "simple_net.protostr")
+with open(out, "w") as f:
+    f.write(protostr(mc))
+print("wrote", out)

@@ -1,5 +1,9 @@
 """Pallas fused RNN kernels (interpret mode on CPU) vs the lax.scan reference —
-the device-equivalence pattern of the reference's math tests."""
+the device-equivalence pattern of the reference's math tests.
+
+The scan twins are imported from pallas_kernels itself (_lstm_reference /
+_gru_reference) so the cell math has exactly one source of truth.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -8,6 +12,8 @@ import pytest
 
 import paddle_tpu.ops as O
 from paddle_tpu.ops.pallas_kernels import (
+    _gru_reference,
+    _lstm_reference,
     gru_forward_pallas,
     lstm_forward_pallas,
     pallas_available,
@@ -16,30 +22,21 @@ from paddle_tpu.ops.pallas_kernels import (
 pytestmark = pytest.mark.skipif(not pallas_available(), reason="pallas unavailable")
 
 
-def _data(rng, B=4, T=6, H=8, gates=4):
-    xp = jnp.asarray(rng.randn(B, T, gates * H).astype(np.float32) * 0.3)
+def _data(rng, B=4, T=6, H=8, gates=4, dtype=np.float32):
+    xp = jnp.asarray(rng.randn(B, T, gates * H).astype(dtype) * 0.3)
     lengths = jnp.asarray(np.array([6, 3, 5, 1], np.int32)[:B])
     mask = O.mask_from_lengths(lengths, T)
-    w_h = jnp.asarray(rng.randn(H, gates * H).astype(np.float32) * 0.2)
+    w_h = jnp.asarray(rng.randn(H, gates * H).astype(dtype) * 0.2)
     return xp, mask, w_h
 
 
 def test_lstm_pallas_matches_scan(rng):
     xp, mask, w_h = _data(rng)
     h_seq_p, h_f_p, c_f_p = lstm_forward_pallas(xp, mask, w_h)
-
-    from paddle_tpu.ops.rnn import lstm_step, scan_rnn
-
-    def step(carry, xp_t):
-        h, c = carry
-        h2, c2 = lstm_step(xp_t, h, c, w_h)
-        return (h2, c2), h2
-
-    B, H = xp.shape[0], w_h.shape[0]
-    z = jnp.zeros((B, H))
-    (h_f, c_f), h_seq = scan_rnn(step, (z, z), xp, mask)
-    np.testing.assert_allclose(np.asarray(h_seq_p) * np.asarray(mask)[..., None],
-                               np.asarray(h_seq), rtol=1e-5, atol=1e-6)
+    h_seq, h_f, c_f = _lstm_reference(xp, mask, w_h)
+    # identical semantics including zeros at padded timesteps
+    np.testing.assert_allclose(np.asarray(h_seq_p), np.asarray(h_seq),
+                               rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(h_f_p), np.asarray(h_f), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(c_f_p), np.asarray(c_f), rtol=1e-5, atol=1e-6)
 
@@ -47,41 +44,69 @@ def test_lstm_pallas_matches_scan(rng):
 def test_gru_pallas_matches_scan(rng):
     xp, mask, w_h = _data(rng, gates=3)
     h_seq_p, h_f_p = gru_forward_pallas(xp, mask, w_h)
-
-    from paddle_tpu.ops.rnn import gru_step, scan_rnn
-
-    def step(h, xp_t):
-        h2 = gru_step(xp_t, h, w_h)
-        return h2, h2
-
-    B, H = xp.shape[0], w_h.shape[0]
-    h_f, h_seq = scan_rnn(step, jnp.zeros((B, H)), xp, mask)
-    np.testing.assert_allclose(np.asarray(h_seq_p) * np.asarray(mask)[..., None],
-                               np.asarray(h_seq), rtol=1e-5, atol=1e-6)
+    h_seq, h_f = _gru_reference(xp, mask, w_h)
+    np.testing.assert_allclose(np.asarray(h_seq_p), np.asarray(h_seq),
+                               rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(h_f_p), np.asarray(h_f), rtol=1e-5, atol=1e-6)
 
 
 def test_lstm_pallas_grad_matches_scan(rng):
     xp, mask, w_h = _data(rng)
 
+    def weighted(h_seq, h_f):
+        w = jnp.cos(jnp.arange(h_seq.size).reshape(h_seq.shape))
+        return jnp.sum(h_seq * w) + jnp.sum(h_f)
+
     def loss_p(xp, w_h):
         h_seq, h_f, _ = lstm_forward_pallas(xp, mask, w_h)
-        return jnp.sum(h_seq * jnp.cos(jnp.arange(h_seq.size).reshape(h_seq.shape))) + jnp.sum(h_f)
-
-    from paddle_tpu.ops.rnn import lstm_step, scan_rnn
+        return weighted(h_seq, h_f)
 
     def loss_s(xp, w_h):
-        def step(carry, xp_t):
-            h, c = carry
-            h2, c2 = lstm_step(xp_t, h, c, w_h)
-            return (h2, c2), h2
-
-        B, H = xp.shape[0], w_h.shape[0]
-        z = jnp.zeros((B, H))
-        (h_f, _), h_seq = scan_rnn(step, (z, z), xp, mask)
-        return jnp.sum(h_seq * jnp.cos(jnp.arange(h_seq.size).reshape(h_seq.shape))) + jnp.sum(h_f)
+        h_seq, h_f, _ = _lstm_reference(xp, mask, w_h)
+        return weighted(h_seq, h_f)
 
     gp = jax.grad(loss_p, argnums=(0, 1))(xp, w_h)
     gs = jax.grad(loss_s, argnums=(0, 1))(xp, w_h)
     for a, b in zip(gp, gs):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_pallas_grad_bfloat16(rng):
+    """bf16 inputs must flow through forward and backward (grads in bf16)."""
+    xp, mask, w_h = _data(rng, dtype=np.float32)
+    xp, w_h = xp.astype(jnp.bfloat16), w_h.astype(jnp.bfloat16)
+
+    def loss(xp, w_h):
+        h_seq, h_f, _ = lstm_forward_pallas(xp, mask, w_h)
+        return jnp.sum(h_seq) + jnp.sum(h_f)
+
+    d_xp, d_wh = jax.grad(loss, argnums=(0, 1))(xp, w_h)
+    assert d_xp.dtype == jnp.bfloat16 and d_wh.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(d_xp, np.float32)).all()
+    f32 = jax.grad(
+        lambda a, b: loss(a.astype(jnp.float32), b.astype(jnp.float32)),
+        argnums=(0, 1),
+    )(xp.astype(jnp.float32), w_h.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(d_xp, np.float32), np.asarray(f32[0]),
+                               rtol=0.1, atol=0.05)
+
+
+def test_lstm_pallas_matches_scan_bf16_policy(rng):
+    """Under the production compute_dtype=bfloat16 policy the kernel must
+    compute the same function as the scan path it shares gradients with."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    xp, mask, w_h = _data(rng)
+    old = FLAGS.compute_dtype
+    FLAGS.compute_dtype = "bfloat16"
+    try:
+        h_seq_p, h_f_p, c_f_p = lstm_forward_pallas(xp, mask, w_h)
+        h_seq, h_f, c_f = _lstm_reference(xp, mask, w_h)
+    finally:
+        FLAGS.compute_dtype = old
+    np.testing.assert_allclose(np.asarray(h_seq_p), np.asarray(h_seq),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_f_p), np.asarray(h_f),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_f_p), np.asarray(c_f),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,298 @@
+// C inference API — analog of the reference's paddle/capi tier
+// (capi/gradient_machine.h:27-59: create_for_inference,
+// load_parameter_from_disk, forward; opaque handles capi/capi.h).
+//
+// The reference exposes its C++ inference engine through a pure-C surface so
+// trained models deploy into non-C++ hosts.  Here the engine is the
+// JAX-backed InferenceModel (paddle_tpu/config/deploy.py) serving a merged
+// bundle (config proto + params); this file embeds CPython — the same
+// technique the reference itself uses for config parsing
+// (TrainerConfigHelper.cpp:33-54 via utils/PythonUtil.h) — and drives
+// load_inference_model/infer behind opaque C handles.  XLA does the actual
+// compute, so the C host gets jitted TPU/CPU inference with zero Python in
+// its own code.
+//
+// Build:
+//   g++ -O2 -shared -fPIC -std=c++17 csrc/capi.cc \
+//       $(python3-config --includes) $(python3-config --ldflags --embed) \
+//       -o paddle_tpu/_native/libpaddletpu_capi.so
+//
+// Thread model: any thread may call any function; each entry point takes the
+// GIL (PyGILState_Ensure) and releases it on exit.
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct Model {
+  PyObject* model = nullptr;    // InferenceModel instance
+  PyObject* feed = nullptr;     // dict being assembled
+  PyObject* outputs = nullptr;  // last infer() result dict
+  PyObject* hold = nullptr;     // contiguous f32 array backing last output
+  long long shape[16];
+};
+
+thread_local std::string g_error;
+
+void set_error_from_python() {
+  PyObject *type, *value, *trace;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+class Gil {
+ public:
+  Gil() : st_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st_); }
+
+ private:
+  PyGILState_STATE st_;
+};
+
+PyObject* np_module() {
+  static PyObject* np = nullptr;
+  if (!np) np = PyImport_ImportModule("numpy");
+  return np;
+}
+
+// numpy array from raw host memory (copies, so the caller's buffer is free
+// to die after the call)
+PyObject* make_array(const char* dtype, const void* data,
+                     const long long* shape, int ndim) {
+  PyObject* np = np_module();
+  if (!np) return nullptr;
+  if (strcmp(dtype, "float32") != 0 && strcmp(dtype, "int32") != 0) {
+    g_error = std::string("unsupported dtype '") + dtype +
+              "' (use \"float32\" or \"int32\")";
+    PyErr_SetString(PyExc_ValueError, g_error.c_str());
+    return nullptr;
+  }
+  long long n = 1;
+  for (int i = 0; i < ndim; i++) n *= shape[i];
+  const size_t item = 4;  // float32 and int32 are both 4 bytes
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(n * item), PyBUF_READ);
+  if (!mem) return nullptr;
+  PyObject* flat =
+      PyObject_CallMethod(np, "frombuffer", "Os", mem, dtype);
+  Py_DECREF(mem);
+  if (!flat) return nullptr;
+  PyObject* dims = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; i++)
+    PyTuple_SET_ITEM(dims, i, PyLong_FromLongLong(shape[i]));
+  PyObject* shaped = PyObject_CallMethod(flat, "reshape", "O", dims);
+  Py_DECREF(flat);
+  Py_DECREF(dims);
+  if (!shaped) return nullptr;
+  PyObject* copy = PyObject_CallMethod(shaped, "copy", nullptr);
+  Py_DECREF(shaped);
+  return copy;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the embedded interpreter and import the framework. Returns 0 on
+// success. Idempotent. (paddle_init analog, capi/main.h)
+int paddle_tpu_init(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by Py_Initialize so other threads (and our
+    // Gil guards) can take it
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject* m = PyImport_ImportModule("paddle_tpu.config.deploy");
+  if (!m) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(m);
+  return 0;
+}
+
+const char* paddle_tpu_last_error(void) { return g_error.c_str(); }
+
+// Load a merged bundle (merge_model output). Returns NULL on failure.
+// (paddle_gradient_machine_create_for_inference +
+//  load_parameter_from_disk analog)
+void* paddle_tpu_model_load(const char* bundle_path) {
+  Gil gil;
+  PyObject* m = PyImport_ImportModule("paddle_tpu.config.deploy");
+  if (!m) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* model =
+      PyObject_CallMethod(m, "load_inference_model", "s", bundle_path);
+  Py_DECREF(m);
+  if (!model) {
+    set_error_from_python();
+    return nullptr;
+  }
+  Model* h = new Model();
+  h->model = model;
+  h->feed = PyDict_New();
+  return h;
+}
+
+void paddle_tpu_model_destroy(void* handle) {
+  if (!handle) return;
+  Gil gil;
+  Model* h = static_cast<Model*>(handle);
+  Py_XDECREF(h->model);
+  Py_XDECREF(h->feed);
+  Py_XDECREF(h->outputs);
+  Py_XDECREF(h->hold);
+  delete h;
+}
+
+// Stage one input. dtype: "float32" | "int32". lengths (may be NULL) makes
+// the feed a sequence (value, lengths) pair; n_lengths must equal shape[0].
+int paddle_tpu_feed(void* handle, const char* name, const char* dtype,
+                    const void* data, const long long* shape, int ndim,
+                    const int* lengths, int n_lengths) {
+  if (!handle || ndim < 1 || ndim > 16) {
+    g_error = "bad handle or ndim";
+    return -1;
+  }
+  Gil gil;
+  Model* h = static_cast<Model*>(handle);
+  PyObject* arr = make_array(dtype, data, shape, ndim);
+  if (!arr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* entry = arr;
+  if (lengths) {
+    long long lshape[1] = {n_lengths};
+    PyObject* larr = make_array("int32", lengths, lshape, 1);
+    if (!larr) {
+      Py_DECREF(arr);
+      set_error_from_python();
+      return -1;
+    }
+    entry = PyTuple_Pack(2, arr, larr);
+    Py_DECREF(arr);
+    Py_DECREF(larr);
+  }
+  int rc = PyDict_SetItemString(h->feed, name, entry);
+  Py_DECREF(entry);
+  if (rc != 0) set_error_from_python();
+  return rc;
+}
+
+// Run inference on the staged feed (paddle_gradient_machine_forward analog).
+// output_name may be NULL to compute the bundle's default outputs.
+int paddle_tpu_forward(void* handle, const char* output_name) {
+  if (!handle) {
+    g_error = "bad handle";
+    return -1;
+  }
+  Gil gil;
+  Model* h = static_cast<Model*>(handle);
+  PyObject* res;
+  if (output_name) {
+    PyObject* outs = PyList_New(1);
+    PyList_SET_ITEM(outs, 0, PyUnicode_FromString(output_name));
+    res = PyObject_CallMethod(h->model, "infer", "OO", h->feed, outs);
+    Py_DECREF(outs);
+  } else {
+    res = PyObject_CallMethod(h->model, "infer", "O", h->feed);
+  }
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_XDECREF(h->outputs);
+  h->outputs = res;
+  return 0;
+}
+
+// Fetch a result as float32. *data stays valid until the next forward /
+// output call or destroy.
+int paddle_tpu_output(void* handle, const char* output_name,
+                      const float** data, const long long** shape,
+                      int* ndim) {
+  if (!handle) {
+    g_error = "bad handle";
+    return -1;
+  }
+  Gil gil;
+  Model* h = static_cast<Model*>(handle);
+  if (!h->outputs) {
+    g_error = "call paddle_tpu_forward first";
+    return -1;
+  }
+  PyObject* arr = PyDict_GetItemString(h->outputs, output_name);  // borrowed
+  if (!arr) {
+    g_error = std::string("no output named '") + output_name + "'";
+    return -1;
+  }
+  PyObject* np = np_module();
+  PyObject* f32 = PyObject_CallMethod(np, "ascontiguousarray", "Os", arr,
+                                      "float32");
+  if (!f32) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_XDECREF(h->hold);
+  h->hold = f32;
+  // data pointer + shape via the ctypes/shape attributes
+  PyObject* sh = PyObject_GetAttrString(f32, "shape");
+  int nd = static_cast<int>(PyTuple_Size(sh));
+  for (int i = 0; i < nd && i < 16; i++)
+    h->shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(sh, i));
+  Py_DECREF(sh);
+  PyObject* ct = PyObject_GetAttrString(f32, "ctypes");
+  PyObject* ptr = ct ? PyObject_GetAttrString(ct, "data") : nullptr;
+  Py_XDECREF(ct);
+  if (!ptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *data = reinterpret_cast<const float*>(PyLong_AsUnsignedLongLong(ptr));
+  Py_DECREF(ptr);
+  *shape = h->shape;
+  *ndim = nd;
+  return 0;
+}
+
+// Introspection: newline-joined input/output names. Caller must free().
+char* paddle_tpu_model_info(void* handle) {
+  if (!handle) return nullptr;
+  Gil gil;
+  Model* h = static_cast<Model*>(handle);
+  PyObject* ins = PyObject_GetAttrString(h->model, "input_names");
+  PyObject* outs = PyObject_GetAttrString(h->model, "output_names");
+  std::string s = "inputs:";
+  for (Py_ssize_t i = 0; ins && i < PyList_Size(ins); i++)
+    s += std::string(" ") + PyUnicode_AsUTF8(PyList_GET_ITEM(ins, i));
+  s += "\noutputs:";
+  for (Py_ssize_t i = 0; outs && i < PyList_Size(outs); i++)
+    s += std::string(" ") + PyUnicode_AsUTF8(PyList_GET_ITEM(outs, i));
+  Py_XDECREF(ins);
+  Py_XDECREF(outs);
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+/* Smoke driver for the C inference API (csrc/capi.cc) — loads a bundle,
+ * feeds a float32 input named "x" of shape [2, dim], prints the "o" output.
+ * Usage: capi_smoke <bundle.ptz> <dim>
+ * The reference's analog is paddle/capi/examples. */
+
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int paddle_tpu_init(void);
+extern const char* paddle_tpu_last_error(void);
+extern void* paddle_tpu_model_load(const char* path);
+extern void paddle_tpu_model_destroy(void* h);
+extern char* paddle_tpu_model_info(void* h);
+extern int paddle_tpu_feed(void* h, const char* name, const char* dtype,
+                           const void* data, const long long* shape, int ndim,
+                           const int* lengths, int n_lengths);
+extern int paddle_tpu_forward(void* h, const char* output_name);
+extern int paddle_tpu_output(void* h, const char* name, const float** data,
+                             const long long** shape, int* ndim);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s bundle.ptz dim\n", argv[0]);
+    return 2;
+  }
+  int dim = atoi(argv[2]);
+  if (paddle_tpu_init() != 0) {
+    fprintf(stderr, "init failed: %s\n", paddle_tpu_last_error());
+    return 1;
+  }
+  void* m = paddle_tpu_model_load(argv[1]);
+  if (!m) {
+    fprintf(stderr, "load failed: %s\n", paddle_tpu_last_error());
+    return 1;
+  }
+  char* info = paddle_tpu_model_info(m);
+  printf("%s\n", info);
+  free(info);
+
+  float* x = (float*)malloc(sizeof(float) * 2 * dim);
+  for (int i = 0; i < 2 * dim; i++) x[i] = (float)i / (2.0f * dim);
+  long long shape[2] = {2, dim};
+  if (paddle_tpu_feed(m, "x", "float32", x, shape, 2, NULL, 0) != 0) {
+    fprintf(stderr, "feed failed: %s\n", paddle_tpu_last_error());
+    return 1;
+  }
+  if (paddle_tpu_forward(m, "o") != 0) {
+    fprintf(stderr, "forward failed: %s\n", paddle_tpu_last_error());
+    return 1;
+  }
+  const float* out;
+  const long long* oshape;
+  int ondim;
+  if (paddle_tpu_output(m, "o", &out, &oshape, &ondim) != 0) {
+    fprintf(stderr, "output failed: %s\n", paddle_tpu_last_error());
+    return 1;
+  }
+  printf("out shape:");
+  long long n = 1;
+  for (int i = 0; i < ondim; i++) {
+    printf(" %lld", oshape[i]);
+    n *= oshape[i];
+  }
+  printf("\nvalues:");
+  for (long long i = 0; i < n; i++) printf(" %.6f", out[i]);
+  printf("\n");
+
+  /* error-path probe: unknown output name must fail cleanly */
+  if (paddle_tpu_forward(m, "nope") == 0) {
+    fprintf(stderr, "expected failure for unknown output\n");
+    return 1;
+  }
+  printf("unknown-output error: %s\n", paddle_tpu_last_error());
+
+  paddle_tpu_model_destroy(m);
+  free(x);
+  return 0;
+}

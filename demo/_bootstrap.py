@@ -1,0 +1,8 @@
+"""Make `import paddle_tpu` work when demos run from a source checkout."""
+
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

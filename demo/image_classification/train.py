@@ -1,0 +1,48 @@
+"""CIFAR-10 image classification — analog of demo/image_classification
+(VGG / ResNet configs, reference demo/image_classification/vgg_16_cifar.py)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Momentum
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["resnet", "vgg"], default="resnet")
+    ap.add_argument("--depth", type=int, default=20, help="resnet depth (6n+2)")
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    if args.model == "resnet":
+        cost, logits = models.resnet_cifar(depth=args.depth)
+    else:
+        cost, logits = models.vgg_cifar()
+    opt = Momentum(learning_rate=args.lr, momentum=0.9)
+    opt.learning_rate_schedule = "poly"
+    trainer = SGDTrainer(cost, opt, seed=0)
+    feeder = data.DataFeeder({"pixel": "dense", "label": "int"})
+    reader = data.shuffle(
+        data.batch(data.datasets.cifar10("train", n=args.n), args.batch_size), 8)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 5 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

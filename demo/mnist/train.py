@@ -1,0 +1,61 @@
+"""MNIST LeNet-5 — analog of the reference's demo/mnist (LeNet on MNIST,
+demo/mnist/mnist_provider.py + vgg_16_mnist.py style configs)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.evaluators import ClassificationError
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--n", type=int, default=1024, help="synthetic samples")
+    ap.add_argument("--save-dir", default="")
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    cost, logits = models.lenet5()
+    trainer = SGDTrainer(cost, Adam(learning_rate=1e-3),
+                         extra_outputs=[logits], seed=0)
+    feeder = data.DataFeeder({"pixel": "dense", "label": "int"})
+    train_reader = data.shuffle(
+        data.batch(data.datasets.mnist("train", n=args.n), args.batch_size), 10)
+    test_reader = data.batch(data.datasets.mnist("test", n=args.n // 4),
+                             args.batch_size)
+
+    def test_error() -> float:
+        evaluator = ClassificationError()
+        evaluator.start()
+        for rows in test_reader():
+            feed = feeder(rows)
+            out = trainer.infer([logits], feed)
+            evaluator.eval_batch(logits=out[logits.name],
+                                 labels=np.asarray(feed["label"]))
+        return evaluator.result()
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 10 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+        if isinstance(ev, events.EndPass):
+            print(f"== pass {ev.pass_id} test error {test_error():.3f} ==")
+            if args.save_dir:
+                trainer.save(args.save_dir, ev.pass_id)
+
+    trainer.train(train_reader, num_passes=args.passes,
+                  event_handler=on_event, feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Text-classification quick start — analog of demo/quick_start, whose seven
+configs span bag-of-words LR, CNN and LSTM text classifiers
+(reference demo/quick_start/trainer_config.*.py)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer, events
+
+VOCAB = 1000
+
+
+def bow_net(vocab):
+    """Bag-of-words logistic regression (trainer_config.lr.py analog)."""
+    words = nn.data("words", size=0, is_seq=True, dtype="int32")
+    emb = nn.embedding(words, 64, vocab_size=vocab)
+    bow = nn.pooling(emb, pooling_type="sum")
+    out = nn.fc(bow, 2, act="softmax", name="out")
+    lbl = nn.data("label", size=2, dtype="int32")
+    return nn.classification_cost(input=out, label=lbl), out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=["lr", "cnn", "lstm"], default="lr")
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--n", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    if args.config == "lr":
+        cost, _ = bow_net(VOCAB)
+    elif args.config == "cnn":
+        cost, _ = models.convolution_net(VOCAB, emb_dim=32, hid_dim=32)
+    else:
+        cost, _ = models.stacked_lstm_net(VOCAB, emb_dim=32, hid_dim=32,
+                                          stacked_num=3)
+    trainer = SGDTrainer(cost, Adam(learning_rate=2e-3), seed=0)
+    feeder = data.DataFeeder({"words": "ids_seq", "label": "int"}, max_len=96)
+    reader = data.batch(
+        data.datasets.imdb("train", vocab_size=VOCAB, n=args.n), args.batch_size)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 5 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

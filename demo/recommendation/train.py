@@ -1,0 +1,50 @@
+"""MovieLens recommender — analog of demo/recommendation (two embedding
+towers to rating regression, reference demo/recommendation/trainer_config.py).
+Pass --mesh to shard the embedding tables over a model axis (the
+SparseRemoteParameterUpdater analog, SURVEY.md §5.8)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--n", type=int, default=2048)
+    ap.add_argument("--emb-dim", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    cost, pred = models.movielens_net(emb_dim=args.emb_dim, hid_dim=32)
+    trainer = SGDTrainer(cost, Adam(learning_rate=1e-3), seed=0)
+    feeder = data.DataFeeder({"user_id": "int", "movie_id": "int",
+                              "score": "dense"})
+
+    def to_row(r):
+        u, mv, s = r
+        return u, mv, [s]
+
+    reader = data.batch(
+        data.map_readers(to_row, data.datasets.movielens("train", n=args.n)),
+        args.batch_size)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 4 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} mse {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Semantic role labeling — analog of demo/semantic_role_labeling (CoNLL-05
+sequence tagging with a CRF output layer, reference demo/semantic_role_labeling
+/db_lstm.py: word+predicate embeddings -> recurrent encoder -> CRF)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def srl_net(vocab, n_labels, emb_dim, hid_dim):
+    words = nn.data("words", size=0, is_seq=True, dtype="int32")
+    pred = nn.data("predicate", size=vocab, dtype="int32")
+    w_emb = nn.embedding(words, emb_dim, vocab_size=vocab, name="w_emb")
+    p_emb = nn.embedding(pred, emb_dim, vocab_size=vocab, name="p_emb")
+    p_exp = nn.expand(p_emb, words, name="p_exp")  # broadcast over timesteps
+    merged = nn.concat([w_emb, p_exp], name="merged")
+    h = nn.bidirectional_rnn(merged, hid_dim, cell="gru", name="enc")
+    feat = nn.fc(h, n_labels, act="linear", name="feat")
+    labels = nn.data("labels", size=n_labels, is_seq=True, dtype="int32")
+    cost = nn.crf_cost(feat, labels, name="cost")
+    decoded = nn.crf_decoding(feat, name="decoded")
+    return cost, decoded
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=800)
+    ap.add_argument("--labels", type=int, default=19)
+    ap.add_argument("--n", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    cost, decoded = srl_net(args.vocab, args.labels, emb_dim=32, hid_dim=32)
+    trainer = SGDTrainer(cost, Adam(learning_rate=2e-3), seed=0)
+    feeder = data.DataFeeder(
+        {"words": "ids_seq", "predicate": "int", "labels": "ids_seq"},
+        max_len=48)
+
+    def clamp(r):
+        words, pred, labels = r
+        return words, pred, [min(l, args.labels - 1) for l in labels]
+
+    reader = data.batch(
+        data.map_readers(clamp, data.datasets.conll05(
+            "train", vocab_size=args.vocab, n_labels=args.labels, n=args.n)),
+        args.batch_size)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 4 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

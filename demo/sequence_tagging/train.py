@@ -1,0 +1,61 @@
+"""Sequence tagging (chunking) — analog of demo/sequence_tagging
+(reference demo/sequence_tagging/linear_crf.py: sliding-window context
+features -> linear CRF)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def linear_crf_net(vocab, n_labels, emb_dim, context_len):
+    words = nn.data("words", size=0, is_seq=True, dtype="int32")
+    emb = nn.embedding(words, emb_dim, vocab_size=vocab, name="emb")
+    ctx = nn.context_projection(emb, context_len=context_len, name="ctx")
+    feat = nn.fc(ctx, n_labels, act="linear", name="feat")
+    labels = nn.data("labels", size=n_labels, is_seq=True, dtype="int32")
+    cost = nn.crf_cost(feat, labels, name="cost")
+    return cost, nn.crf_decoding(feat, name="decoded")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=500)
+    ap.add_argument("--labels", type=int, default=9, help="BIO chunk labels")
+    ap.add_argument("--context-len", type=int, default=5)
+    ap.add_argument("--n", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    cost, decoded = linear_crf_net(args.vocab, args.labels, emb_dim=32,
+                                   context_len=args.context_len)
+    trainer = SGDTrainer(cost, Adam(learning_rate=2e-3), seed=0)
+    feeder = data.DataFeeder({"words": "ids_seq", "labels": "ids_seq"},
+                             max_len=48)
+
+    def to_chunk(r):
+        words, _, labels = r
+        return words, [l % args.labels for l in labels]
+
+    reader = data.batch(
+        data.map_readers(to_chunk, data.datasets.conll05(
+            "train", vocab_size=args.vocab, n=args.n)), args.batch_size)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 4 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+
+
+if __name__ == "__main__":
+    main()

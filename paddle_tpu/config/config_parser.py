@@ -159,6 +159,7 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
             is_static=a.is_static,
             sparse_grad=a.sparse_grad,
             is_state=spec.is_state,
+            pruning_ratio=a.pruning_ratio,
         )
     mc.input_layer_names.extend(l.name for l in topology.data_layers)
     mc.output_layer_names.extend(topology.output_names())

@@ -17,7 +17,8 @@ from typing import Callable, Iterator, List, Tuple
 
 import numpy as np
 
-__all__ = ["mnist", "cifar10", "imdb", "wmt14", "movielens", "uci_housing"]
+__all__ = ["mnist", "cifar10", "imdb", "wmt14", "movielens", "uci_housing",
+           "imikolov", "conll05", "sentiment"]
 
 DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
 
@@ -130,6 +131,56 @@ def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3706
             yield u, m, float(np.clip(r + rng.randn() * 0.2, 1.0, 5.0))
 
     return synth_reader
+
+
+def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
+             n: int = 4096) -> Callable:
+    """Yields n-gram tuples (w0..w{n-2}, next_word) — the word2vec /
+    n-gram-LM feed format (reference: python/paddle/v2/dataset/imikolov.py,
+    demo/word2vec).  Synthetic text follows a Zipf-ish bigram chain so
+    embeddings have co-occurrence structure to learn."""
+
+    def synth_reader():
+        rng = _synth_rng("imikolov", split)
+        # bigram transition: each word prefers a small successor set
+        succ = rng.randint(0, vocab_size, (vocab_size, 4))
+        w = rng.randint(0, vocab_size)
+        for _ in range(n):
+            ctx = []
+            for _ in range(ngram):
+                w = int(succ[w, rng.randint(0, 4)]) if rng.rand() < 0.8 else rng.randint(0, vocab_size)
+                ctx.append(w)
+            yield tuple(ctx[:-1]) + (ctx[-1],)
+
+    return synth_reader
+
+
+def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
+            n: int = 1024) -> Callable:
+    """Yields (word_ids, predicate_id, label_ids) — semantic-role-labeling
+    sequence-tagging shapes (reference: python/paddle/v2/dataset/conll05.py,
+    demo/semantic_role_labeling).  Labels use the reference's BIO scheme size
+    (67 classes)."""
+
+    def synth_reader():
+        rng = _synth_rng("conll05", split)
+        for _ in range(n):
+            L = rng.randint(5, 40)
+            words = rng.randint(2, vocab_size, L).tolist()
+            pred_pos = rng.randint(0, L)
+            # labels correlate with distance from the predicate so the
+            # tagger has learnable structure
+            labels = [min(n_labels - 1, abs(i - pred_pos) % n_labels) for i in range(L)]
+            yield words, words[pred_pos], labels
+
+    return synth_reader
+
+
+def sentiment(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Callable:
+    """Yields (word_ids, label 0/1) — the demo/sentiment stacked-LSTM feed
+    (reference: python/paddle/v2/dataset/sentiment.py wraps NLTK movie
+    reviews; same shapes as imdb with a different corpus)."""
+    return imdb(split, vocab_size=vocab_size, n=n)
 
 
 def uci_housing(split: str = "train", *, n: int = 404) -> Callable:

@@ -12,6 +12,8 @@ from paddle_tpu.nn.graph import (
     LayerOutput,
     Topology,
     reset_naming,
+    naming_scope,
+    device_pin,
 )
 from paddle_tpu.nn.layers import *  # noqa: F401,F403
 from paddle_tpu.nn.layers_extra import *  # noqa: F401,F403

@@ -42,8 +42,20 @@ __all__ = [
     "Topology",
     "next_name",
     "reset_naming",
+    "naming_scope",
+    "device_pin",
     "LAYER_TYPES",
 ]
+
+
+def device_pin(node: "LayerOutput", tag: str) -> "LayerOutput":
+    """Pin a layer to a model-parallel device group — the per-layer
+    ``device`` attribute of the reference's --parallel_nn mode.  ``tag`` is
+    resolved to a sharding via ``Topology.apply(device_specs={tag: ...})``;
+    the tag round-trips through ModelConfig serialization (LayerConf.device).
+    """
+    node.meta["device"] = str(tag)
+    return node
 
 LAYER_TYPES: Registry = Registry("layer_type")
 
@@ -105,6 +117,9 @@ class ParamAttr:
     l2_decay: float = 0.0
     is_static: bool = False
     sparse_grad: bool = False
+    # StaticPruningHook analog: fraction of smallest-|w| entries masked to 0
+    # after every update (paddle/parameter/ParameterUpdaterHook.cpp:36-78)
+    pruning_ratio: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -304,9 +319,18 @@ class Topology:
         train: bool = False,
         rng: Optional[jax.Array] = None,
         outputs: Optional[Sequence[str]] = None,
+        device_specs: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Dict[str, Act], Dict[str, Any]]:
         """Run the graph. ``feed`` maps data-layer name -> Act | array |
-        (value, lengths). Returns ({layer_name: Act}, new_state)."""
+        (value, lengths). Returns ({layer_name: Act}, new_state).
+
+        ``device_specs`` is the model-parallel pinning plane — the analog of
+        the reference's per-layer ``device`` attribute dispatched by
+        ParallelNeuralNetwork (ParallelNeuralNetwork.h:34,
+        config_parser.py:1772-1848).  Layers tagged via ``device_pin(node,
+        tag)`` get ``lax.with_sharding_constraint(value, device_specs[tag])``
+        on their output — XLA/GSPMD then places per-layer compute on the
+        matching mesh shards instead of spawning per-device threads."""
         ctx = ApplyContext(train, rng)
         env: Dict[str, Act] = {}
         all_params = {**params, **state}
@@ -315,11 +339,20 @@ class Topology:
         for layer in needed:
             with layer_scope(layer.name):
                 if layer.is_data:
-                    env[layer.name] = _coerce_feed(layer, feed)
+                    act = _coerce_feed(layer, feed)
                 else:
                     parent_acts = [env[p.name] for p in layer.parents]
                     local = {s.name: all_params[s.name] for s in layer.param_specs}
-                    env[layer.name] = layer.forward(ctx, local, *parent_acts)
+                    act = layer.forward(ctx, local, *parent_acts)
+                tag = layer.meta.get("device")
+                if device_specs and tag is not None and tag in device_specs:
+                    act = replace(
+                        act,
+                        value=jax.lax.with_sharding_constraint(
+                            act.value, device_specs[tag]
+                        ),
+                    )
+                env[layer.name] = act
         new_state = {**state, **ctx.updated_state}
         result = {l.name: env[l.name] for l in self.layers if l.name in env}
         return result, new_state

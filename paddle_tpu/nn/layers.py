@@ -185,7 +185,13 @@ def embedding(input: LayerOutput, size: int, *, vocab_size: Optional[int] = None
     spec = ParamSpec(name=pa.name, shape=(V, size), attr=pa)
 
     def forward(ctx, params, a: Act) -> Act:
-        out = O.embedding_lookup(params[spec.name], a.value, pad_to_zero_id=padding_idx)
+        ids = a.value
+        if not a.is_seq and ids.ndim == 2 and ids.shape[1] == 1:
+            # non-seq int slots feed as [B,1]; the embedding of a scalar id
+            # is the per-row vector [B,D], not a length-1 sequence — squeeze
+            # here so every consumer (expand, concat, fc, ...) sees [B,D]
+            ids = ids[:, 0]
+        out = O.embedding_lookup(params[spec.name], ids, pad_to_zero_id=padding_idx)
         if a.is_seq:
             out = out * a.mask[..., None].astype(out.dtype)
             return _seq_like(a, out)

@@ -1,3 +1,9 @@
+from paddle_tpu.param.hooks import (
+    PARAM_HOOKS,
+    StaticPruningHook,
+    apply_masks,
+    build_masks,
+)
 from paddle_tpu.param.optimizers import (
     Optimizer,
     SGD,

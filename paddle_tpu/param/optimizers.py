@@ -153,7 +153,16 @@ class Optimizer:
         lr_scales: Optional[Dict[str, float]] = None,
         decays: Optional[Dict[str, float]] = None,
         statics: Optional[Dict[str, bool]] = None,
+        sparse_rows: Optional[Dict[str, bool]] = None,
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """``sparse_rows`` marks row-sparse parameters (embedding tables with
+        ParamAttr(sparse_grad=True)): rows a batch never touched keep their
+        value AND optimizer slots unchanged — the reference's sparse-row
+        update semantics (SparseRowCpuMatrix / SparseMomentum,
+        paddle/math/SparseRowMatrix.h, FirstOrderOptimizer.h:52), where
+        momentum decay and regularization do not advance untouched rows.
+        Implemented as a per-row touched mask over the dense scatter-add
+        gradient — static shapes, jit/pjit-safe, fuses into the update."""
         step = opt_state["step"] + 1
         lr = self.lr_at(step)
         if self.gradient_clipping_threshold > 0:
@@ -170,7 +179,23 @@ class Optimizer:
             if self.l1_rate:
                 g = g + self.l1_rate * jnp.sign(p)
             scale = lr_scales.get(k, 1.0) if lr_scales else 1.0
-            p2, s2 = self.update_leaf(p, g, opt_state["slots"][k], lr * scale, step)
+            old_slots = opt_state["slots"][k]
+            p2, s2 = self.update_leaf(p, g, old_slots, lr * scale, step)
+            if sparse_rows and sparse_rows.get(k) and p.ndim >= 2:
+                touched = jnp.any(grads[k] != 0, axis=tuple(range(1, p.ndim)))
+                row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
+
+                def sel(new, old, row=row):
+                    r = row.astype(jnp.bool_)
+                    r = r.reshape(r.shape + (1,) * (new.ndim - r.ndim))
+                    return jnp.where(r, new, old)
+
+                p2 = sel(p2, p)
+                s2 = jax.tree_util.tree_map(
+                    lambda n, o: sel(n, o)
+                    if getattr(n, "shape", None) == p.shape else n,
+                    s2, old_slots,
+                )
             new_params[k] = p2.astype(p.dtype)
             new_slots[k] = s2
         return new_params, {"step": step, "slots": new_slots}

@@ -39,22 +39,33 @@ class SGDTrainer:
 
     def __init__(
         self,
-        cost: LayerOutput,
+        cost,
         optimizer: Optional[Optimizer] = None,
         *,
         extra_outputs: Sequence[LayerOutput] = (),
+        cost_weights: Optional[Sequence[float]] = None,
         mesh=None,
         data_axis: str = "data",
         seed: Optional[int] = None,
         averager: Optional[ParameterAverager] = None,
+        device_specs: Optional[Dict[str, Any]] = None,
     ) -> None:
-        self.cost_name = cost.name
+        # several costs train jointly (MultiNetwork analog,
+        # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
+        # (weighted) sum, parameters shared by name across sub-networks
+        costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
+        self.cost_names = [c.name for c in costs]
+        self.cost_weights = list(cost_weights) if cost_weights else [1.0] * len(costs)
+        if len(self.cost_weights) != len(costs):
+            raise ValueError("cost_weights must match the number of costs")
+        self.cost_name = costs[0].name
         self.extra_names = [e.name for e in extra_outputs]
-        self.topology = Topology([cost, *extra_outputs])
+        self.topology = Topology([*costs, *extra_outputs])
         self.optimizer = optimizer or SGD(learning_rate=0.01)
         self.mesh = mesh
         self.data_axis = data_axis
         self.averager = averager
+        self.device_specs = device_specs
 
         seed = FLAGS.seed if seed is None else seed
         self._rng = jax.random.PRNGKey(seed)
@@ -65,6 +76,8 @@ class SGDTrainer:
         self.lr_scales = {}
         self.decays = {}
         self.statics = {}
+        self.sparse_rows = {}
+        pruning_ratios = {}
         for name, spec in self.topology.param_specs.items():
             if spec.is_state:
                 continue
@@ -74,6 +87,17 @@ class SGDTrainer:
                 self.decays[name] = spec.attr.l2_decay
             if spec.attr.is_static:
                 self.statics[name] = True
+            if spec.attr.sparse_grad:
+                self.sparse_rows[name] = True
+            if spec.attr.pruning_ratio:
+                pruning_ratios[name] = spec.attr.pruning_ratio
+
+        # StaticPruningHook analog: masks fixed from initial magnitudes,
+        # re-applied after every update inside the jitted step
+        from paddle_tpu.param.hooks import apply_masks, build_masks
+
+        self.masks = build_masks(self.params, pruning_ratios)
+        self.params = apply_masks(self.params, self.masks)
 
         self.opt_state = self.optimizer.init_state(self.params)
         self.avg_params = self.averager.init_state(self.params) if self.averager else None
@@ -83,17 +107,29 @@ class SGDTrainer:
     # ------------------------------------------------------------------
 
     def _build_step(self):
+        from paddle_tpu.param.hooks import apply_masks
+
         topo = self.topology
-        cost_name = self.cost_name
+        cost_names = list(self.cost_names)
+        cost_weights = list(self.cost_weights)
         extra_names = list(self.extra_names)
         opt = self.optimizer
         lr_scales, decays, statics = self.lr_scales, self.decays, self.statics
+        sparse_rows, masks = self.sparse_rows, self.masks
+
+        device_specs = self.device_specs
 
         def step(params, state, opt_state, rng, feed):
             def loss_fn(p):
-                outs, new_state = topo.apply(p, state, feed, train=True, rng=rng)
+                outs, new_state = topo.apply(
+                    p, state, feed, train=True, rng=rng,
+                    device_specs=device_specs,
+                )
                 extras = {k: outs[k].value for k in extra_names}
-                return outs[cost_name].value, (new_state, extras)
+                total = sum(
+                    w * outs[n].value for n, w in zip(cost_names, cost_weights)
+                )
+                return total, (new_state, extras)
 
             (loss, (new_state, extras)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -101,7 +137,9 @@ class SGDTrainer:
             new_params, new_opt = opt.update(
                 params, grads, opt_state,
                 lr_scales=lr_scales, decays=decays, statics=statics,
+                sparse_rows=sparse_rows,
             )
+            new_params = apply_masks(new_params, masks)
             return loss, new_params, new_state, new_opt, extras
 
         if self.mesh is not None:

@@ -1,0 +1,80 @@
+"""C inference API end-to-end: compile csrc/capi.cc, run the C smoke driver
+against a bundle exported from Python, compare outputs.
+
+Mirrors the reference's capi tests (paddle/capi/tests) which run the pure-C
+surface against a trained model.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.config import merge_model
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+
+
+def _pyconfig(*args):
+    exe = f"python{sys.version_info.major}.{sys.version_info.minor}-config"
+    if shutil.which(exe) is None:
+        exe = "python3-config"
+    return subprocess.run([exe, *args], check=True, capture_output=True,
+                         text=True).stdout.split()
+
+
+@pytest.fixture(scope="module")
+def capi_bin(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi")
+    lib = str(d / "libpaddletpu_capi.so")
+    exe = str(d / "capi_smoke")
+    includes = _pyconfig("--includes")
+    ldflags = _pyconfig("--ldflags", "--embed")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         os.path.join(ROOT, "csrc", "capi.cc"), *includes, *ldflags,
+         "-o", lib],
+        check=True, capture_output=True, timeout=180,
+    )
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(ROOT, "csrc", "capi_smoke.c"),
+         lib, *ldflags, "-o", exe, f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True, timeout=120,
+    )
+    return exe
+
+
+def test_capi_inference_matches_python(capi_bin, tmp_path, rng):
+    nn.reset_naming()
+    x = nn.data("x", size=6)
+    o = nn.fc(nn.fc(x, 8, name="h"), 3, act="softmax", name="o")
+    topo = nn.Topology(o)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    bundle = str(tmp_path / "m.ptz")
+    merge_model(bundle, topo, params, state)
+
+    feed_x = (np.arange(12, dtype=np.float32) / 12.0).reshape(2, 6)
+    want, _ = topo.apply(params, state, {"x": feed_x})
+    want = np.asarray(want["o"].value)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_COMPUTE_DTYPE"] = "float32"
+    r = subprocess.run([capi_bin, bundle, "6"], capture_output=True, text=True,
+                      env=env, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "inputs: x" in r.stdout and "outputs: o" in r.stdout
+    m = re.search(r"values:((?: -?\d+\.\d+)+)", r.stdout)
+    assert m, r.stdout
+    got = np.array([float(v) for v in m.group(1).split()]).reshape(2, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert "unknown-output error:" in r.stdout

@@ -1,0 +1,41 @@
+"""Every demo runs end-to-end with tiny settings — the analog of the
+reference's trainer/tests one-pass .conf fixtures (SURVEY.md §4)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = [
+    ("mnist", ["--passes", "1", "--n", "128", "--batch-size", "32"]),
+    ("image_classification",
+     ["--passes", "1", "--n", "64", "--batch-size", "16", "--depth", "8"]),
+    ("quick_start", ["--passes", "1", "--n", "64", "--config", "lr"]),
+    ("quick_start", ["--passes", "1", "--n", "64", "--config", "cnn"]),
+    ("sentiment", ["--passes", "1", "--n", "64", "--vocab", "200",
+                   "--emb-dim", "16", "--hid-dim", "16", "--stacked-num", "1"]),
+    ("seqToseq", ["--passes", "1", "--n", "32", "--batch-size", "8",
+                  "--dict-size", "100", "--emb-dim", "16", "--hid-dim", "16",
+                  "--generate"]),
+    ("recommendation", ["--passes", "1", "--n", "256", "--batch-size", "64"]),
+    ("word2vec", ["--passes", "1", "--n", "256", "--vocab", "100",
+                  "--output", "hsigmoid"]),
+    ("semantic_role_labeling", ["--passes", "1", "--n", "32",
+                                "--vocab", "100", "--batch-size", "8"]),
+    ("sequence_tagging", ["--passes", "1", "--n", "32", "--vocab", "100",
+                          "--batch-size", "8"]),
+    ("gan", ["--steps", "20", "--batch-size", "32"]),
+]
+
+
+@pytest.mark.parametrize("name,args", CASES,
+                         ids=[f"{n}-{i}" for i, (n, _) in enumerate(CASES)])
+def test_demo_runs(name, args, monkeypatch, capsys):
+    script = os.path.join(ROOT, "demo", name, "train.py")
+    monkeypatch.setattr(sys, "argv", [script] + args)
+    runpy.run_path(script, run_name="__main__")
+    out = capsys.readouterr().out
+    assert "cost" in out or "loss" in out or "mse" in out

@@ -1,0 +1,165 @@
+"""Row-sparse embedding updates, pruning hooks, multi-cost training, and
+per-layer device pinning.
+
+Reference semantics being matched:
+- sparse-row updates: untouched embedding rows keep value AND optimizer
+  slots (paddle/math/SparseRowMatrix.h, FirstOrderOptimizer.h:52
+  SparseMomentum) — momentum/adagrad do not advance rows a batch never saw.
+- StaticPruningHook: magnitude mask fixed at init, re-applied after every
+  update (paddle/parameter/ParameterUpdaterHook.cpp:36-78).
+- MultiNetwork: several cost layers train jointly
+  (gserver/gradientmachines/MultiNetwork.h:24).
+- ParallelNeuralNetwork: per-layer device pinning
+  (ParallelNeuralNetwork.h:34) → sharding constraints on a mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.param.hooks import StaticPruningHook
+from paddle_tpu.param.optimizers import Adam, Momentum
+from paddle_tpu.trainer import SGDTrainer
+
+
+def _emb_net(sparse: bool):
+    nn.reset_naming()
+    words = nn.data("words", size=0, is_seq=True, dtype="int32")
+    emb = nn.embedding(
+        words, 8, vocab_size=32, name="emb",
+        param_attr=nn.ParamAttr(name="table", sparse_grad=sparse),
+    )
+    agg = nn.pooling(emb, pooling_type="sum")
+    out = nn.fc(agg, 2, act="softmax", name="out")
+    lbl = nn.data("label", size=2, dtype="int32")
+    return nn.classification_cost(input=out, label=lbl, name="cost")
+
+
+def _feed(rng):
+    # only ids < 8 ever appear: rows 8..31 must stay untouched
+    return {
+        "words": (rng.randint(0, 8, (4, 5)), np.array([5, 4, 3, 5])),
+        "label": rng.randint(0, 2, (4,)),
+    }
+
+
+def test_sparse_rows_keep_untouched(rng):
+    tr = SGDTrainer(cost=_emb_net(True), optimizer=Momentum(learning_rate=0.1))
+    t0 = np.asarray(tr.params["table"]).copy()
+    for _ in range(3):
+        tr.train_batch(_feed(rng))
+    t1 = np.asarray(tr.params["table"])
+    v1 = np.asarray(tr.opt_state["slots"]["table"])
+    np.testing.assert_array_equal(t1[8:], t0[8:])        # untouched rows frozen
+    assert np.abs(t1[:8] - t0[:8]).max() > 0             # touched rows moved
+    assert np.abs(v1[8:]).max() == 0                     # no momentum on untouched
+    assert np.abs(v1[:8]).max() > 0
+
+
+def test_sparse_rows_match_dense_on_touched(rng):
+    # the same feed every step: rows touched in EVERY batch must follow the
+    # exact dense update (rows touched in only some batches legitimately
+    # diverge — dense optimizers keep moving them on momentum alone, sparse
+    # freezes them; that divergence is the reference's sparse-row semantic)
+    feed = _feed(rng)
+    tr_s = SGDTrainer(cost=_emb_net(True), optimizer=Adam(learning_rate=0.01), seed=3)
+    tr_d = SGDTrainer(cost=_emb_net(False), optimizer=Adam(learning_rate=0.01), seed=3)
+    for _ in range(3):
+        tr_s.train_batch(feed)
+        tr_d.train_batch(feed)
+    ts = np.asarray(tr_s.params["table"])
+    td = np.asarray(tr_d.params["table"])
+    touched = sorted(set(np.asarray(feed["words"][0]).ravel().tolist()))
+    np.testing.assert_allclose(ts[touched], td[touched], rtol=1e-5, atol=1e-6)
+
+
+def test_pruning_hook_mask_and_reapply(rng):
+    hook = StaticPruningHook(0.75)
+    w = jnp.asarray(rng.randn(16, 16).astype(np.float32))
+    mask = hook.init_mask(w)
+    kept = float(mask.sum()) / mask.size
+    assert 0.2 <= kept <= 0.3  # ~25% kept
+
+    nn.reset_naming()
+    x = nn.data("x", size=8)
+    h = nn.fc(x, 16, name="h",
+              param_attr=nn.ParamAttr(name="pw", pruning_ratio=0.5))
+    cost = nn.mse_cost(input=nn.fc(h, 4, name="o"),
+                       label=nn.data("y", size=4))
+    tr = SGDTrainer(cost=cost, optimizer=Adam(learning_rate=0.01))
+    m0 = np.asarray(tr.params["pw"]) != 0
+    assert 0.45 <= 1 - m0.mean() <= 0.55  # ~half pruned at init
+    for _ in range(3):
+        tr.train_batch({"x": rng.rand(4, 8).astype(np.float32),
+                        "y": rng.rand(4, 4).astype(np.float32)})
+    m1 = np.asarray(tr.params["pw"]) != 0
+    np.testing.assert_array_equal(m1, m0)  # zeros stay zero through updates
+
+
+def test_multi_cost_joint_training(rng):
+    nn.reset_naming()
+    x = nn.data("x", size=6)
+    shared = nn.fc(x, 16, name="shared")
+    head_a = nn.fc(shared, 3, act="softmax", name="ha")
+    head_b = nn.fc(shared, 1, name="hb")
+    ca = nn.classification_cost(input=head_a, label=nn.data("ya", size=3, dtype="int32"),
+                                name="cost_a")
+    cb = nn.mse_cost(input=head_b, label=nn.data("yb", size=1), name="cost_b")
+    tr = SGDTrainer(cost=[ca, cb], optimizer=Adam(learning_rate=0.01),
+                    cost_weights=[1.0, 0.5])
+    feed = {
+        "x": rng.rand(8, 6).astype(np.float32),
+        "ya": rng.randint(0, 3, (8,)),
+        "yb": rng.rand(8, 1).astype(np.float32),
+    }
+    losses = [tr.train_batch(feed) for _ in range(20)]
+    assert losses[-1] < losses[0]  # joint loss decreases
+    # both heads' weights moved (gradients flowed through both costs)
+    assert np.abs(np.asarray(tr.params["_ha.w0"])).max() > 0
+    assert np.abs(np.asarray(tr.params["_hb.w0"])).max() > 0
+
+
+def test_device_pin_sharding_equivalence(rng):
+    """Pinned layers compute the same values; the tag round-trips config."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    nn.reset_naming()
+    x = nn.data("x", size=8)
+    h = nn.device_pin(nn.fc(x, 16, name="h"), "g0")
+    o = nn.fc(h, 4, name="o")
+    topo = nn.Topology(o)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"x": rng.rand(8, 8).astype(np.float32)}
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    specs = {"g0": NamedSharding(mesh, P(None, "model"))}
+
+    plain, _ = topo.apply(params, state, feed)
+
+    @jax.jit
+    def run(params, state, feed):
+        outs, _ = topo.apply(params, state, feed, device_specs=specs)
+        return outs["o"].value
+
+    pinned = run(params, state, feed)
+    np.testing.assert_allclose(np.asarray(pinned), np.asarray(plain[o.name].value),
+                               rtol=1e-5, atol=1e-6)
+
+    # tag survives serialization
+    from paddle_tpu.config import build_topology, dump_model_config
+
+    mc = dump_model_config(topo)
+    (lc,) = [l for l in mc.layers if l.name == "h"]
+    assert lc.device == "g0"
+    topo2 = build_topology(mc)
+    assert [l for l in topo2.layers if l.name == "h"][0].meta["device"] == "g0"
+
+
+def test_pruning_hook_constant_init_keeps_fraction():
+    """Tie magnitudes (constant init) must still keep 1-ratio of entries."""
+    hook = StaticPruningHook(0.5)
+    mask = hook.init_mask(jnp.zeros((10, 10), jnp.float32))
+    assert float(mask.sum()) == 50.0

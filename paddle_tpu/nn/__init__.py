@@ -17,5 +17,6 @@ from paddle_tpu.nn.graph import (
 )
 from paddle_tpu.nn.layers import *  # noqa: F401,F403
 from paddle_tpu.nn.layers_extra import *  # noqa: F401,F403
+from paddle_tpu.nn.layers_extra2 import *  # noqa: F401,F403
 from paddle_tpu.nn.recurrent import Memory, StaticInput, recurrent_group, SequenceGenerator
 from paddle_tpu.nn import layers as layer

@@ -36,6 +36,7 @@ from paddle_tpu.ops.sequence import (
 )
 from paddle_tpu.ops.conv import (
     conv2d,
+    conv2d_transpose,
     max_pool2d,
     avg_pool2d,
     batch_norm,

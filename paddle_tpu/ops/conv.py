@@ -21,6 +21,7 @@ from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
 
 __all__ = [
     "conv2d",
+    "conv2d_transpose",
     "max_pool2d",
     "avg_pool2d",
     "batch_norm",
@@ -42,6 +43,21 @@ def conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
+        preferred_element_type=acc_dtype(),
+    )
+
+
+def conv2d_transpose(x, w, *, stride=(1, 1), padding="SAME"):
+    """Transposed NHWC conv (deconvolution) — the exconvt analog
+    (reference gserver/layers/ConvTransLayerBase; hl deconv kernels).
+    x [B,H,W,Cin], w [kh,kw,Cin,Cout] -> [B,H*s,W*s,Cout] for SAME."""
+    x, w = mxu_cast(x, w)
+    return lax.conv_transpose(
+        x,
+        w,
+        strides=tuple(stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=acc_dtype(),
     )
 

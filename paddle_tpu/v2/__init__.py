@@ -1,0 +1,41 @@
+"""`paddle.v2`-compatible facade.
+
+The reference's user API is ``import paddle.v2 as paddle``:
+``paddle.init(...)``, typed data layers (``paddle.data_type``), activation
+objects (``paddle.activation.Softmax()``), ``paddle.layer.*``,
+``paddle.parameters.create(cost)``, ``paddle.trainer.SGD(cost, parameters,
+update_equation)``, ``paddle.infer``, ``paddle.batch``/``paddle.reader``,
+``paddle.dataset``, ``paddle.event`` (python/paddle/v2/: trainer.py:30-175,
+parameters.py:192-285, inference.py, reader/, dataset/).
+
+This package re-exports the TPU-native framework under those names so a
+reference user's training script ports with minimal edits:
+
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    out = paddle.layer.fc(images, size=10, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    parameters = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=opt)
+    trainer.train(paddle.batch(reader, 64), num_passes=5,
+                  event_handler=handler)
+"""
+
+from paddle_tpu.utils.devices import init  # noqa: F401
+from paddle_tpu.v2 import activation, attr, data_type, pooling  # noqa: F401
+from paddle_tpu.v2 import dataset, event, layer, optimizer  # noqa: F401
+from paddle_tpu.v2 import parameters, trainer  # noqa: F401
+from paddle_tpu.v2.inference import infer  # noqa: F401
+from paddle_tpu.data.reader import batch  # noqa: F401
+from paddle_tpu.data import reader  # noqa: F401
+
+__all__ = [
+    "init", "activation", "attr", "data_type", "pooling", "dataset",
+    "event", "layer", "optimizer", "parameters", "trainer", "infer",
+    "batch", "reader",
+]

@@ -1,0 +1,19 @@
+"""`paddle.v2.attr` facade (python/paddle/v2/attr.py): Param/Extra
+attribute objects."""
+
+from paddle_tpu.nn.graph import ParamAttr
+
+__all__ = ["Param", "ParamAttr", "Extra", "ExtraAttr"]
+
+Param = ParamAttr
+ParamAttr = ParamAttr
+
+
+class Extra:
+    """ExtraLayerAttribute stub — dropout is a first-class layer here."""
+
+    def __init__(self, **kw):
+        self.kw = kw
+
+
+ExtraAttr = Extra

@@ -1,0 +1,49 @@
+"""Typed data slots — analog of paddle.v2.data_type (python/paddle/v2/
+data_type.py re-exporting trainer.PyDataProvider2 input types)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "InputType",
+    "dense_vector",
+    "dense_vector_sequence",
+    "integer_value",
+    "integer_value_sequence",
+    "sparse_binary_vector",
+]
+
+
+@dataclass(frozen=True)
+class InputType:
+    dim: int
+    seq: bool
+    kind: str  # 'dense' | 'int' | 'sparse'
+
+    @property
+    def feeder_kind(self) -> str:
+        if self.kind == "int":
+            return "ids_seq" if self.seq else "int"
+        return "dense_seq" if self.seq else "dense"
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType(dim, False, "dense")
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, True, "dense")
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType(value_range, False, "int")
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType(value_range, True, "int")
+
+
+def sparse_binary_vector(dim: int) -> InputType:
+    # fed as id lists, embedded densely on-device
+    return InputType(dim, True, "int")

@@ -1,0 +1,13 @@
+"""`paddle.v2.event` facade (python/paddle/v2/event.py): the reference's
+event class names re-exported."""
+
+from paddle_tpu.trainer.events import (  # noqa: F401
+    BeginIteration,
+    BeginPass,
+    EndIteration,
+    EndPass,
+    TestResult,
+)
+
+__all__ = ["BeginIteration", "BeginPass", "EndIteration", "EndPass",
+           "TestResult"]

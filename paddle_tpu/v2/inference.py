@@ -1,0 +1,34 @@
+"""`paddle.infer` facade (python/paddle/v2/inference.py): run a trained
+topology on raw input rows."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.nn.graph import LayerOutput, Topology
+from paddle_tpu.v2.parameters import Parameters
+from paddle_tpu.v2.trainer import _auto_feeder
+
+__all__ = ["infer"]
+
+
+def infer(output_layer, parameters: Parameters, input: Sequence,
+          feeding: Optional[Dict[str, int]] = None,
+          field: str = "value") -> np.ndarray:
+    """``paddle.infer(output_layer=out, parameters=params, input=rows)``."""
+    outputs = ([output_layer] if isinstance(output_layer, LayerOutput)
+               else list(output_layer))
+    topo = Topology(outputs)
+    feeder = _auto_feeder(topo, feeding)
+    feed = feeder(list(input))
+
+    def run(params, state, feed):
+        outs, _ = topo.apply(params, state, feed, train=False)
+        return [outs[o.name].value for o in outputs]
+
+    vals = jax.jit(run)(parameters.params, parameters.state, feed)
+    res = [np.asarray(v) for v in vals]
+    return res[0] if len(res) == 1 else res

@@ -1,0 +1,92 @@
+"""`paddle.v2.parameters` facade — Parameters with numpy get/set and tar
+checkpoints (python/paddle/v2/parameters.py:192-285)."""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+from typing import Any, Dict, Iterator
+
+import jax
+import numpy as np
+
+from paddle_tpu.nn.graph import LayerOutput, Topology
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters:
+    """Name-addressable parameter store over (params, state) pytrees."""
+
+    def __init__(self, topology: Topology, params: Dict[str, Any],
+                 state: Dict[str, Any]):
+        self.topology = topology
+        self.params = {k: np.asarray(v) for k, v in params.items()}
+        self.state = {k: np.asarray(v) for k, v in state.items()}
+
+    # dict-style access (parameters.py __getitem__/__setitem__)
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name in self.params:
+            return self.params[name]
+        return self.state[name]
+
+    def __setitem__(self, name: str, value) -> None:
+        store = self.params if name in self.params else self.state
+        old = store[name]
+        value = np.asarray(value, dtype=old.dtype)
+        if value.shape != old.shape:
+            raise ValueError(
+                f"parameter {name!r} has shape {old.shape}, got {value.shape}")
+        store[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.params or name in self.state
+
+    def keys(self) -> Iterator[str]:
+        return iter([*self.params, *self.state])
+
+    def names(self):
+        return list(self.keys())
+
+    # -- tar checkpoints (to_tar/from_tar, parameters.py:266-285) ----------
+    def to_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for group, d in (("params", self.params), ("state", self.state)):
+                for name, arr in d.items():
+                    buf = io.BytesIO()
+                    np.save(buf, arr, allow_pickle=False)
+                    data = buf.getvalue()
+                    info = tarfile.TarInfo(f"{group}/{name}.npy")
+                    info.size = len(data)
+                    info.mtime = int(time.time())
+                    tar.addfile(info, io.BytesIO(data))
+            meta = json.dumps({"params": list(self.params),
+                               "state": list(self.state)}).encode()
+            info = tarfile.TarInfo("meta.json")
+            info.size = len(meta)
+            tar.addfile(info, io.BytesIO(meta))
+
+    def from_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                if not member.name.endswith(".npy"):
+                    continue
+                group, fname = member.name.split("/", 1)
+                name = fname[: -len(".npy")]
+                arr = np.load(io.BytesIO(tar.extractfile(member).read()),
+                              allow_pickle=False)
+                self[name] = arr if name in self else arr  # validates shape
+                if group == "params" and name in self.params:
+                    self.params[name] = arr.astype(self.params[name].dtype)
+                elif name in self.state:
+                    self.state[name] = arr.astype(self.state[name].dtype)
+
+
+def create(cost: LayerOutput, *, seed: int = 0) -> Parameters:
+    """``paddle.parameters.create(cost)`` — initialize from the topology."""
+    costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
+    topo = Topology(costs)
+    params, state = topo.init(jax.random.PRNGKey(seed))
+    return Parameters(topo, params, state)

@@ -1,0 +1,83 @@
+"""`paddle.v2.trainer.SGD` facade (python/paddle/v2/trainer.py:30-175):
+``SGD(cost=, parameters=, update_equation=)`` driving the TPU-native
+SGDTrainer; the Parameters object is adopted and kept in sync."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.nn.graph import LayerOutput
+from paddle_tpu.trainer.trainer import SGDTrainer
+from paddle_tpu.v2.parameters import Parameters
+
+__all__ = ["SGD"]
+
+
+def _auto_feeder(topology, feeding: Optional[Dict[str, int]]):
+    types = {}
+    for l in topology.data_layers:
+        t = l.meta.get("v2_type")
+        if t is None:
+            spec = l.data_spec or {}
+            kind = "int" if spec.get("dtype") == "int32" else "dense"
+            if spec.get("is_seq"):
+                kind = "ids_seq" if kind == "int" else "dense_seq"
+            types[l.name] = kind
+        else:
+            types[l.name] = t.feeder_kind
+    return DataFeeder(types, feeding)
+
+
+class SGD:
+    """v2 signature: SGD(cost, parameters, update_equation, extra_layers)."""
+
+    def __init__(self, cost, parameters: Parameters, update_equation,
+                 extra_layers: Sequence[LayerOutput] = (), **kw):
+        self._parameters = parameters
+        self._trainer = SGDTrainer(cost, update_equation,
+                                   extra_outputs=list(extra_layers), **kw)
+        # adopt user-visible parameter values (reference: the Parameters
+        # object passed in IS the store the trainer reads and updates)
+        for name, arr in parameters.params.items():
+            if name in self._trainer.params:
+                self._trainer.params[name] = np.asarray(
+                    arr, dtype=np.asarray(self._trainer.params[name]).dtype)
+        for name, arr in parameters.state.items():
+            if name in self._trainer.state:
+                self._trainer.state[name] = np.asarray(
+                    arr, dtype=np.asarray(self._trainer.state[name]).dtype)
+
+    def _sync_back(self) -> None:
+        for name in self._parameters.params:
+            if name in self._trainer.params:
+                self._parameters.params[name] = np.asarray(self._trainer.params[name])
+        for name in self._parameters.state:
+            if name in self._trainer.state:
+                self._parameters.state[name] = np.asarray(self._trainer.state[name])
+
+    def train(self, reader: Callable, *, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              feeding: Optional[Dict[str, int]] = None) -> None:
+        feeder = _auto_feeder(self._trainer.topology, feeding)
+
+        def handler(ev):
+            if event_handler:
+                event_handler(ev)
+
+        try:
+            self._trainer.train(reader, num_passes=num_passes,
+                                event_handler=handler, feeder=feeder)
+        finally:
+            self._sync_back()
+
+    def test(self, reader: Callable,
+             feeding: Optional[Dict[str, int]] = None) -> Dict[str, float]:
+        feeder = _auto_feeder(self._trainer.topology, feeding)
+        return self._trainer.test(reader, feeder=feeder)
+
+    def save_parameter_to_tar(self, f) -> None:
+        self._sync_back()
+        self._parameters.to_tar(f)

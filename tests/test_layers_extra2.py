@@ -1,0 +1,225 @@
+"""Long-tail layer inventory (layers_extra2) vs brute-force references —
+the per-layer numeric-check pattern of the reference's test_LayerGrad."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.graph import Act
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+def _run(out, feed, train=False):
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    outs, new_state = topo.apply(params, state, feed, train=train)
+    return outs[out.name], params, new_state
+
+
+def test_prelu(rng):
+    x = nn.data("x", size=6)
+    out = nn.prelu(x, name="p")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    params["_p.w0"] = jnp.full((6,), 0.25)
+    xv = rng.randn(4, 6).astype(np.float32)
+    o, _ = topo.apply(params, state, {"x": xv})
+    want = np.maximum(xv, 0) + 0.25 * np.minimum(xv, 0)
+    np.testing.assert_allclose(np.asarray(o[out.name].value), want, rtol=1e-6)
+
+
+def test_trans_and_resize(rng):
+    x = nn.data("x", size=12)
+    t = nn.trans(nn.resize(x, 9, name="r"), name="t")  # 12*3 -> rows of 9 (3x3)
+    xv = rng.randn(3, 12).astype(np.float32)
+    got, _, _ = _run(t, {"x": xv})
+    want = xv.reshape(4, 9).reshape(4, 3, 3).transpose(0, 2, 1).reshape(4, 9)
+    np.testing.assert_allclose(np.asarray(got.value), want, rtol=1e-6)
+
+
+def test_data_norm_zscore(rng):
+    x = nn.data("x", size=5)
+    out = nn.data_norm(x, name="dn")
+    xv = (rng.randn(64, 5) * 3 + 7).astype(np.float32)
+    got, params, new_state = _run(out, {"x": xv}, train=True)
+    v = np.asarray(got.value)
+    np.testing.assert_allclose(v.mean(0), 0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1, atol=1e-2)
+    assert float(np.abs(np.asarray(new_state["_dn.mean"])).max()) > 0  # stats updated
+
+
+def test_conv_shift(rng):
+    a = nn.data("a", size=8)
+    b = nn.data("b", size=3)
+    out = nn.conv_shift(a, b)
+    av = rng.randn(2, 8).astype(np.float32)
+    bv = rng.randn(2, 3).astype(np.float32)
+    got, _, _ = _run(out, {"a": av, "b": bv})
+    want = np.zeros_like(av)
+    for bi in range(2):
+        for i in range(8):
+            for j in range(3):
+                want[bi, i] += bv[bi, j] * av[bi, (i + j - 1) % 8]
+    np.testing.assert_allclose(np.asarray(got.value), want, rtol=1e-5, atol=1e-6)
+
+
+def test_linear_comb_and_cos_vm(rng):
+    w = nn.data("w", size=3)
+    m = nn.data("m", size=12)
+    v = nn.data("v", size=4)
+    lc = nn.linear_comb(w, m, 4)
+    cv = nn.cos_vm(v, m)
+    wv = rng.randn(2, 3).astype(np.float32)
+    mv = rng.randn(2, 12).astype(np.float32)
+    vv = rng.randn(2, 4).astype(np.float32)
+    got, _, _ = _run(lc, {"w": wv, "m": mv})
+    want = np.einsum("bk,bkd->bd", wv, mv.reshape(2, 3, 4))
+    np.testing.assert_allclose(np.asarray(got.value), want, rtol=1e-5)
+    got2, _, _ = _run(cv, {"v": vv, "m": mv})
+    mm = mv.reshape(2, 3, 4)
+    want2 = np.einsum("bd,bkd->bk", vv, mm) / (
+        np.linalg.norm(vv, axis=1, keepdims=True) * np.linalg.norm(mm, axis=2) + 1e-8)
+    np.testing.assert_allclose(np.asarray(got2.value), want2, rtol=1e-4)
+
+
+def test_get_output_lstm_cell_state(rng):
+    x = nn.data("x", size=0, is_seq=True, dtype="int32")
+    emb = nn.embedding(x, 8, vocab_size=20)
+    lstm = nn.lstmemory(emb, 6, name="l")
+    # lstmemory exposes final states via Act.state
+    topo_probe = nn.Topology(lstm)
+    p, s = topo_probe.init(jax.random.PRNGKey(0))
+    feed = {"x": (rng.randint(0, 20, (2, 5)), np.array([5, 3]))}
+    acts, _ = topo_probe.apply(p, s, feed)
+    keys = sorted(acts[lstm.name].state)
+    assert keys, "lstmemory exposes no aux state"
+    out = nn.get_output(lstm, keys[0])
+    got, _, _ = _run(out, feed)
+    assert np.asarray(got.value).shape[0] == 2
+
+
+def test_lambda_cost_prefers_correct_ranking(rng):
+    s = nn.data("s", size=1, is_seq=True)
+    l = nn.data("l", size=1, is_seq=True)
+    out = nn.lambda_cost(s, l, NDCG_num=3)
+    rel = np.array([[3.0, 2.0, 1.0, 0.0]], np.float32)[..., None]
+    lens = np.array([4])
+    good = np.array([[4.0, 3.0, 2.0, 1.0]], np.float32)[..., None]
+    bad = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)[..., None]
+    c_good, _, _ = _run(out, {"s": (good, lens), "l": (rel, lens)})
+    nn.reset_naming()
+    s2 = nn.data("s", size=1, is_seq=True)
+    l2 = nn.data("l", size=1, is_seq=True)
+    out2 = nn.lambda_cost(s2, l2, NDCG_num=3)
+    c_bad, _, _ = _run(out2, {"s": (bad, lens), "l": (rel, lens)})
+    assert float(c_good.value) < float(c_bad.value)
+
+
+def test_selective_fc(rng):
+    x = nn.data("x", size=5)
+    sel = nn.data("sel", size=7)
+    out = nn.selective_fc(x, sel, 7, act="linear", name="sfc")
+    xv = rng.randn(3, 5).astype(np.float32)
+    sv = (rng.rand(3, 7) > 0.5).astype(np.float32)
+    got, params, _ = _run(out, {"x": xv, "sel": sv})
+    v = np.asarray(got.value)
+    assert np.all(v[sv == 0] == 0)
+    dense = xv @ np.asarray(params["_sfc.w0"]) + np.asarray(params["_sfc.wbias"])
+    np.testing.assert_allclose(v[sv == 1], dense[sv == 1], rtol=1e-4, atol=1e-5)
+
+
+def test_spp_fixed_size(rng):
+    img = nn.data("img", size=3, height=7, width=5)
+    out = nn.spp(img, pyramid_height=3)
+    assert out.size == 3 * (1 + 4 + 16)
+    xv = rng.rand(2, 7, 5, 3).astype(np.float32)
+    got, _, _ = _run(out, {"img": xv})
+    assert np.asarray(got.value).shape == (2, out.size)
+    # the 1x1 bin is the global max
+    np.testing.assert_allclose(np.asarray(got.value)[:, :3],
+                               xv.max(axis=(1, 2)), rtol=1e-6)
+
+
+def test_priorbox_shapes_and_bounds(rng):
+    img = nn.data("img", size=3, height=32, width=32)
+    feat = nn.img_pool(nn.img_conv(img, filter_size=3, num_filters=4),
+                       pool_size=4, stride=4)
+    pb = nn.priorbox(feat, img, min_size=[10], max_size=[20],
+                     aspect_ratio=[2.0])
+    got, _, _ = _run(pb, {"img": rng.rand(1, 32, 32, 3).astype(np.float32)})
+    v = np.asarray(got.value)
+    assert v.shape == (1, 2, pb.size)
+    assert v[0, 0].min() >= 0.0 and v[0, 0].max() <= 1.0
+
+
+def test_eos_id(rng):
+    x = nn.data("x", size=0, is_seq=True, dtype="int32")
+    out = nn.eos_id(x, eos_id=1)
+    ids = np.array([[3, 1, 4, 1], [1, 5, 6, 7]], np.int32)
+    got, _, _ = _run(out, {"x": (ids, np.array([4, 2]))})
+    v = np.asarray(got.value)
+    np.testing.assert_array_equal(v, [[0, 1, 0, 1], [1, 0, 0, 0]])
+
+
+def test_img_conv_transpose_upsamples(rng):
+    img = nn.data("img", size=2, height=4, width=4)
+    out = nn.img_conv_transpose(img, filter_size=3, num_filters=5, stride=2)
+    assert out.meta["hw"] == (8, 8)
+    got, _, _ = _run(out, {"img": rng.rand(2, 4, 4, 2).astype(np.float32)})
+    assert np.asarray(got.value).shape == (2, 8, 8, 5)
+
+
+def test_mdlstm_matches_python_loop(rng):
+    img = nn.data("img", size=3, height=3, width=4)
+    out = nn.mdlstmemory(img, 5, name="md")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(1))
+    xv = rng.randn(2, 3, 4, 3).astype(np.float32) * 0.5
+    got, _ = topo.apply(params, state, {"img": xv})
+    v = np.asarray(got[out.name].value)
+    assert v.shape == (2, 3, 4, 5)
+
+    # brute-force python loop with the same params
+    wx = np.asarray(params["_md.wx"]); wl = np.asarray(params["_md.wl"])
+    wt = np.asarray(params["_md.wt"]); b = np.asarray(params["_md.wbias"])
+    H = 5
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    hs = np.zeros((2, 3, 4, H)); cs = np.zeros((2, 3, 4, H))
+    for i in range(3):
+        for j in range(4):
+            h_left = hs[:, i, j - 1] if j > 0 else np.zeros((2, H))
+            c_left = cs[:, i, j - 1] if j > 0 else np.zeros((2, H))
+            h_top = hs[:, i - 1, j] if i > 0 else np.zeros((2, H))
+            c_top = cs[:, i - 1, j] if i > 0 else np.zeros((2, H))
+            z = xv[:, i, j] @ wx + b + h_left @ wl + h_top @ wt
+            ii, fl, ft, o, g = np.split(z, 5, axis=-1)
+            c = sig(fl) * c_left + sig(ft) * c_top + sig(ii) * np.tanh(g)
+            hs[:, i, j] = sig(o) * np.tanh(c)
+            cs[:, i, j] = c
+    np.testing.assert_allclose(v, hs, rtol=1e-4, atol=1e-5)
+
+
+def test_extra2_layers_serialize(rng):
+    """New constructors round-trip through ModelConfig."""
+    from paddle_tpu.config import build_topology, dump_model_config
+
+    x = nn.data("x", size=6)
+    out = nn.prelu(nn.fc(x, 6, name="h"), name="pr")
+    topo = nn.Topology(out)
+    topo2 = build_topology(dump_model_config(topo))
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"x": rng.randn(2, 6).astype(np.float32)}
+    o1, _ = topo.apply(params, state, feed)
+    o2, _ = topo2.apply(params, state, feed)
+    np.testing.assert_allclose(np.asarray(o1["pr"].value),
+                               np.asarray(o2["pr"].value), rtol=1e-6)

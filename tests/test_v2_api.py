@@ -1,0 +1,108 @@
+"""The v2 compatibility facade runs a reference-style script verbatim —
+the analog of the reference's python/paddle/v2/tests."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    yield
+
+
+def test_v2_script_end_to_end(rng):
+    paddle.init()
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(64))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    hidden = paddle.layer.fc(images, size=32, act=paddle.activation.Tanh())
+    out = paddle.layer.fc(hidden, size=10, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    w0 = {k: parameters[k].copy() for k in parameters.names()}
+    opt = paddle.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(rate=1e-4))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=opt)
+
+    def reader():
+        r = np.random.RandomState(0)
+        for _ in range(64):
+            x = r.rand(64).astype("float32")
+            yield x, int(x[:10].argmax())
+
+    seen = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            seen.append(event.cost)
+        if isinstance(event, paddle.event.EndPass):
+            seen.append(("pass", event.pass_id))
+
+    trainer.train(paddle.batch(reader, 16), num_passes=6,
+                  event_handler=handler)
+    assert ("pass", 1) in seen
+    costs = [c for c in seen if isinstance(c, float)]
+    assert costs[-1] < costs[0]
+    # the Parameters object the user holds was updated in place
+    assert any(np.abs(parameters[k] - w0[k]).max() > 0 for k in w0
+               if k in parameters.params)
+
+    # paddle.infer over raw rows
+    probs = paddle.infer(output_layer=out, parameters=parameters,
+                         input=[(np.ones(64, np.float32) * 0.1,)],
+                         feeding={"pixel": 0})
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-4)
+
+
+def test_v2_parameters_tar_roundtrip():
+    images = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(images, size=4, act=paddle.activation.Softmax(),
+                          name="out")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+    p1 = paddle.parameters.create(cost, seed=1)
+    buf = io.BytesIO()
+    p1.to_tar(buf)
+    buf.seek(0)
+
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    images = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(images, size=4, act=paddle.activation.Softmax(),
+                          name="out")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+    p2 = paddle.parameters.create(cost, seed=2)
+    assert np.abs(p2["_out.w0"] - p1["_out.w0"]).max() > 0
+    p2.from_tar(buf)
+    np.testing.assert_array_equal(p2["_out.w0"], p1["_out.w0"])
+
+
+def test_v2_sequence_and_dataset(rng):
+    words = paddle.layer.data(
+        "words", paddle.data_type.integer_value_sequence(100))
+    emb = paddle.layer.embedding(words, 16)
+    pooled = paddle.layer.pooling(emb, pooling_type=paddle.pooling.Max())
+    out = paddle.layer.fc(pooled, size=2, act=paddle.activation.Softmax())
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=paddle.optimizer.Adam())
+    reader = paddle.batch(
+        paddle.dataset.imdb.train(vocab_size=100, n=64), 16)
+    trainer.train(reader, num_passes=1)
+    res = trainer.test(paddle.batch(paddle.dataset.imdb.test(vocab_size=100,
+                                                             n=32), 16))
+    assert np.isfinite(list(res.values())).all()

@@ -286,21 +286,30 @@ def selective_fc(input: LayerOutput, select: LayerOutput, size: int, *,
     outputs are exactly 0), no dynamic shapes."""
     name = name or next_name("selective_fc")
     inputs = [input] if isinstance(input, LayerOutput) else list(input)
-    pa = _pa(param_attr, f"_{name}.w0")
-    wspec = ParamSpec(name=pa.name, shape=(inputs[0].size, size), attr=pa)
-    specs = [wspec]
+    # multiple inputs get separate weight matrices summed, as in fc
+    # (SelectiveFullyConnectedLayer.cpp iterates all inputs)
+    wspecs = []
+    for i, ipt in enumerate(inputs):
+        pa = _pa(param_attr if len(inputs) == 1 else None, f"_{name}.w{i}")
+        wspecs.append(ParamSpec(name=pa.name, shape=(ipt.size, size), attr=pa))
+    specs = list(wspecs)
     ba = _bias_attr(bias_attr, f"_{name}.wbias")
     if ba:
         specs.append(ParamSpec(name=ba.name, shape=(size,), attr=ba))
     act_fn = O.get_activation(act)
 
-    def forward(ctx, params, a: Act, sel: Act) -> Act:
-        y = O.linear(a.value, params[wspec.name],
-                     params[ba.name] if ba else None)
+    def forward(ctx, params, *acts: Act) -> Act:
+        sel = acts[-1]
+        y = None
+        for spec, a in zip(wspecs, acts[:-1]):
+            z = O.linear(a.value, params[spec.name])
+            y = z if y is None else y + z
+        if ba:
+            y = y + params[ba.name].astype(y.dtype)
         y = act_fn(y) * sel.value.astype(y.dtype)
         return Act(value=y)
 
-    return LayerOutput(name, "selective_fc", size, [inputs[0], select],
+    return LayerOutput(name, "selective_fc", size, [*inputs, select],
                        forward, specs)
 
 

@@ -91,12 +91,13 @@ class SGDTrainer:
                 self.sparse_rows[name] = True
             if spec.attr.pruning_ratio:
                 pruning_ratios[name] = spec.attr.pruning_ratio
+        self.pruning_ratios = pruning_ratios
 
         # StaticPruningHook analog: masks fixed from initial magnitudes,
         # re-applied after every update inside the jitted step
         from paddle_tpu.param.hooks import apply_masks, build_masks
 
-        self.masks = build_masks(self.params, pruning_ratios)
+        self.masks = build_masks(self.params, self.pruning_ratios)
         self.params = apply_masks(self.params, self.masks)
 
         self.opt_state = self.optimizer.init_state(self.params)
@@ -183,6 +184,23 @@ class SGDTrainer:
 
     # ------------------------------------------------------------------
 
+    def rebuild_masks(self) -> None:
+        """Rebuild pruning masks from the CURRENT parameter values and refresh
+        the cached jitted step (which closes over the masks).
+
+        The reference builds the pruning mask from the parameter values
+        actually in effect — initial or loaded
+        (paddle/parameter/ParameterUpdaterHook.cpp:36-78) — so whenever
+        ``self.params`` is swapped wholesale (checkpoint load, v2 parameter
+        adoption) the magnitude pattern must be recomputed."""
+        from paddle_tpu.param.hooks import apply_masks, build_masks
+
+        if not self.pruning_ratios:
+            return
+        self.masks = build_masks(self.params, self.pruning_ratios)
+        self.params = apply_masks(self.params, self.masks)
+        self._step = self._build_step()
+
     def train_batch(self, feed: Dict[str, Any]) -> float:
         """Run one optimizer step on a prepared feed dict; returns cost."""
         self._rng, key = jax.random.split(self._rng)
@@ -246,17 +264,30 @@ class SGDTrainer:
         return fn
 
     def test(self, reader: Callable, *, feeder: Optional[Callable] = None) -> Dict[str, float]:
-        """Eval loop — Tester analog (paddle/trainer/Tester.h:40)."""
+        """Eval loop — Tester analog (paddle/trainer/Tester.h:40).
+
+        Reports the same weighted joint cost the train step optimizes (all
+        cost heads, not just the first), plus per-cost values when training
+        is multi-cost."""
         fn = getattr(self, "_test_fn", None)
         if fn is None:
-            fn = self._test_fn = self._infer_fn([self.cost_name])
+            fn = self._test_fn = self._infer_fn(self.cost_names)
         params = self.avg_params if self.avg_params is not None else self.params
-        costs = []
+        totals: List[float] = []
+        per_cost: Dict[str, List[float]] = {n: [] for n in self.cost_names}
         for data_batch in reader():
             feed = feeder(data_batch) if feeder else data_batch
             out = fn(params, self.state, feed)
-            costs.append(float(out[self.cost_name]))
-        return {"cost": float(np.mean(costs)) if costs else float("nan")}
+            vals = {n: float(out[n]) for n in self.cost_names}
+            totals.append(sum(w * vals[n]
+                              for n, w in zip(self.cost_names, self.cost_weights)))
+            for n, v in vals.items():
+                per_cost[n].append(v)
+        result = {"cost": float(np.mean(totals)) if totals else float("nan")}
+        if len(self.cost_names) > 1:
+            for n, vs in per_cost.items():
+                result[f"cost:{n}"] = float(np.mean(vs)) if vs else float("nan")
+        return result
 
     def infer(self, output_layers, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:
         """paddle.infer analog: run forward to the given layers."""
@@ -281,3 +312,4 @@ class SGDTrainer:
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
         )
+        self.rebuild_masks()

@@ -27,16 +27,28 @@ _initialized = False
 
 
 def force_virtual_devices(n: int) -> None:
-    """Force N virtual CPU devices (must run before first jax import/use).
+    """Force N virtual CPU devices (must run before the first jax *backend*
+    initialization; calling it before or after ``import jax`` both work).
 
     Test-only analog of a multi-chip pod; see SURVEY.md §4 (device-equivalence
-    strategy) — used by tests/conftest.py and driver dry runs.
+    strategy) — used by tests/conftest.py and driver dry runs.  Environments
+    like this container import jax at interpreter start (sitecustomize
+    registering a TPU plugin), locking the platform into jax.config before
+    user code runs — so the env vars alone are not enough and the config
+    value is overridden too.
     """
+    import sys
+
     flags = os.environ.get("XLA_FLAGS", "")
     token = f"--xla_force_host_platform_device_count={n}"
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    kept = [t for t in flags.split()
+            if "xla_force_host_platform_device_count" not in t]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [token])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
 
 def init(argv: Optional[list] = None) -> list:

@@ -70,18 +70,26 @@ class Parameters:
 
     def from_tar(self, f) -> None:
         with tarfile.open(fileobj=f, mode="r") as tar:
+            # validate every member before assigning anything, so a
+            # mismatched checkpoint cannot leave this object half-overwritten
+            loaded = {}
             for member in tar.getmembers():
                 if not member.name.endswith(".npy"):
                     continue
-                group, fname = member.name.split("/", 1)
-                name = fname[: -len(".npy")]
+                name = member.name.split("/", 1)[1][: -len(".npy")]
+                if name not in self:
+                    raise ValueError(
+                        f"checkpoint contains unknown parameter {name!r}; "
+                        f"known: {sorted(self.names())}")
                 arr = np.load(io.BytesIO(tar.extractfile(member).read()),
                               allow_pickle=False)
-                self[name] = arr if name in self else arr  # validates shape
-                if group == "params" and name in self.params:
-                    self.params[name] = arr.astype(self.params[name].dtype)
-                elif name in self.state:
-                    self.state[name] = arr.astype(self.state[name].dtype)
+                if arr.shape != self[name].shape:
+                    raise ValueError(
+                        f"parameter {name!r} has shape {self[name].shape}, "
+                        f"checkpoint has {arr.shape}")
+                loaded[name] = arr
+            for name, arr in loaded.items():
+                self[name] = arr  # validates shape, converts dtype
 
 
 def create(cost: LayerOutput, *, seed: int = 0) -> Parameters:

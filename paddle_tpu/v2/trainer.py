@@ -49,6 +49,10 @@ class SGD:
             if name in self._trainer.state:
                 self._trainer.state[name] = np.asarray(
                     arr, dtype=np.asarray(self._trainer.state[name]).dtype)
+        # pruning masks must reflect the adopted values, not the discarded
+        # init (reference: mask built from actual initial values,
+        # ParameterUpdaterHook.cpp:36-78)
+        self._trainer.rebuild_masks()
 
     def _sync_back(self) -> None:
         for name in self._parameters.params:

@@ -7,18 +7,13 @@ mesh; numerical checks compare against numpy and finite differences.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
 
-# The container's sitecustomize imports jax at interpreter start (registering
-# the axon TPU platform), so the env var alone is read too late — override the
-# locked-in config value before any backend initializes.
-import jax
+# force_virtual_devices both sets the env vars and overrides the jax_platforms
+# config value locked in by the container sitecustomize's early jax import.
+from paddle_tpu.utils.devices import force_virtual_devices
 
-jax.config.update("jax_platforms", "cpu")
+force_virtual_devices(8)
 
 import numpy as np
 import pytest

@@ -223,3 +223,23 @@ def test_extra2_layers_serialize(rng):
     o2, _ = topo2.apply(params, state, feed)
     np.testing.assert_allclose(np.asarray(o1["pr"].value),
                                np.asarray(o2["pr"].value), rtol=1e-6)
+
+
+def test_selective_fc_multi_input(rng):
+    """Multiple inputs get separate weights summed, like fc
+    (SelectiveFullyConnectedLayer.cpp iterates all inputs)."""
+    nn.reset_naming()
+    a = nn.data("a", size=5)
+    b = nn.data("b", size=3)
+    sel = nn.data("sel", size=7)
+    out = nn.selective_fc([a, b], sel, 7, act="linear", name="sfc")
+    av = rng.randn(4, 5).astype(np.float32)
+    bv = rng.randn(4, 3).astype(np.float32)
+    sv = (rng.rand(4, 7) > 0.5).astype(np.float32)
+    got, params, _ = _run(out, {"a": av, "b": bv, "sel": sv})
+    v = np.asarray(got.value)
+    dense = (av @ np.asarray(params["_sfc.w0"])
+             + bv @ np.asarray(params["_sfc.w1"])
+             + np.asarray(params["_sfc.wbias"]))
+    assert np.all(v[sv == 0] == 0)
+    np.testing.assert_allclose(v[sv == 1], dense[sv == 1], rtol=1e-4, atol=1e-5)

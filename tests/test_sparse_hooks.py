@@ -163,3 +163,54 @@ def test_pruning_hook_constant_init_keeps_fraction():
     hook = StaticPruningHook(0.5)
     mask = hook.init_mask(jnp.zeros((10, 10), jnp.float32))
     assert float(mask.sum()) == 50.0
+
+
+def test_pruning_mask_rebuilt_on_load(rng, tmp_path):
+    """Masks must be rebuilt from LOADED values, not the discarded init
+    (reference builds masks from the values in effect,
+    ParameterUpdaterHook.cpp:36-78)."""
+    def build():
+        nn.reset_naming()
+        x = nn.data("x", size=8)
+        h = nn.fc(x, 16, name="h",
+                  param_attr=nn.ParamAttr(name="pw", pruning_ratio=0.5))
+        return nn.mse_cost(input=nn.fc(h, 4, name="o"),
+                           label=nn.data("y", size=4))
+
+    feed = {"x": rng.rand(4, 8).astype(np.float32),
+            "y": rng.rand(4, 4).astype(np.float32)}
+    t1 = SGDTrainer(cost=build(), optimizer=Adam(learning_rate=0.01), seed=3)
+    for _ in range(2):
+        t1.train_batch(feed)
+    t1.save(str(tmp_path), 0)
+    pattern1 = np.asarray(t1.params["pw"]) != 0
+
+    # different seed -> different init magnitudes -> different initial mask
+    t2 = SGDTrainer(cost=build(), optimizer=Adam(learning_rate=0.01), seed=77)
+    pattern2_init = np.asarray(t2.params["pw"]) != 0
+    assert (pattern2_init != pattern1).any()
+    t2.load(str(tmp_path), 0)
+    # after load the mask reflects the loaded weights' pattern
+    np.testing.assert_array_equal(np.asarray(t2.masks["pw"]) != 0, pattern1)
+    t2.train_batch(feed)
+    np.testing.assert_array_equal(np.asarray(t2.params["pw"]) != 0, pattern1)
+
+
+def test_multi_cost_test_reports_weighted_sum(rng):
+    nn.reset_naming()
+    x = nn.data("x", size=6)
+    shared = nn.fc(x, 8, name="shared")
+    ca = nn.classification_cost(
+        input=nn.fc(shared, 3, act="softmax", name="ha"),
+        label=nn.data("ya", size=3, dtype="int32"), name="cost_a")
+    cb = nn.mse_cost(input=nn.fc(shared, 1, name="hb"),
+                     label=nn.data("yb", size=1), name="cost_b")
+    tr = SGDTrainer(cost=[ca, cb], optimizer=Adam(learning_rate=0.01),
+                    cost_weights=[1.0, 0.5])
+    feed = {"x": rng.rand(8, 6).astype(np.float32),
+            "ya": rng.randint(0, 3, (8,)),
+            "yb": rng.rand(8, 1).astype(np.float32)}
+    res = tr.test(lambda: iter([feed]))
+    assert set(res) == {"cost", "cost:cost_a", "cost:cost_b"}
+    np.testing.assert_allclose(
+        res["cost"], res["cost:cost_a"] + 0.5 * res["cost:cost_b"], rtol=1e-6)

@@ -106,3 +106,26 @@ def test_v2_sequence_and_dataset(rng):
     res = trainer.test(paddle.batch(paddle.dataset.imdb.test(vocab_size=100,
                                                              n=32), 16))
     assert np.isfinite(list(res.values())).all()
+
+
+def test_v2_parameters_from_tar_unknown_name_raises():
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(x, size=4, name="out")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    p1 = paddle.parameters.create(
+        paddle.layer.classification_cost(input=out, label=lbl), seed=1)
+    buf = io.BytesIO()
+    p1.to_tar(buf)
+    buf.seek(0)
+
+    nn.reset_naming()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(x, size=4, name="DIFFERENT")  # different param names
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    p2 = paddle.parameters.create(
+        paddle.layer.classification_cost(input=out, label=lbl), seed=2)
+    with pytest.raises(ValueError, match="unknown parameter"):
+        p2.from_tar(buf)

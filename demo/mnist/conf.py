@@ -1,0 +1,32 @@
+"""CLI config for the mnist demo: ``python -m paddle_tpu --job=train
+--config=demo/mnist/conf.py`` — the trainer-config analog of the reference's
+demo/mnist configs driven by paddle_trainer (TrainerMain.cpp:32-65)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+
+N = int(os.environ.get("MNIST_N", "512"))
+BATCH = int(os.environ.get("MNIST_BATCH", "64"))
+
+
+def get_config():
+    nn.reset_naming()
+    cost, logits = models.lenet5()
+    return {
+        "cost": cost,
+        "optimizer": Adam(learning_rate=1e-3),
+        "reader": data.shuffle(
+            data.batch(data.datasets.mnist("train", n=N), BATCH), 10),
+        # drop_last=False: eval tolerates one ragged tail batch (one extra
+        # compile) rather than silently skipping a small test split
+        "test_reader": data.batch(data.datasets.mnist("test", n=N // 4), BATCH,
+                                  drop_last=False),
+        "feeder": data.DataFeeder({"pixel": "dense", "label": "int"}),
+    }

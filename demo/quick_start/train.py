@@ -27,9 +27,21 @@ def bow_net(vocab):
     return nn.classification_cost(input=out, label=lbl), out
 
 
+def sparse_lr_net(vocab):
+    """LR straight over a sparse_binary_vector bag-of-words input — the
+    reference's actual trainer_config.lr.py shape (fc over sparse input,
+    no embedding): the fc computes by row gather (hl_sparse analog)."""
+    words = nn.data("words", size=vocab, sparse="binary")
+    out = nn.fc(words, 2, act="softmax", name="out",
+                param_attr=nn.ParamAttr(name="lr_w", sparse_grad=True))
+    lbl = nn.data("label", size=2, dtype="int32")
+    return nn.classification_cost(input=out, label=lbl), out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", choices=["lr", "cnn", "lstm"], default="lr")
+    ap.add_argument("--config", choices=["lr", "lr_sparse", "cnn", "lstm"],
+                    default="lr")
     ap.add_argument("--passes", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--n", type=int, default=512)
@@ -38,13 +50,16 @@ def main(argv=None):
     nn.reset_naming()
     if args.config == "lr":
         cost, _ = bow_net(VOCAB)
+    elif args.config == "lr_sparse":
+        cost, _ = sparse_lr_net(VOCAB)
     elif args.config == "cnn":
         cost, _ = models.convolution_net(VOCAB, emb_dim=32, hid_dim=32)
     else:
         cost, _ = models.stacked_lstm_net(VOCAB, emb_dim=32, hid_dim=32,
                                           stacked_num=3)
     trainer = SGDTrainer(cost, Adam(learning_rate=2e-3), seed=0)
-    feeder = data.DataFeeder({"words": "ids_seq", "label": "int"}, max_len=96)
+    words_kind = "sparse_ids" if args.config == "lr_sparse" else "ids_seq"
+    feeder = data.DataFeeder({"words": words_kind, "label": "int"}, max_len=96)
     reader = data.batch(
         data.datasets.imdb("train", vocab_size=VOCAB, n=args.n), args.batch_size)
 

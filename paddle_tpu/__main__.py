@@ -1,0 +1,185 @@
+"""The ``paddle_trainer`` CLI analog: ``python -m paddle_tpu --job=... --config=...``.
+
+Reference: the paddle_trainer binary drives train / test / checkgrad / time
+from gflags + a Python-generated config (paddle/trainer/TrainerMain.cpp:32-65;
+Trainer.h:43-202 init/train/test/checkGradient/time;
+TrainerBenchmark.cpp for --job=time).
+
+The ``--config`` file is a Python module defining ``get_config()`` returning a
+dict (the TrainerConfigHelper plane — here the config IS Python, no embedded
+interpreter needed):
+
+    cost         LayerOutput (or list) — required
+    optimizer    Optimizer (default SGD lr=0.01)
+    reader       () -> iterable of batches — required for train/time
+    feeder       batch -> feed dict (optional)
+    test_reader  () -> iterable (optional; falls back to reader for --job=test)
+    trainer_kwargs  extra SGDTrainer kwargs (optional)
+
+Flags shared with the reference's surface: --save_dir, --start_pass,
+--num_passes, --log_period, --checkgrad_eps, --enable_timers, --profile_dir.
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _load_config(path: str):
+    from paddle_tpu.utils.error import ConfigError
+
+    ns = runpy.run_path(path)
+    if "get_config" not in ns:
+        raise ConfigError(f"config {path!r} does not define get_config()")
+    conf = ns["get_config"]()
+    if "cost" not in conf:
+        raise ConfigError(f"get_config() in {path!r} returned no 'cost'")
+    return conf
+
+
+def _build_trainer(conf):
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    return SGDTrainer(
+        conf["cost"],
+        conf.get("optimizer") or SGD(learning_rate=0.01),
+        **conf.get("trainer_kwargs", {}),
+    )
+
+
+def _first_feed(conf):
+    feeder = conf.get("feeder")
+    batch = next(iter(conf["reader"]()))
+    return feeder(batch) if feeder else batch
+
+
+def job_train(conf) -> int:
+    from paddle_tpu.trainer import events as ev
+    from paddle_tpu.trainer.checkpoint import latest_pass
+    from paddle_tpu.utils import FLAGS, logger
+
+    trainer = _build_trainer(conf)
+    if FLAGS.save_dir and FLAGS.start_pass > 0:
+        resume = min(FLAGS.start_pass - 1, latest_pass(FLAGS.save_dir))
+        if resume >= 0:
+            logger.info("resuming from pass %d", resume)
+            trainer.load(FLAGS.save_dir, resume)
+
+    def handler(e):
+        if isinstance(e, ev.EndPass):
+            logger.info("pass %d done: %s", e.pass_id, e.evaluator)
+
+    trainer.train(
+        conf["reader"],
+        num_passes=FLAGS.num_passes,
+        feeder=conf.get("feeder"),
+        test_reader=conf.get("test_reader"),
+        event_handler=handler,
+    )
+    return 0
+
+
+def job_test(conf) -> int:
+    from paddle_tpu.trainer.checkpoint import latest_pass
+    from paddle_tpu.utils import FLAGS, logger
+    from paddle_tpu.utils.error import ConfigError
+
+    trainer = _build_trainer(conf)
+    if FLAGS.save_dir:
+        p = FLAGS.test_pass if FLAGS.test_pass >= 0 else latest_pass(FLAGS.save_dir)
+        if p < 0:
+            raise ConfigError(f"no checkpoint under {FLAGS.save_dir!r}")
+        trainer.load(FLAGS.save_dir, p)
+        logger.info("testing checkpoint pass %d", p)
+    reader = conf.get("test_reader") or conf["reader"]
+    result = trainer.test(reader, feeder=conf.get("feeder"))
+    logger.info("test result: %s", result)
+    print({k: round(v, 6) for k, v in result.items()})
+    return 0
+
+
+def job_checkgrad(conf) -> int:
+    """Finite-difference check of the whole-model gradient on one batch
+    (Trainer::checkGradient analog)."""
+    from paddle_tpu.trainer.checkgrad import check_gradients
+    from paddle_tpu.utils import FLAGS, logger
+
+    trainer = _build_trainer(conf)
+    feed = _first_feed(conf)
+
+    def loss_fn(params):
+        outs, _ = trainer.topology.apply(params, trainer.state, feed, train=False)
+        return sum(
+            w * outs[n].value
+            for n, w in zip(trainer.cost_names, trainer.cost_weights)
+        )
+
+    # whole-model FD through relu/maxpool kinks is rougher than per-op
+    # checks; the reference's checkgrad mode uses epsilon~0.02 similarly
+    report = check_gradients(loss_fn, trainer.params, eps=FLAGS.checkgrad_eps,
+                             rtol=1e-1, atol=5e-3)
+    worst = max(report.values()) if report else 0.0
+    logger.info("checkgrad OK: %d params, worst abs err %.3g", len(report), worst)
+    print(f"checkgrad OK ({len(report)} parameters, worst abs err {worst:.3g})")
+    return 0
+
+
+def job_time(conf) -> int:
+    """--job=time: ms/batch over N timed batches after warmup
+    (TrainerBenchmark.cpp analog)."""
+    import jax
+
+    from paddle_tpu.utils import FLAGS, logger
+
+    trainer = _build_trainer(conf)
+    feeder = conf.get("feeder")
+    n = max(1, FLAGS.time_batches)
+    feeds = []
+    for i, batch in enumerate(conf["reader"]()):
+        if i >= n:
+            break
+        feeds.append(feeder(batch) if feeder else batch)
+    loss = trainer.train_batch(feeds[0])  # warmup/compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for feed in feeds:
+        loss = trainer.train_batch(feed)
+    float(np.asarray(loss))  # sync
+    ms = (time.perf_counter() - t0) / len(feeds) * 1e3
+    logger.info("%d batches, %.3f ms/batch", len(feeds), ms)
+    print(f"{ms:.3f} ms/batch over {len(feeds)} batches")
+    return 0
+
+
+JOBS = {
+    "train": job_train,
+    "test": job_test,
+    "checkgrad": job_checkgrad,
+    "time": job_time,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from paddle_tpu.utils import FLAGS
+    from paddle_tpu.utils.devices import init
+    from paddle_tpu.utils.error import ConfigError
+
+    rest = init(list(sys.argv[1:]) if argv is None else list(argv))
+    if rest:
+        raise ConfigError(f"unrecognized arguments: {rest}")
+    if FLAGS.job not in JOBS:
+        raise ConfigError(f"--job must be one of {sorted(JOBS)}, got {FLAGS.job!r}")
+    if not FLAGS.config:
+        raise ConfigError("--config=<file.py> is required")
+    conf = _load_config(FLAGS.config)
+    return JOBS[FLAGS.job](conf)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,6 +145,7 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
         lc.call_id = call_renumber.setdefault(cfg["call_id"], len(call_renumber))
         if "device" in node.meta:
             lc.device = str(node.meta["device"])
+        _fill_typed(lc, node, kwargs)
     for pname in sorted(topology.param_specs):
         spec = topology.param_specs[pname]
         a = spec.attr
@@ -164,6 +165,91 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
     mc.input_layer_names.extend(l.name for l in topology.data_layers)
     mc.output_layer_names.extend(topology.output_names())
     return mc
+
+
+#: cost-layer constructors covered by the CostConf typed contract
+_COST_TYPES = frozenset({
+    "classification_cost", "cross_entropy_cost", "soft_cross_entropy_cost",
+    "cross_entropy_with_selfnorm", "mse_cost", "huber_cost", "smooth_l1_cost",
+    "multi_binary_label_cross_entropy", "sum_cost", "rank_cost", "lambda_cost",
+    "crf_cost", "ctc_cost", "nce_cost", "hsigmoid_cost",
+})
+
+
+def _has_bias(kwargs: Dict[str, Any]) -> bool:
+    b = kwargs.get("bias_attr", True)
+    return b is not False and b is not None
+
+
+def _fill_typed(lc, node, kwargs: Dict[str, Any]) -> None:
+    """Populate the typed oneof for the top layer families — the reference's
+    per-layer typed proto fields (proto/ModelConfig.proto), giving deploy
+    bundles a schema-level contract on top of the complete JSON record."""
+    t = lc.type
+    if t == "fc":
+        lc.fc.size = int(node.size)
+        lc.fc.act = str(kwargs.get("act", "tanh"))
+        lc.fc.has_bias = _has_bias(kwargs)
+    elif t == "img_conv":
+        lc.conv.filter_size = int(kwargs.get("filter_size", 3))
+        lc.conv.num_filters = int(kwargs.get("num_filters", node.size))
+        lc.conv.stride = int(kwargs.get("stride", 1))
+        lc.conv.padding = str(kwargs.get("padding", "SAME"))
+        lc.conv.groups = int(kwargs.get("groups", 1))
+        lc.conv.act = str(kwargs.get("act", "tanh"))
+        lc.conv.has_bias = _has_bias(kwargs)
+    elif t == "img_pool":
+        lc.pool.pool_type = str(kwargs.get("pool_type", "max"))
+        lc.pool.pool_size = int(kwargs.get("pool_size", 2))
+        lc.pool.stride = int(kwargs.get("stride", kwargs.get("pool_size", 2)))
+        lc.pool.padding = str(kwargs.get("padding", "VALID"))
+    elif t == "batch_norm":
+        lc.batch_norm.act = str(kwargs.get("act", "relu"))
+        lc.batch_norm.momentum = float(kwargs.get("momentum", 0.9))
+        lc.batch_norm.epsilon = float(kwargs.get("epsilon", 1e-5))
+    elif t in ("lstmemory", "grumemory"):
+        dst = lc.lstm if t == "lstmemory" else lc.gru
+        dst.size = int(node.size)
+        dst.act = str(kwargs.get("act", "tanh"))
+        dst.gate_act = str(kwargs.get("gate_act", "sigmoid"))
+        if t == "lstmemory":
+            dst.state_act = str(kwargs.get("state_act", "tanh"))
+        dst.reverse = bool(kwargs.get("reverse", False))
+    elif t == "embedding":
+        lc.embedding.emb_dim = int(node.size)
+        lc.embedding.vocab_size = int(kwargs.get("vocab_size") or 0)
+    elif t in _COST_TYPES:
+        lc.cost.cost_type = t
+
+
+def _check_typed(lc, node) -> None:
+    """Schema-level validation of a rebuilt node against the typed contract
+    (detects a tampered/mismatched config_json)."""
+    which = lc.WhichOneof("typed")
+    if which is None:
+        return  # older bundle or uncovered layer type: JSON plane only
+    if which == "fc" and lc.fc.size != node.size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed fc.size={lc.fc.size} != rebuilt "
+            f"size={node.size}")
+    if which == "conv" and lc.conv.num_filters != node.size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed conv.num_filters={lc.conv.num_filters}"
+            f" != rebuilt size={node.size}")
+    if which in ("lstm", "gru"):
+        conf = lc.lstm if which == "lstm" else lc.gru
+        if conf.size != node.size:
+            raise ConfigError(
+                f"layer {lc.name!r}: typed {which}.size={conf.size} != "
+                f"rebuilt size={node.size}")
+    if which == "embedding" and lc.embedding.emb_dim != node.size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed embedding.emb_dim="
+            f"{lc.embedding.emb_dim} != rebuilt size={node.size}")
+    if which == "cost" and lc.cost.cost_type != lc.type:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed cost_type={lc.cost.cost_type!r} != "
+            f"type={lc.type!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +320,7 @@ def _check_rebuilt(lc, out: LayerOutput) -> None:
         raise ConfigError(
             f"layer {lc.name!r}: rebuilt size {out.size} != recorded {lc.size}"
         )
+    _check_typed(lc, out)
 
 
 def _check_params(mc: pb.ModelConfig, topo: Topology) -> None:

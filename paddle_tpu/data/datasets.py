@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import struct
+import zlib
 from typing import Callable, Iterator, List, Tuple
 
 import numpy as np
@@ -24,7 +25,9 @@ DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/
 
 
 def _synth_rng(name: str, split: str) -> np.random.RandomState:
-    return np.random.RandomState(abs(hash((name, split))) % (2**31))
+    # stable across processes (Python's hash() is randomized per process,
+    # which would make synthetic datasets nondeterministic)
+    return np.random.RandomState(zlib.crc32(f"{name}/{split}".encode()) % (2**31))
 
 
 # ---------------------------------------------------------------------------

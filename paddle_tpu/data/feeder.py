@@ -63,9 +63,65 @@ class DataFeeder:
                 feed[name] = arr
             elif kind in ("ids_seq", "dense_seq"):
                 feed[name] = self._pad_seq(col, kind)
+            elif kind in ("sparse_ids", "sparse_pairs"):
+                feed[name] = self._pad_sparse(col, kind)
+            elif kind in ("ids_nested", "dense_nested"):
+                feed[name] = self._pad_nested(col, kind)
             else:
                 raise ValueError(f"unknown slot kind {kind!r} for {name!r}")
         return feed
+
+    def _pad_nested(self, col: List, kind: str):
+        """Nested sequences (rows are lists of sub-sequences; the
+        subSequenceStartPositions analog, Argument.h:90) -> padded
+        (value [B, To, Ti(, D)], outer_lengths [B], sub_lengths [B, To])."""
+        outer = np.asarray([len(s) for s in col], np.int32)
+        To = bucket_length(max(int(outer.max()) if len(outer) else 1, 1),
+                           self.buckets)
+        ti_max = max((len(sub) for row in col for sub in row), default=1)
+        Ti = bucket_length(max(ti_max, 1), self.buckets)
+        sub_lengths = np.zeros((len(col), To), np.int32)
+        if kind == "ids_nested":
+            out = np.zeros((len(col), To, Ti), np.int32)
+            for i, row in enumerate(col):
+                for j, sub in enumerate(row):
+                    sub = list(sub)[:Ti]
+                    out[i, j, : len(sub)] = sub
+                    sub_lengths[i, j] = len(sub)
+        else:
+            D = len(col[0][0][0])
+            out = np.zeros((len(col), To, Ti, D), self.dtype)
+            for i, row in enumerate(col):
+                for j, sub in enumerate(row):
+                    sub = np.asarray(sub, self.dtype)[:Ti]
+                    out[i, j, : len(sub)] = sub
+                    sub_lengths[i, j] = len(sub)
+        return out, outer, sub_lengths
+
+    def _pad_sparse(self, col: List, kind: str):
+        """Sparse rows -> padded COO: 'sparse_ids' rows are id lists
+        (sparse_binary_vector), 'sparse_pairs' rows are (id, weight) lists
+        (sparse_float_vector).  Returns (ids, nnz) or (ids, weights, nnz)
+        with the nnz width bucketed like sequence lengths."""
+        nnz = np.asarray([len(s) for s in col], np.int32)
+        N = int(nnz.max()) if len(nnz) else 1
+        if self.max_len:
+            N = min(max(N, 1), self.max_len)
+            nnz = np.minimum(nnz, self.max_len)
+        N = bucket_length(max(N, 1), self.buckets)
+        ids = np.zeros((len(col), N), np.int32)
+        if kind == "sparse_ids":
+            for i, s in enumerate(col):
+                s = list(s)[: nnz[i]]
+                ids[i, : len(s)] = s
+            return ids, nnz
+        weights = np.zeros((len(col), N), self.dtype)
+        for i, s in enumerate(col):
+            s = list(s)[: nnz[i]]
+            for j, (idx, w) in enumerate(s):
+                ids[i, j] = idx
+                weights[i, j] = w
+        return ids, weights, nnz
 
     def _pad_seq(self, col: List, kind: str) -> Tuple[np.ndarray, np.ndarray]:
         lengths = np.asarray([len(s) for s in col], np.int32)

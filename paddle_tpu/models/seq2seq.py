@@ -88,6 +88,10 @@ class Seq2SeqAttention:
         enc = jnp.concatenate([h_fw, h_bw], axis=-1)
         enc_proj = O.linear(enc, params["enc_proj_w"], params["enc_proj_b"])
         s0 = jnp.tanh(O.linear(h_bw_fin, params["boot_w"], params["boot_b"]))
+        # enc/enc_proj are re-read on every decode step from inside the scan;
+        # store them in the bf16 compute dtype once so the attention tier's
+        # bandwidth-bound reads are halved (no-op when compute dtype is f32)
+        enc, enc_proj = O.mxu_cast(enc, enc_proj)
         return enc, enc_proj, s0
 
     def _dec_step(self, params, y_emb, s, enc, enc_proj, src_mask):
@@ -120,8 +124,10 @@ class Seq2SeqAttention:
             return s_new, s_new
 
         _, states = O.scan_rnn(step, s0, y_emb, trg_mask)  # [B,T,D]
-        logits = O.linear(states, params["out_w"], params["out_b"])
-        return O.sequence_cross_entropy(logits, trg_next, trg_mask)
+        # fused readout+CE: the [B,T,30k] logits buffer stays in the bf16
+        # compute dtype (the f32 version dominates HBM traffic otherwise)
+        return O.sequence_softmax_ce_readout(
+            states, params["out_w"], params["out_b"], trg_next, trg_mask)
 
     # ------------------------------------------------------------------
 

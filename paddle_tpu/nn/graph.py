@@ -73,29 +73,40 @@ class Act:
     value: [B, D] (non-seq), [B, T, D] (sequence) or int ids [B, T].
     lengths/mask present iff the activation is a sequence. ``state`` carries
     auxiliary outputs (e.g. RNN final cell state, attention weights).
+
+    Nested (sub)sequences — the subSequenceStartPositions analog (reference:
+    paddle/parameter/Argument.h:90,152): value is [B, To, Ti(, D)] with
+    ``lengths``/``mask`` indexing the OUTER level (number of sub-sequences)
+    and ``sub_lengths`` [B, To] the inner token counts per sub-sequence.
     """
 
     value: Any
     lengths: Optional[Any] = None
     mask: Optional[Any] = None
+    sub_lengths: Optional[Any] = None
     state: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def is_seq(self) -> bool:
         return self.lengths is not None
 
+    @property
+    def is_nested(self) -> bool:
+        return self.sub_lengths is not None
+
     def tree_flatten(self):
         keys = tuple(sorted(self.state))
-        children = (self.value, self.lengths, self.mask) + tuple(
+        children = (self.value, self.lengths, self.mask, self.sub_lengths) + tuple(
             self.state[k] for k in keys
         )
         return children, keys
 
     @classmethod
     def tree_unflatten(cls, keys, children):
-        value, lengths, mask = children[:3]
-        state = dict(zip(keys, children[3:]))
-        return cls(value=value, lengths=lengths, mask=mask, state=state)
+        value, lengths, mask, sub_lengths = children[:4]
+        state = dict(zip(keys, children[4:]))
+        return cls(value=value, lengths=lengths, mask=mask,
+                   sub_lengths=sub_lengths, state=state)
 
 
 # ---------------------------------------------------------------------------
@@ -249,12 +260,23 @@ class Topology:
     suitable for jit/pjit/grad.
     """
 
+    #: layer types with a sparse-input compute path; anything else consuming
+    #: a sparse data layer is a config error (it would misread the id array)
+    SPARSE_AWARE = frozenset({"fc", "selective_fc"})
+
     def __init__(self, outputs: Sequence[LayerOutput] | LayerOutput):
         if isinstance(outputs, LayerOutput):
             outputs = [outputs]
         self.outputs: List[LayerOutput] = list(outputs)
         self.layers: List[LayerOutput] = self._toposort(self.outputs)
         self.data_layers: List[LayerOutput] = [l for l in self.layers if l.is_data]
+        for layer in self.layers:
+            for p in layer.parents:
+                if p.meta.get("sparse") and layer.layer_type not in self.SPARSE_AWARE:
+                    raise ConfigError(
+                        f"layer {layer.name!r} ({layer.layer_type}) cannot "
+                        f"consume sparse input {p.name!r}; sparse-aware "
+                        f"layers: {sorted(self.SPARSE_AWARE)}")
         self.param_specs: Dict[str, ParamSpec] = {}
         for layer in self.layers:
             for spec in layer.param_specs:
@@ -389,8 +411,28 @@ def _coerce_feed(layer: LayerOutput, feed: Dict[str, Any]) -> Act:
     if layer.name not in feed:
         raise ConfigError(f"missing feed for data layer {layer.name!r}")
     v = feed[layer.name]
+    sparse = (layer.data_spec or {}).get("sparse")
+    if sparse and not isinstance(v, Act):
+        # padded COO rows: (ids, nnz) for binary, (ids, weights, nnz) for float
+        if not isinstance(v, tuple) or len(v) not in (2, 3):
+            raise ConfigError(
+                f"sparse data layer {layer.name!r} expects (ids, nnz) or "
+                f"(ids, weights, nnz), got {type(v).__name__}")
+        ids = jnp.asarray(v[0])
+        nnz = jnp.asarray(v[-1])
+        valid = jnp.arange(ids.shape[1])[None, :] < nnz[:, None]
+        if len(v) == 3:
+            weights = jnp.asarray(v[1])
+        else:
+            weights = valid.astype(jnp.float32)
+        return Act(value=ids, mask=valid.astype(jnp.float32),
+                   state={"weights": weights})
     if isinstance(v, Act):
         act = v
+    elif isinstance(v, tuple) and len(v) == 3 and (layer.data_spec or {}).get("nested"):
+        value, lengths, sub_lengths = v
+        act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths),
+                  sub_lengths=jnp.asarray(sub_lengths))
     elif isinstance(v, tuple):
         value, lengths = v
         act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths))

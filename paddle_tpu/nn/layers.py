@@ -92,7 +92,8 @@ def _bias_attr(bias: AttrLike, default_name: str) -> Optional[ParamAttr]:
 
 
 def _seq_like(parent: Act, value) -> Act:
-    return Act(value=value, lengths=parent.lengths, mask=parent.mask)
+    return Act(value=value, lengths=parent.lengths, mask=parent.mask,
+               sub_lengths=parent.sub_lengths)
 
 
 # ---------------------------------------------------------------------------
@@ -101,15 +102,32 @@ def _seq_like(parent: Act, value) -> Act:
 
 
 def data(name: str, *, size: int = 0, is_seq: bool = False, dtype: str = "float32",
-         height: Optional[int] = None, width: Optional[int] = None) -> LayerOutput:
+         height: Optional[int] = None, width: Optional[int] = None,
+         sparse: Optional[str] = None, nested: bool = False) -> LayerOutput:
     """Input layer — analog of data_layer (layers.py:200-ish) / DataLayer.cpp.
 
     For images pass height/width; feed shape is NHWC [B, H, W, size].
     For sequences feed (value [B, T, size] | ids [B, T], lengths [B]).
+    For nested sequences (``nested=True``, the subSequenceStartPositions
+    analog, Argument.h:90) feed (value [B, To, Ti(, size)] | ids [B, To, Ti],
+    outer_lengths [B], sub_lengths [B, To]).
+    For sparse features (``sparse='binary'|'float'``, the
+    sparse_binary_vector / sparse_float_vector input types,
+    reference py_paddle/dataprovider_converter.py SparseBinaryScanner) the
+    feed is padded COO rows: (ids [B, N], nnz [B]) for binary or
+    (ids [B, N], weights [B, N], nnz [B]) for float; ``size`` is the full
+    sparse dimension.  Sparse inputs feed sparse-aware layers (fc,
+    selective_fc) which compute by row gather instead of densifying.
     """
+    if sparse not in (None, "binary", "float"):
+        raise ConfigError(f"sparse must be 'binary' or 'float', got {sparse!r}")
+    if nested and not is_seq:
+        raise ConfigError("nested=True requires is_seq=True")
     meta = {}
     if height is not None:
         meta["hw"] = (height, width)
+    if sparse:
+        meta["sparse"] = sparse
     return LayerOutput(
         name=name,
         layer_type="data",
@@ -117,7 +135,9 @@ def data(name: str, *, size: int = 0, is_seq: bool = False, dtype: str = "float3
         parents=[],
         forward=None,
         is_data=True,
-        data_spec={"dtype": dtype, "is_seq": is_seq},
+        data_spec={"dtype": dtype, "is_seq": is_seq,
+                   **({"sparse": sparse} if sparse else {}),
+                   **({"nested": True} if nested else {})},
         meta=meta,
     )
 
@@ -143,6 +163,7 @@ def fc(input: Union[LayerOutput, Sequence[LayerOutput]], size: int, *,
     inputs = [input] if isinstance(input, LayerOutput) else list(input)
     name = name or next_name("fc")
     specs, attrs = [], []
+    sparse_kinds = [ipt.meta.get("sparse") for ipt in inputs]
     for i, ipt in enumerate(inputs):
         pa = _pa(param_attr if len(inputs) == 1 else None, f"_{name}.w{i}")
         spec = ParamSpec(name=pa.name, shape=(_flat_in_size(ipt), size), attr=pa)
@@ -155,7 +176,14 @@ def fc(input: Union[LayerOutput, Sequence[LayerOutput]], size: int, *,
 
     def forward(ctx, params, *acts: Act) -> Act:
         out = None
-        for spec, a in zip(specs[: len(inputs)], acts):
+        for spec, a, sparse in zip(specs[: len(inputs)], acts, sparse_kinds):
+            if sparse:
+                # bag-of-features input: gather rows + weighted sum, the
+                # hl_sparse csr_mul_dense analog (ops/sparse.py)
+                y = O.sparse_gather_matmul(a.value, a.state["weights"],
+                                           a.mask, params[spec.name])
+                out = y if out is None else out + y
+                continue
             v = a.value
             if not a.is_seq and v.ndim > 2:
                 v = v.reshape(v.shape[0], -1)

@@ -278,14 +278,35 @@ def lambda_cost(score: LayerOutput, label: LayerOutput, *,
 def selective_fc(input: LayerOutput, select: LayerOutput, size: int, *,
                  act: str = "tanh", name: Optional[str] = None,
                  param_attr: AttrLike = None,
-                 bias_attr: AttrLike = True) -> LayerOutput:
+                 bias_attr: AttrLike = True,
+                 select_mode: str = "mask") -> LayerOutput:
     """FC evaluated only on selected output columns — analog of
     SelectiveFullyConnectedLayer (SelectiveFullyConnectedLayer.cpp: skip
-    unselected columns for huge softmax fronts).  TPU-native: the matmul is
-    MXU-cheap, so compute densely and mask — same semantics (unselected
-    outputs are exactly 0), no dynamic shapes."""
+    unselected columns for huge softmax fronts).
+
+    Two TPU-native compute paths:
+    - ``select_mode='mask'``: ``select`` is a dense 0/1 vector [B, size];
+      compute densely on the MXU and mask — same semantics (unselected
+      outputs are exactly 0), no dynamic shapes.  Right when the selected
+      fraction is large.
+    - ``select_mode='ids'``: ``select`` carries integer candidate ids
+      [B, C] (C = select.size); only those C columns of the weight are
+      gathered and multiplied — the reference's sparse-selection path
+      (SelectiveFullyConnectedLayer.cpp with a sparse selection matrix),
+      right when C << size.  Output is [B, C], column j scoring candidate
+      ``select[b, j]``.
+    """
+    if select_mode not in ("mask", "ids"):
+        raise ConfigError(f"select_mode must be 'mask' or 'ids', got {select_mode!r}")
     name = name or next_name("selective_fc")
     inputs = [input] if isinstance(input, LayerOutput) else list(input)
+    if select_mode == "ids":
+        return _selective_fc_ids(inputs, select, size, act=act, name=name,
+                                 param_attr=param_attr, bias_attr=bias_attr)
+    if inputs[0].meta.get("sparse"):
+        return _selective_fc_sparse_input(inputs, select, size, act=act,
+                                          name=name, param_attr=param_attr,
+                                          bias_attr=bias_attr)
     # multiple inputs get separate weight matrices summed, as in fc
     # (SelectiveFullyConnectedLayer.cpp iterates all inputs)
     wspecs = []
@@ -303,6 +324,72 @@ def selective_fc(input: LayerOutput, select: LayerOutput, size: int, *,
         y = None
         for spec, a in zip(wspecs, acts[:-1]):
             z = O.linear(a.value, params[spec.name])
+            y = z if y is None else y + z
+        if ba:
+            y = y + params[ba.name].astype(y.dtype)
+        y = act_fn(y) * sel.value.astype(y.dtype)
+        return Act(value=y)
+
+    return LayerOutput(name, "selective_fc", size, [*inputs, select],
+                       forward, specs)
+
+
+def _selective_fc_ids(inputs, select, size, *, act, name, param_attr, bias_attr):
+    """selective_fc sparse-selection path: gather only the candidate columns."""
+    wspecs = []
+    for i, ipt in enumerate(inputs):
+        pa = _pa(param_attr if len(inputs) == 1 else None, f"_{name}.w{i}")
+        wspecs.append(ParamSpec(name=pa.name, shape=(ipt.size, size), attr=pa))
+    specs = list(wspecs)
+    ba = _bias_attr(bias_attr, f"_{name}.wbias")
+    if ba:
+        specs.append(ParamSpec(name=ba.name, shape=(size,), attr=ba))
+    act_fn = O.get_activation(act)
+
+    def forward(ctx, params, *acts: Act) -> Act:
+        sel = acts[-1]
+        sel_ids = sel.value
+        y = None
+        for i, (spec, a) in enumerate(zip(wspecs, acts[:-1])):
+            z = O.selective_columns_matmul(
+                a.value, sel_ids, params[spec.name],
+                params[ba.name] if (ba and i == 0) else None)
+            y = z if y is None else y + z
+        y = act_fn(y)
+        if sel.mask is not None:
+            y = y * sel.mask.astype(y.dtype)
+        return Act(value=y, state={"sel_ids": sel_ids})
+
+    out = LayerOutput(name, "selective_fc", select.size, [*inputs, select],
+                      forward, specs)
+    out.meta["select_mode"] = "ids"
+    return out
+
+
+def _selective_fc_sparse_input(inputs, select, size, *, act, name, param_attr,
+                               bias_attr):
+    """selective_fc over a sparse (bag-of-features) input: sparse gather
+    matmul for the forward, dense 0/1 selection mask on the output."""
+    wspecs = []
+    for i, ipt in enumerate(inputs):
+        pa = _pa(param_attr if len(inputs) == 1 else None, f"_{name}.w{i}")
+        wspecs.append(ParamSpec(name=pa.name, shape=(ipt.size, size), attr=pa))
+    specs = list(wspecs)
+    ba = _bias_attr(bias_attr, f"_{name}.wbias")
+    if ba:
+        specs.append(ParamSpec(name=ba.name, shape=(size,), attr=ba))
+    act_fn = O.get_activation(act)
+    sparse_kinds = [ipt.meta.get("sparse") for ipt in inputs]
+
+    def forward(ctx, params, *acts: Act) -> Act:
+        sel = acts[-1]
+        y = None
+        for spec, a, sparse in zip(wspecs, acts[:-1], sparse_kinds):
+            if sparse:
+                z = O.sparse_gather_matmul(a.value, a.state["weights"], a.mask,
+                                           params[spec.name])
+            else:
+                z = O.linear(a.value, params[spec.name])
             y = z if y is None else y + z
         if ba:
             y = y + params[ba.name].astype(y.dtype)

@@ -117,16 +117,52 @@ def recurrent_group(
             if mi in boot_ix:
                 mem0.append(acts[boot_ix[mi]].value)
             else:
-                mem0.append(jnp.zeros((B, m.size), ref.value.dtype))
+                mem0.append(jnp.zeros((B, m.size), jnp.float32))
 
-        def step_fn(mems, frames):
+        def base_feed(mems):
             feed = {}
-            for fl, f_t in zip(frame_layers, frames):
-                feed[fl.name] = Act(value=f_t)
             for sl, sa in zip(static_layers, static_acts):
                 feed[sl.name] = Act(value=sa.value)
             for ml, mv in zip(mem_layers, mems):
                 feed[ml.name] = Act(value=mv)
+            return feed
+
+        if ref.is_nested:
+            # outer iteration over SUB-SEQUENCES: each frame is itself a
+            # padded sequence [B, Ti, ...] with its own lengths — the
+            # RecurrentGradientMachine nested-sequence mode (reference:
+            # RecurrentGradientMachine.cpp; Argument.h:90 sub positions;
+            # proven equivalent to the flat unroll in
+            # test_RecurrentGradientMachine.cpp sequence_nest_rnn.conf)
+            Ti = ref.value.shape[2]
+
+            def step_fn(mems, inp):
+                frames, sl_t = inp
+                imask = O.mask_from_lengths(sl_t, Ti)
+                feed = base_feed(mems)
+                for fl, f_t in zip(frame_layers, frames):
+                    feed[fl.name] = Act(value=f_t, lengths=sl_t, mask=imask)
+                outs, _ = sub_topo.apply(params, {}, feed, train=ctx.train,
+                                         rng=None)
+                out_act = outs[out_layer.name]
+                new_mems = tuple(outs[u.name].value for u in mem_updates)
+                payload = {"v": out_act.value}
+                if out_act.is_seq:
+                    payload["l"] = out_act.lengths
+                return new_mems, payload
+
+            xs = (tuple(a.value for a in seq_acts), ref.sub_lengths)
+            _, outs = O.scan_rnn(step_fn, tuple(mem0), xs, ref.mask,
+                                 reverse=reverse)
+            if "l" in outs:  # step emitted a sequence -> nested output
+                return Act(value=outs["v"], lengths=ref.lengths, mask=ref.mask,
+                           sub_lengths=outs["l"])
+            return Act(value=outs["v"], lengths=ref.lengths, mask=ref.mask)
+
+        def step_fn(mems, frames):
+            feed = base_feed(mems)
+            for fl, f_t in zip(frame_layers, frames):
+                feed[fl.name] = Act(value=f_t)
             outs, _ = sub_topo.apply(params, {}, feed, train=ctx.train,
                                      rng=None)
             new_mems = tuple(outs[u.name].value for u in mem_updates)

@@ -20,6 +20,7 @@ from paddle_tpu.ops.losses import (
     rank_cost,
     masked_token_mean,
     sequence_cross_entropy,
+    sequence_softmax_ce_readout,
 )
 from paddle_tpu.ops.sequence import (
     mask_from_lengths,
@@ -52,6 +53,11 @@ from paddle_tpu.ops.attention import (
     dot_product_attention,
 )
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
+from paddle_tpu.ops.sparse import (
+    sparse_gather_matmul,
+    sparse_to_dense,
+    selective_columns_matmul,
+)
 from paddle_tpu.ops.crf import crf_log_likelihood, crf_nll, crf_decode
 from paddle_tpu.ops.ctc import ctc_loss
 from paddle_tpu.ops.misc import (

@@ -34,8 +34,13 @@ def additive_attention_scores(enc_proj, dec_state, w_dec, v):
     Returns [B, S] unnormalized scores.
     """
     q = linear(dec_state, w_dec)[:, None, :]  # [B, 1, A]
+    # the [B, S, A] intermediate is re-read every decode step — keep it in
+    # the bf16 compute dtype so the bandwidth-bound tanh/add/dot run at half
+    # the HBM traffic; scores accumulate in f32
+    enc_proj, q = mxu_cast(enc_proj, q)
     e = jnp.tanh(enc_proj + q)
-    return jnp.einsum("bsa,a->bs", e, v.astype(e.dtype))
+    return jnp.einsum("bsa,a->bs", e, v.astype(e.dtype),
+                      preferred_element_type=acc_dtype())
 
 
 def attend(scores, values, mask):
@@ -48,7 +53,9 @@ def attend(scores, values, mask):
     z = jnp.where(mask > 0, scores, neg)
     w = jax.nn.softmax(z, axis=-1) * mask.astype(scores.dtype)
     w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
-    ctx = jnp.einsum("bs,bsd->bd", w, values)
+    wc, vc = mxu_cast(w, values)
+    ctx = jnp.einsum("bs,bsd->bd", wc, vc,
+                     preferred_element_type=acc_dtype()).astype(acc_dtype())
     return ctx, w
 
 

@@ -33,9 +33,15 @@ __all__ = [
 
 
 def conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
-    """NHWC conv: x [B,H,W,Cin], w [kh,kw,Cin//groups,Cout] -> [B,H',W',Cout]."""
+    """NHWC conv: x [B,H,W,Cin], w [kh,kw,Cin//groups,Cout] -> [B,H',W',Cout].
+
+    Operands in the bf16 compute dtype, output cast up to f32 explicitly
+    (not via ``preferred_element_type``: conv's VJP builds transposed convs
+    from the f32 cotangent + bf16 operand and conv requires matching operand
+    dtypes, whereas the explicit convert's transpose downcasts the cotangent
+    first — the MXU still accumulates in f32 internally either way)."""
     x, w = mxu_cast(x, w)
-    return lax.conv_general_dilated(
+    out = lax.conv_general_dilated(
         x,
         w,
         window_strides=tuple(stride),
@@ -43,8 +49,8 @@ def conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
-        preferred_element_type=acc_dtype(),
     )
+    return out.astype(acc_dtype())
 
 
 def conv2d_transpose(x, w, *, stride=(1, 1), padding="SAME"):
@@ -52,14 +58,14 @@ def conv2d_transpose(x, w, *, stride=(1, 1), padding="SAME"):
     (reference gserver/layers/ConvTransLayerBase; hl deconv kernels).
     x [B,H,W,Cin], w [kh,kw,Cin,Cout] -> [B,H*s,W*s,Cout] for SAME."""
     x, w = mxu_cast(x, w)
-    return lax.conv_transpose(
+    out = lax.conv_transpose(
         x,
         w,
         strides=tuple(stride),
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=acc_dtype(),
     )
+    return out.astype(acc_dtype())  # see conv2d: keep conv VJP dtypes matched
 
 
 def _pool(x, window, stride, padding, init, op):

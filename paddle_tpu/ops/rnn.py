@@ -52,6 +52,11 @@ def _use_pallas_rnn(batch, hidden, h0, c0, peep_i, peep_f, peep_o, act,
         return False
     if hidden % 128 != 0 or batch % 8 != 0:
         return False
+    # the fused kernel's per-step working set ([B, gates*H] blocks + carry)
+    # must fit Mosaic's 16MB scoped VMEM; measured limit on v5e: B*H=384*512
+    # compiles, 512*512 OOMs -> gate at 384*512 and fall back to the scan path
+    if batch * hidden > 384 * 512:
+        return False
     from paddle_tpu.utils.flags import FLAGS
 
     if not FLAGS.use_pallas_rnn:
@@ -115,13 +120,15 @@ def scan_rnn(step_fn, carry_init, xs_btd, mask_bt, *, reverse=False):
     def masked_step(carry, inp):
         x_t, m_t = inp
         new_carry, out = step_fn(carry, x_t)
-        m = m_t[:, None]
+
+        def bmask(a):  # [B] mask broadcast against [B, ...] of any rank
+            return m_t.reshape(m_t.shape + (1,) * (a.ndim - 1)).astype(a.dtype)
 
         def sel(new, old):
-            return jnp.where(m.astype(new.dtype) > 0, new, old)
+            return jnp.where(bmask(new) > 0, new, old)
 
         carry_out = jax.tree_util.tree_map(sel, new_carry, carry)
-        out = jax.tree_util.tree_map(lambda o: o * m.astype(o.dtype), out)
+        out = jax.tree_util.tree_map(lambda o: o * bmask(o), out)
         return carry_out, out
 
     final, outs_tb = lax.scan(masked_step, carry_init, (xs_tb, mask_tb), reverse=reverse)

@@ -1,0 +1,84 @@
+"""Sparse-input compute — the TPU-native analog of the reference's CSR/CSC
+sparse tier.
+
+Reference surface being covered: the ``hl_sparse.h`` kernel family (26 fns:
+CSR/CSC construction, sparse×dense matmul, transpose-matmul for the backward
+pass — reference: paddle/cuda/include/hl_sparse.h), the CPU sparse matrices
+(paddle/math/CpuSparseMatrix.cpp, SparseMatrix.cpp) and the
+``sparse_binary_vector`` / ``sparse_float_vector`` input types consumed by fc
+layers over bag-of-words features (demo/quick_start/trainer_config.lr.py;
+py_paddle/dataprovider_converter.py SparseBinaryScanner).
+
+TPU-first re-design: CSR's variable row lengths are hostile to XLA's static
+shapes, so the on-device format is **padded COO rows** (a.k.a. ELL): per
+sample a fixed-width id vector [B, N] + weight vector [B, N] + validity mask
+[B, N], with N bucketed by the feeder the same way sequence lengths are.
+Sparse×dense matmul is then gather(W rows) → weighted segment-sum — a form
+XLA lowers to dynamic-gather + reduction that stays entirely on-chip, and
+whose autodiff transpose is exactly the row-sparse scatter-add the reference
+implements by hand (hl_sparse.h csc_mul_dense backward;
+SparseRowCpuMatrix::addTo).  The gradient w.r.t. the dense weight therefore
+only touches the gathered rows — composing with the row-sparse optimizer
+update path (``ParamAttr(sparse_grad=True)``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.matmul import linear
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = [
+    "sparse_gather_matmul",
+    "sparse_to_dense",
+    "selective_columns_matmul",
+]
+
+
+def sparse_gather_matmul(ids, weights, mask, w, b=None):
+    """Padded-sparse [B, N] × dense [V, D] -> [B, D].
+
+    ``out[b] = sum_n weights[b,n] * w[ids[b,n]]`` over valid n — the
+    hl_sparse csr_mul_dense analog.  Invalid (padding) slots must be
+    masked: their ids may be arbitrary in-range values.
+    """
+    rows = jnp.take(w, ids, axis=0)                      # [B, N, D]
+    coef = (weights * mask).astype(rows.dtype)
+    rows, coef = mxu_cast(rows, coef)
+    out = jnp.einsum("bnd,bn->bd", rows, coef).astype(acc_dtype())
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+def sparse_to_dense(ids, weights, mask, dim: int):
+    """Densify padded-sparse rows into [B, dim] (the CpuSparseMatrix ->
+    dense copy analog; used for equivalence testing and for layers without
+    a sparse fast path). Duplicate ids accumulate, as in COO."""
+    B, N = ids.shape
+    coef = (weights * mask).astype(acc_dtype())
+    out = jnp.zeros((B, dim), acc_dtype())
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, N))
+    return out.at[rows.ravel(), ids.ravel()].add(coef.ravel())
+
+
+def selective_columns_matmul(x, sel_ids, w, b=None, sel_mask: Optional[jnp.ndarray] = None):
+    """Compute only selected output columns: x [B, Din] × w [Din, V] gathered
+    at sel_ids [B, C] -> [B, C].
+
+    The sparse compute path of SelectiveFullyConnectedLayer
+    (gserver/layers/SelectiveFullyConnectedLayer.cpp: with a sparse selection
+    the forward multiplies only the selected columns) — for huge softmax
+    fronts where C << V makes even the MXU-dense path wasteful."""
+    cols = jnp.take(w, sel_ids, axis=1)                  # [Din, B, C]
+    cols = jnp.moveaxis(cols, 1, 0)                      # [B, Din, C]
+    xc, colsc = mxu_cast(x, cols)
+    out = jnp.einsum("bd,bdc->bc", xc, colsc).astype(acc_dtype())
+    if b is not None:
+        out = out + jnp.take(b, sel_ids, axis=0).astype(out.dtype)
+    if sel_mask is not None:
+        out = out * sel_mask.astype(out.dtype)
+    return out

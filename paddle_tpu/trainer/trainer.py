@@ -49,6 +49,7 @@ class SGDTrainer:
         seed: Optional[int] = None,
         averager: Optional[ParameterAverager] = None,
         device_specs: Optional[Dict[str, Any]] = None,
+        sharding_rules=None,
     ) -> None:
         # several costs train jointly (MultiNetwork analog,
         # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
@@ -66,6 +67,13 @@ class SGDTrainer:
         self.data_axis = data_axis
         self.averager = averager
         self.device_specs = device_specs
+        # parameter-placement plane: a parallel.ShardingRules mapping param
+        # name globs to PartitionSpecs (tensor parallelism through the same
+        # trainer — the ParallelNeuralNetwork analog for weights, see
+        # paddle_tpu/parallel/sharding.py); None = replicate
+        self.sharding_rules = sharding_rules
+        if sharding_rules is not None and mesh is None:
+            raise ValueError("sharding_rules requires a mesh")
 
         seed = FLAGS.seed if seed is None else seed
         self._rng = jax.random.PRNGKey(seed)
@@ -102,6 +110,8 @@ class SGDTrainer:
 
         self.opt_state = self.optimizer.init_state(self.params)
         self.avg_params = self.averager.init_state(self.params) if self.averager else None
+        if self.mesh is not None:
+            self._place_sharded()
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -144,24 +154,56 @@ class SGDTrainer:
             return loss, new_params, new_state, new_opt, extras
 
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            mesh = self.mesh
-            repl = NamedSharding(mesh, P())
-
-            def sharded_step(params, state, opt_state, rng, feed):
-                return step(params, state, opt_state, rng, feed)
-
-            jitted = jax.jit(sharded_step, donate_argnums=(0, 2))
+            # params/opt slots were placed ONCE at init (or after load) with
+            # their rule-derived shardings; the jitted step consumes and
+            # donates them in place — no per-batch host re-placement
+            jitted = jax.jit(step, donate_argnums=(0, 2))
 
             def run(params, state, opt_state, rng, feed):
                 feed = self._shard_feed(feed)
-                params = jax.device_put(params, repl)
-                opt_state = jax.device_put(opt_state, repl)
                 return jitted(params, state, opt_state, rng, feed)
 
             return run
         return jax.jit(step, donate_argnums=(0, 2))
+
+    def _param_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.sharding_rules is None:
+            repl = NamedSharding(self.mesh, P())
+            return {k: repl for k in self.params}
+        return self.sharding_rules.shardings(self.mesh, self.params)
+
+    def _place_sharded(self) -> None:
+        """Place params at their rule shardings and every optimizer slot at
+        its parameter's sharding; BN state and scalars replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = self._param_shardings()
+        repl = NamedSharding(self.mesh, P())
+        self.params = {k: jax.device_put(v, sh[k]) for k, v in self.params.items()}
+        self.state = jax.device_put(self.state, repl)
+
+        def put_like(name):
+            def put(leaf):
+                if hasattr(leaf, "shape") and tuple(leaf.shape) == tuple(
+                    self.params[name].shape
+                ):
+                    return jax.device_put(leaf, sh[name])
+                return jax.device_put(jnp.asarray(leaf), repl)
+
+            return put
+
+        if isinstance(self.opt_state, dict) and "slots" in self.opt_state:
+            slots = {
+                k: jax.tree_util.tree_map(put_like(k), v)
+                for k, v in self.opt_state["slots"].items()
+            }
+            rest = {k: jax.device_put(v, repl)
+                    for k, v in self.opt_state.items() if k != "slots"}
+            self.opt_state = {**rest, "slots": slots}
+        else:
+            self.opt_state = jax.device_put(self.opt_state, repl)
 
     def _shard_feed(self, feed):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -221,35 +263,64 @@ class SGDTrainer:
         feeder: Optional[Callable] = None,
         test_reader: Optional[Callable] = None,
     ) -> None:
-        """Pass/batch loop with events — trainer.py:108-173 analog."""
+        """Pass/batch loop with events — trainer.py:108-173 analog.
+
+        Instrumentation mirrors the reference's Stat plane: named timers
+        around data-wait / step / eval (REGISTER_TIMER in
+        TrainerInternal.cpp:118), a per-pass timing table behind
+        ``--enable_timers`` (Stat.h:70-247 print-per-pass), and an opt-in
+        ``jax.profiler`` trace via ``--profile_dir`` — the hl_profiler_start/
+        end analog (hl_cuda.h:338-343), viewable in TensorBoard/XProf."""
+        from paddle_tpu.utils.stat import print_stats, timer
+
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
-        for pass_id in range(FLAGS.start_pass, num_passes):
-            handler(ev.BeginPass(pass_id))
-            costs: List[float] = []
-            t0 = time.time()
-            for batch_id, data_batch in enumerate(reader()):
-                handler(ev.BeginIteration(pass_id, batch_id))
-                feed = feeder(data_batch) if feeder else data_batch
-                loss = self.train_batch(feed)
-                cost = float(loss)
-                costs.append(cost)
-                handler(ev.EndIteration(pass_id, batch_id, cost))
-                if log_period and (batch_id + 1) % log_period == 0:
-                    logger.info(
-                        "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
-                        pass_id, batch_id + 1, float(np.mean(costs[-log_period:])),
-                        log_period / max(time.time() - t0, 1e-9),
-                    )
-                    t0 = time.time()
-            result = {}
-            if test_reader is not None:
-                result = self.test(test_reader, feeder=feeder)
-            handler(ev.EndPass(pass_id, evaluator=result))
-            if FLAGS.save_dir and FLAGS.saving_period and (
-                (pass_id + 1) % FLAGS.saving_period == 0
-            ):
-                self.save(FLAGS.save_dir, pass_id)
+        profiling = bool(FLAGS.profile_dir)
+        if profiling:
+            jax.profiler.start_trace(FLAGS.profile_dir)
+        try:
+            for pass_id in range(FLAGS.start_pass, num_passes):
+                handler(ev.BeginPass(pass_id))
+                costs: List[float] = []
+                t0 = time.time()
+                it = iter(reader())
+                batch_id = 0
+                while True:
+                    with timer("DataWaitTimer"):
+                        data_batch = next(it, None)
+                    if data_batch is None:
+                        break
+                    handler(ev.BeginIteration(pass_id, batch_id))
+                    with timer("PrepareBatch"):
+                        feed = feeder(data_batch) if feeder else data_batch
+                    with timer("TrainBatch", sync=lambda: loss):
+                        loss = self.train_batch(feed)
+                    cost = float(loss)
+                    costs.append(cost)
+                    handler(ev.EndIteration(pass_id, batch_id, cost))
+                    if log_period and (batch_id + 1) % log_period == 0:
+                        logger.info(
+                            "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
+                            pass_id, batch_id + 1, float(np.mean(costs[-log_period:])),
+                            log_period / max(time.time() - t0, 1e-9),
+                        )
+                        t0 = time.time()
+                    batch_id += 1
+                result = {}
+                if test_reader is not None:
+                    with timer("TestTimer"):
+                        result = self.test(test_reader, feeder=feeder)
+                handler(ev.EndPass(pass_id, evaluator=result))
+                if FLAGS.enable_timers:
+                    print_stats()
+                if FLAGS.save_dir and FLAGS.saving_period and (
+                    (pass_id + 1) % FLAGS.saving_period == 0
+                ):
+                    with timer("SaveCheckpoint"):
+                        self.save(FLAGS.save_dir, pass_id)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
 
     # ------------------------------------------------------------------
 
@@ -312,4 +383,6 @@ class SGDTrainer:
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
         )
+        if self.mesh is not None:
+            self._place_sharded()
         self.rebuild_masks()

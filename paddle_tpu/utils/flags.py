@@ -148,6 +148,13 @@ def flags_snapshot() -> Dict[str, Any]:
 # --- Core flag set (TPU-native analog of paddle/utils/Flags.cpp:18-77) ---
 
 # Device / platform (replaces use_gpu, gpu_id, parallel_nn ...)
+# CLI driver plane (paddle_trainer analog, trainer/TrainerMain.cpp:32-65)
+define_flag("job", "train", "CLI mode: train | test | checkgrad | time")
+define_flag("config", "", "python config file defining get_config()")
+define_flag("num_passes", 1, "training passes for the CLI train job")
+define_flag("test_pass", -1, "checkpoint pass to test (-1 = latest)")
+define_flag("time_batches", 10, "batches to time in --job=time")
+
 define_flag("platform", "", "jax platform override: '', 'tpu', 'cpu'")
 define_flag("use_tpu", True, "prefer TPU devices when available")
 define_flag("seed", 1, "global RNG seed (0 = nondeterministic)")
@@ -158,7 +165,10 @@ define_flag("compute_dtype", "bfloat16", "preferred matmul/conv compute dtype on
 define_flag("log_period", 100, "log every N batches")
 define_flag("test_period", 0, "test every N batches (0 = per pass)")
 define_flag("show_parameter_stats_period", 0, "print param stats every N batches")
-define_flag("checkgrad_eps", 1e-2, "epsilon for finite-difference gradient checks")
+# reference default was 1e-2 (f64 CPU); at f32 a smaller step is both safe
+# (FD noise ~1e-4 at loss~O(1)) and far less likely to cross a relu/maxpool
+# kink, which corrupts whole-model FD checks on conv nets
+define_flag("checkgrad_eps", 1e-3, "epsilon for finite-difference gradient checks")
 define_flag("save_dir", "", "checkpoint root; pass dirs saved under it ('' = no saving)")
 define_flag("start_pass", 0, "resume training from this pass")
 define_flag("saving_period", 1, "save checkpoint every N passes")
@@ -173,11 +183,16 @@ define_flag("beam_size", 3, "default beam width for sequence generation")
 define_flag("max_gen_length", 100, "max generated sequence length")
 
 # Kernel selection
-# Measured on v5e (B=64,T=100,H=256): XLA's compiled lax.scan beats the fused
-# Pallas time-loop kernel (3.8 vs 5.8 ms/layer), so the scan path is default;
-# flip on to experiment per-model.
-define_flag("use_pallas_rnn", False, "use fused Pallas LSTM/GRU time-loop kernels on TPU")
+# A/B on v5e with round-trip-calibrated chained timing (bench.py
+# bench_pallas_lstm_ab, B=64,T=100,H=256, fwd+bwd): Pallas fused time-loop
+# 0.470 ms vs XLA scan 0.498 ms — the fused kernel wins, so it is the
+# default on TPU for tile-aligned default-cell shapes (see
+# ops/rnn.py:_use_pallas_rnn for the exact gate; everything else falls back
+# to the scan path automatically).
+define_flag("use_pallas_rnn", True, "use fused Pallas LSTM/GRU time-loop kernels on TPU")
 
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")
+define_flag("profile_dir", "", "write a jax.profiler trace here during train() "
+            "(hl_profiler_start/end analog; view with TensorBoard/XProf)")
 define_flag("prefetch_batches", 2, "data provider background prefetch depth")

@@ -11,7 +11,10 @@ __all__ = [
     "dense_vector_sequence",
     "integer_value",
     "integer_value_sequence",
+    "integer_value_sub_sequence",
+    "dense_vector_sub_sequence",
     "sparse_binary_vector",
+    "sparse_float_vector",
 ]
 
 
@@ -19,12 +22,20 @@ __all__ = [
 class InputType:
     dim: int
     seq: bool
-    kind: str  # 'dense' | 'int' | 'sparse'
+    kind: str  # 'dense' | 'int' | 'sparse_binary' | 'sparse_float'
 
     @property
     def feeder_kind(self) -> str:
+        if self.kind == "int_nested":
+            return "ids_nested"
+        if self.kind == "dense_nested":
+            return "dense_nested"
         if self.kind == "int":
             return "ids_seq" if self.seq else "int"
+        if self.kind == "sparse_binary":
+            return "sparse_ids"
+        if self.kind == "sparse_float":
+            return "sparse_pairs"
         return "dense_seq" if self.seq else "dense"
 
 
@@ -44,6 +55,23 @@ def integer_value_sequence(value_range: int) -> InputType:
     return InputType(value_range, True, "int")
 
 
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    """Nested sequence of ids (the reference's sub-sequence input type,
+    PyDataProvider2 integer_value_sub_sequence)."""
+    return InputType(value_range, True, "int_nested")
+
+
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, True, "dense_nested")
+
+
 def sparse_binary_vector(dim: int) -> InputType:
-    # fed as id lists, embedded densely on-device
-    return InputType(dim, True, "int")
+    """Rows are id lists; fed as padded COO (ids, nnz) — the
+    reference's sparse_binary_vector bag-of-words input."""
+    return InputType(dim, False, "sparse_binary")
+
+
+def sparse_float_vector(dim: int) -> InputType:
+    """Rows are (id, weight) pair lists; fed as padded COO
+    (ids, weights, nnz)."""
+    return InputType(dim, False, "sparse_float")

@@ -95,12 +95,14 @@ def data(name: str, type: Optional[InputType] = None, *, size: int = 0,
     """Typed data layer: ``paddle.layer.data("words",
     paddle.data_type.integer_value_sequence(V))``."""
     if type is not None:
+        sparse = {"sparse_binary": "binary", "sparse_float": "float"}.get(type.kind)
         out = _nn.data(
             name,
             size=type.dim,
             is_seq=type.seq,
             dtype="int32" if type.kind == "int" else "float32",
             height=height, width=width,
+            sparse=sparse,
         )
         out.meta["v2_type"] = type
         return out

@@ -1,0 +1,59 @@
+"""The paddle_trainer CLI analog: python -m paddle_tpu --job=... --config=...
+
+Reference: TrainerMain.cpp:32-65 drives train/test/checkgrad/time from flags;
+here every job runs in-process through paddle_tpu.__main__.main().
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.__main__ import main
+from paddle_tpu.utils.error import ConfigError
+from paddle_tpu.utils.flags import FLAGS
+
+CONF = os.path.join(os.path.dirname(__file__), "..", "demo", "mnist", "conf.py")
+
+
+@pytest.fixture(autouse=True)
+def small_mnist(monkeypatch):
+    monkeypatch.setenv("MNIST_N", "96")
+    monkeypatch.setenv("MNIST_BATCH", "32")
+    # flags are process-global: restore around each test
+    keep = {k: getattr(FLAGS, k) for k in
+            ("job", "config", "num_passes", "save_dir", "start_pass",
+             "test_pass", "time_batches", "log_period")}
+    yield
+    for k, v in keep.items():
+        setattr(FLAGS, k, v)
+
+
+def test_cli_train_then_test_roundtrip(tmp_path):
+    rc = main([f"--config={CONF}", "--job=train", "--num_passes=1",
+               f"--save_dir={tmp_path}", "--log_period=0"])
+    assert rc == 0
+    assert (tmp_path / "pass-00000").is_dir()
+
+    rc = main([f"--config={CONF}", "--job=test", f"--save_dir={tmp_path}"])
+    assert rc == 0
+
+
+def test_cli_checkgrad():
+    rc = main([f"--config={CONF}", "--job=checkgrad"])
+    assert rc == 0
+
+
+def test_cli_time(capsys):
+    rc = main([f"--config={CONF}", "--job=time", "--time_batches=2"])
+    assert rc == 0
+    assert "ms/batch" in capsys.readouterr().out
+
+
+def test_cli_rejects_bad_args():
+    with pytest.raises(ConfigError, match="unrecognized"):
+        main([f"--config={CONF}", "--job=train", "--no_such_flag=1"])
+    with pytest.raises(ConfigError, match="--job"):
+        main([f"--config={CONF}", "--job=frobnicate"])
+    with pytest.raises(ConfigError, match="--config"):
+        main(["--job=train", "--config="])

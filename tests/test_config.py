@@ -29,6 +29,7 @@ from paddle_tpu.config import (
     protostr,
 )
 from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.utils.error import ConfigError
 
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 
@@ -152,3 +153,36 @@ def test_merge_model_bundle(tmp_path, rng):
     np.testing.assert_allclose(
         got["out"], np.asarray(want["out"].value), rtol=1e-5, atol=1e-6
     )
+
+
+def test_typed_fields_present_and_validated():
+    """Typed layer fields (the ModelConfig.proto contract analog) are written
+    for the top families, old bundles without them still load, and a
+    tampered typed field is rejected."""
+    mc = dump_model_config(_simple_net(), "m")
+    by_type = {}
+    for lc in mc.layers:
+        w = lc.WhichOneof("typed")
+        if w:
+            by_type.setdefault(w, lc)
+    assert "fc" in by_type and by_type["fc"].fc.size > 0
+    assert "cost" in by_type
+
+    # old-bundle compatibility: strip typed fields -> still rebuilds
+    mc_old = type(mc)()
+    mc_old.CopyFrom(mc)
+    for lc in mc_old.layers:
+        if lc.WhichOneof("typed"):
+            lc.ClearField(lc.WhichOneof("typed"))
+    topo = build_topology(mc_old)
+    assert topo.output_names() == list(mc.output_layer_names)
+
+    # tampered typed field -> schema validation error
+    mc_bad = type(mc)()
+    mc_bad.CopyFrom(mc)
+    for lc in mc_bad.layers:
+        if lc.WhichOneof("typed") == "fc":
+            lc.fc.size = lc.fc.size + 1
+            break
+    with pytest.raises(ConfigError, match="typed fc.size"):
+        build_topology(mc_bad)

@@ -1,0 +1,204 @@
+"""Nested (sub)sequence recurrent groups — the subSequenceStartPositions tier.
+
+Reference semantics matched (paddle/parameter/Argument.h:90,152;
+gserver/gradientmachines/RecurrentGradientMachine.cpp;
+gserver/tests/test_RecurrentGradientMachine.cpp): a recurrent group over a
+nested sequence iterates over SUB-SEQUENCES; an inner group inside the step
+iterates over that sub-sequence's tokens; chaining the inner RNN's final
+state through an outer memory makes the nested unroll exactly equal to one
+flat RNN over the concatenated tokens (the sequence_nest_rnn.conf vs
+sequence_rnn.conf golden equivalence).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.data import DataFeeder
+
+H = 6  # rnn width
+
+
+def _rnn_step(pa_x, pa_h):
+    """Shared-parameter simple-RNN step builder."""
+
+    def step(x_t, mem):
+        return nn.fc([x_t, mem], H, act="tanh", name=None,
+                     param_attr=None, bias_attr=False)
+
+    return step
+
+
+def _flat_rnn(x, name):
+    """recurrent_group over a flat token sequence; returns states [B,T,H]."""
+
+    def step(x_t, mem):
+        s = nn.fc([x_t, mem], H, act="tanh", name=f"{name}_cell",
+                  bias_attr=False)
+        return [s, s]
+
+    return nn.recurrent_group(
+        step, [x], [nn.Memory(f"{name}_m", H)], name=name)
+
+
+def test_nested_equals_flat_rnn(rng):
+    """Outer group over sub-sequences + inner RNN booted from outer memory
+    == one flat RNN over the concatenated tokens."""
+    B, To, Ti, D = 2, 3, 4, 5
+    sub_lengths = np.array([[4, 2, 3], [3, 4, 0]], np.int32)
+    outer_len = np.array([3, 2], np.int32)
+    T = int(sub_lengths.sum(1).max())  # flat lengths: 9, 7
+    flat_len = sub_lengths.sum(1).astype(np.int32)
+
+    vals = rng.randn(B, To, Ti, D).astype(np.float32)
+    # zero padded token slots so flat packing is well-defined
+    for b in range(B):
+        for j in range(To):
+            vals[b, j, sub_lengths[b, j]:] = 0.0
+    flat = np.zeros((B, T, D), np.float32)
+    for b in range(B):
+        t = 0
+        for j in range(outer_len[b]):
+            n = sub_lengths[b, j]
+            flat[b, t:t + n] = vals[b, j, :n]
+            t += n
+
+    # ---- nested net: outer group over sub-seqs, inner rnn boots from the
+    # outer memory carrying the previous sub-seq's final state -------------
+    nn.reset_naming()
+    xn = nn.data("x", size=D, is_seq=True, nested=True)
+
+    def outer_step(frame, outer_mem):
+        def inner_step(tok, inner_mem):
+            s = nn.fc([tok, inner_mem], H, act="tanh", name="cell",
+                      bias_attr=False)
+            return [s, s]
+
+        states = nn.recurrent_group(
+            inner_step, [frame], [nn.Memory("im", H, boot=outer_mem)],
+            name="inner")
+        last = nn.last_seq(states, name="last")
+        return [last, last]
+
+    nested_out = nn.recurrent_group(
+        outer_step, [xn], [nn.Memory("om", H)], name="outer")
+    topo_n = nn.Topology(nested_out)
+    params, state = topo_n.init(jax.random.PRNGKey(0))
+
+    # ---- flat net with the SAME cell parameters --------------------------
+    nn.reset_naming()
+    xf = nn.data("x", size=D, is_seq=True)
+
+    def flat_step(tok, mem):
+        s = nn.fc([tok, mem], H, act="tanh", name="cell", bias_attr=False)
+        return [s, s]
+
+    flat_out = nn.recurrent_group(flat_step, [xf], [nn.Memory("m", H)],
+                                  name="flat")
+    topo_f = nn.Topology(flat_out)
+    assert set(topo_f.param_specs) == set(topo_n.param_specs)
+
+    o_n, _ = topo_n.apply(params, state, {"x": (vals, outer_len, sub_lengths)})
+    o_f, _ = topo_f.apply(params, state, {"x": (flat, flat_len)})
+
+    nested_states = np.asarray(o_n[nested_out.name].value)   # [B,To,H]
+    flat_states = np.asarray(o_f[flat_out.name].value)       # [B,T,H]
+
+    # nested outer-step j output == flat state at the end of sub-seq j
+    for b in range(B):
+        t = 0
+        for j in range(outer_len[b]):
+            t += sub_lengths[b, j]
+            np.testing.assert_allclose(
+                nested_states[b, j], flat_states[b, t - 1],
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"b={b} sub-seq {j}")
+
+
+def test_nested_group_emits_nested_output(rng):
+    """A step whose output is a sequence produces a nested [B,To,Ti,H] act
+    with per-sub-seq lengths preserved."""
+    B, To, Ti, D = 2, 3, 4, 5
+    sub_lengths = np.array([[4, 2, 3], [3, 4, 0]], np.int32)
+    outer_len = np.array([3, 2], np.int32)
+    vals = rng.randn(B, To, Ti, D).astype(np.float32)
+
+    nn.reset_naming()
+    xn = nn.data("x", size=D, is_seq=True, nested=True)
+
+    def outer_step(frame, outer_mem):
+        def inner_step(tok, inner_mem):
+            s = nn.fc([tok, inner_mem], H, act="tanh", name="cell",
+                      bias_attr=False)
+            return [s, s]
+
+        states = nn.recurrent_group(
+            inner_step, [frame], [nn.Memory("im", H, boot=outer_mem)],
+            name="inner")
+        return [states, nn.last_seq(states, name="last")]
+
+    out = nn.recurrent_group(outer_step, [xn], [nn.Memory("om", H)],
+                             name="outer")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(1))
+    o, _ = topo.apply(params, state, {"x": (vals, outer_len, sub_lengths)})
+    act = o[out.name]
+    assert act.is_nested
+    assert np.asarray(act.value).shape == (B, To, Ti, H)
+    np.testing.assert_array_equal(np.asarray(act.sub_lengths), sub_lengths)
+    # padded outer steps are zeroed
+    assert np.abs(np.asarray(act.value)[1, 2]).max() == 0
+
+
+def test_nested_grad_flows(rng):
+    B, To, Ti, D = 2, 2, 3, 4
+    sub_lengths = np.array([[3, 2], [2, 0]], np.int32)
+    outer_len = np.array([2, 1], np.int32)
+    vals = rng.randn(B, To, Ti, D).astype(np.float32)
+
+    nn.reset_naming()
+    xn = nn.data("x", size=D, is_seq=True, nested=True)
+
+    def outer_step(frame, outer_mem):
+        def inner_step(tok, inner_mem):
+            s = nn.fc([tok, inner_mem], H, act="tanh", name="cell",
+                      bias_attr=False)
+            return [s, s]
+
+        states = nn.recurrent_group(
+            inner_step, [frame], [nn.Memory("im", H, boot=outer_mem)],
+            name="inner")
+        last = nn.last_seq(states, name="last")
+        return [last, last]
+
+    out = nn.recurrent_group(outer_step, [xn], [nn.Memory("om", H)],
+                             name="outer")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(2))
+
+    def loss(p):
+        o, _ = topo.apply(p, state, {"x": (vals, outer_len, sub_lengths)})
+        return (o[out.name].value ** 2).sum()
+
+    g = jax.grad(loss)(params)
+    for k, v in g.items():
+        assert np.isfinite(np.asarray(v)).all()
+        assert np.abs(np.asarray(v)).max() > 0, k
+
+
+def test_feeder_nested_kind():
+    feeder = DataFeeder({"x": "ids_nested", "label": "int"},
+                        buckets=(2, 4, 8))
+    rows = [
+        ([[1, 2, 3], [4]], 0),
+        ([[5]], 1),
+    ]
+    feed = feeder(rows)
+    vals, outer, sub = feed["x"]
+    np.testing.assert_array_equal(outer, [2, 1])
+    assert vals.shape[1] >= 2 and vals.shape[2] >= 3
+    np.testing.assert_array_equal(sub[0, :2], [3, 1])
+    np.testing.assert_array_equal(vals[0, 0, :3], [1, 2, 3])
+    np.testing.assert_array_equal(sub[1], [1] + [0] * (sub.shape[1] - 1))

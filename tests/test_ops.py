@@ -318,3 +318,21 @@ class TestMisc:
         x = jnp.asarray(rng.randn(4, 4).astype(np.float32))
         y = ops.dropout(jax.random.PRNGKey(0), x, 0.5, train=False)
         np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_sequence_softmax_ce_readout_matches_unfused(rng):
+    """Fused readout+CE == linear + sequence_cross_entropy (f32 compute)."""
+    B, T, D, V = 3, 5, 8, 17
+    states = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32) * 0.3)
+    b = jnp.asarray(rng.randn(V).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rng.randint(0, V, (B, T)).astype(np.int32))
+    mask = jnp.asarray((rng.rand(B, T) > 0.3).astype(np.float32))
+    fused = ops.sequence_softmax_ce_readout(states, w, b, labels, mask)
+    unfused = ops.sequence_cross_entropy(ops.linear(states, w, b), labels, mask)
+    np.testing.assert_allclose(float(fused), float(unfused), rtol=1e-5)
+
+    # gradients agree too
+    gf = jax.grad(lambda w: ops.sequence_softmax_ce_readout(states, w, b, labels, mask))(w)
+    gu = jax.grad(lambda w: ops.sequence_cross_entropy(ops.linear(states, w, b), labels, mask))(w)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gu), rtol=1e-4, atol=1e-6)

@@ -178,3 +178,44 @@ def test_trainer_with_mesh_runs(rng):
     for _ in range(20):
         l = float(trainer.train_batch(feed))
     assert l < l0
+
+
+def test_sgdtrainer_tensor_parallel_matches_single(rng):
+    """SGDTrainer(mesh=..., sharding_rules=...) — TP through the Topology
+    trainer itself — produces the SAME losses as the single-device trainer
+    (ParallelNeuralNetwork.h:34 analog, params sharded not just activations)."""
+    from paddle_tpu.trainer import SGDTrainer
+
+    def build():
+        nn.reset_naming()
+        x = nn.data("x", size=16)
+        h = nn.fc(x, 32, act="relu", name="h")
+        logits = nn.fc(h, 8, act="linear", name="out")
+        lab = nn.data("label", size=8, dtype="int32")
+        return nn.classification_cost(logits, lab, name="cost")
+
+    feeds = [{"x": rng.rand(8, 16).astype(np.float32),
+              "label": rng.randint(0, 8, (8,))} for _ in range(3)]
+
+    t_single = SGDTrainer(build(), Adam(learning_rate=0.01), seed=5)
+    losses_single = [float(t_single.train_batch(f)) for f in feeds]
+
+    mesh = make_mesh((4, 2), ("data", "model"))
+    rules = par.ShardingRules([
+        ("_h.w0", P(None, "model")),     # column-parallel hidden
+        ("_h.wbias", P("model")),
+        ("_out.w0", P("model", None)),   # row-parallel readout
+        ("*", P()),
+    ])
+    t_tp = SGDTrainer(build(), Adam(learning_rate=0.01), seed=5,
+                      mesh=mesh, sharding_rules=rules)
+    # params actually placed sharded (not replicated)
+    sh = t_tp.params["_h.w0"].sharding
+    assert sh.spec == P(None, "model")
+    losses_tp = [float(t_tp.train_batch(f)) for f in feeds]
+
+    np.testing.assert_allclose(losses_single, losses_tp, rtol=2e-5)
+    for k in t_single.params:
+        np.testing.assert_allclose(np.asarray(t_single.params[k]),
+                                   np.asarray(t_tp.params[k]),
+                                   rtol=2e-4, atol=1e-6)

@@ -1,0 +1,274 @@
+"""Sparse-input feature tier: padded-COO feeds, sparse fc, selective_fc
+sparse paths — the CSR/CSC tier analog.
+
+Reference semantics matched:
+- sparse_binary_vector / sparse_float_vector inputs feed fc layers
+  (demo/quick_start/trainer_config.lr.py; dataprovider_converter.py
+  SparseBinaryScanner/SparseFloatScanner).
+- sparse x dense matmul == densified x dense matmul, forward and backward
+  (hl_sparse.h csr_mul_dense; math/CpuSparseMatrix.cpp).
+- selective_fc with a sparse selection computes only selected columns
+  (gserver/layers/SelectiveFullyConnectedLayer.cpp).
+- gradients w.r.t. the weight touch only gathered rows (SparseRowCpuMatrix),
+  composing with the row-sparse optimizer path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.ops as O
+from paddle_tpu.data import DataFeeder
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.trainer import SGDTrainer
+from paddle_tpu.utils.error import ConfigError
+
+V, B, N = 50, 4, 8
+
+
+def _sparse_batch(rng, with_weights=False):
+    nnz = rng.randint(1, N + 1, B).astype(np.int32)
+    ids = np.zeros((B, N), np.int32)
+    weights = np.zeros((B, N), np.float32)
+    for i in range(B):
+        ids[i, : nnz[i]] = rng.choice(V, nnz[i], replace=False)
+        weights[i, : nnz[i]] = rng.rand(nnz[i]).astype(np.float32) + 0.5
+    if with_weights:
+        return ids, weights, nnz
+    return ids, nnz
+
+
+def _densify(ids, weights, nnz):
+    dense = np.zeros((B, V), np.float32)
+    for i in range(B):
+        for j in range(nnz[i]):
+            dense[i, ids[i, j]] += weights[i, j]
+    return dense
+
+
+def test_sparse_gather_matmul_equals_dense(rng):
+    ids, weights, nnz = _sparse_batch(rng, with_weights=True)
+    mask = (np.arange(N)[None] < nnz[:, None]).astype(np.float32)
+    w = rng.randn(V, 6).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    got = O.sparse_gather_matmul(jnp.asarray(ids), jnp.asarray(weights),
+                                 jnp.asarray(mask), jnp.asarray(w), jnp.asarray(b))
+    want = _densify(ids, weights, nnz) @ w + b
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_gather_matmul_grad_row_sparse(rng):
+    """Weight gradient is nonzero ONLY on gathered rows (SparseRowMatrix)."""
+    ids, weights, nnz = _sparse_batch(rng, with_weights=True)
+    mask = (np.arange(N)[None] < nnz[:, None]).astype(np.float32)
+    w = jnp.asarray(rng.randn(V, 6).astype(np.float32))
+
+    def f(w):
+        out = O.sparse_gather_matmul(jnp.asarray(ids), jnp.asarray(weights),
+                                     jnp.asarray(mask), w)
+        return (out ** 2).sum()
+
+    g = np.asarray(jax.grad(f)(w))
+    touched = set()
+    for i in range(B):
+        touched.update(ids[i, : nnz[i]].tolist())
+    untouched = sorted(set(range(V)) - touched)
+    assert np.abs(g[untouched]).max() == 0
+    assert np.abs(g[sorted(touched)]).max() > 0
+
+    # and it matches the dense-input gradient restricted to those rows
+    dense = _densify(ids, weights, nnz)
+
+    def fd(w):
+        return ((jnp.asarray(dense) @ w) ** 2).sum()
+
+    gd = np.asarray(jax.grad(fd)(w))
+    np.testing.assert_allclose(g, gd, rtol=1e-3, atol=1e-4)
+
+
+def test_sparse_to_dense(rng):
+    ids, weights, nnz = _sparse_batch(rng, with_weights=True)
+    mask = (np.arange(N)[None] < nnz[:, None]).astype(np.float32)
+    got = O.sparse_to_dense(jnp.asarray(ids), jnp.asarray(weights),
+                            jnp.asarray(mask), V)
+    np.testing.assert_allclose(np.asarray(got), _densify(ids, weights, nnz),
+                               rtol=1e-6)
+
+
+def test_fc_over_sparse_binary_equals_densified(rng):
+    """fc(sparse_binary input) == fc(densified 0/1 input), fwd and bwd."""
+    ids, nnz = _sparse_batch(rng)
+    ones = (np.arange(N)[None] < nnz[:, None]).astype(np.float32)
+
+    nn.reset_naming()
+    sw = nn.data("w_sparse", size=V, sparse="binary")
+    out_s = nn.fc(sw, 3, act="linear", name="outs",
+                  param_attr=nn.ParamAttr(name="W"),
+                  bias_attr=nn.ParamAttr(name="bias", init="normal"))
+    topo_s = nn.Topology(out_s)
+    params, state = topo_s.init(jax.random.PRNGKey(0))
+
+    nn.reset_naming()
+    dw = nn.data("w_dense", size=V)
+    out_d = nn.fc(dw, 3, act="linear", name="outd",
+                  param_attr=nn.ParamAttr(name="W"),
+                  bias_attr=nn.ParamAttr(name="bias", init="normal"))
+    topo_d = nn.Topology(out_d)
+
+    dense = _densify(ids, ones, nnz)
+    o_s, _ = topo_s.apply(params, state, {"w_sparse": (ids, nnz)})
+    o_d, _ = topo_d.apply(params, state, {"w_dense": dense})
+    np.testing.assert_allclose(np.asarray(o_s["outs"].value),
+                               np.asarray(o_d["outd"].value),
+                               rtol=1e-4, atol=1e-5)
+
+    def loss_s(p):
+        o, _ = topo_s.apply(p, state, {"w_sparse": (ids, nnz)})
+        return (o["outs"].value ** 2).sum()
+
+    def loss_d(p):
+        o, _ = topo_d.apply(p, state, {"w_dense": dense})
+        return (o["outd"].value ** 2).sum()
+
+    gs = jax.grad(loss_s)(params)
+    gd = jax.grad(loss_d)(params)
+    np.testing.assert_allclose(np.asarray(gs["W"]), np.asarray(gd["W"]),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_fc_over_sparse_float_weights(rng):
+    ids, weights, nnz = _sparse_batch(rng, with_weights=True)
+    nn.reset_naming()
+    sw = nn.data("w_sparse", size=V, sparse="float")
+    out = nn.fc(sw, 3, act="linear", name="out", bias_attr=False,
+                param_attr=nn.ParamAttr(name="W"))
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(1))
+    o, _ = topo.apply(params, state, {"w_sparse": (ids, weights, nnz)})
+    want = _densify(ids, weights, nnz) @ np.asarray(params["W"])
+    np.testing.assert_allclose(np.asarray(o["out"].value), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_into_unaware_layer_raises(rng):
+    nn.reset_naming()
+    sw = nn.data("w_sparse", size=V, sparse="binary")
+    bad = nn.pooling(nn.embedding(nn.data("ids", size=0, is_seq=True,
+                                          dtype="int32"), 4, vocab_size=V),
+                     pooling_type="sum")
+    with pytest.raises(ConfigError, match="sparse"):
+        nn.Topology(nn.addto([bad, sw]))
+
+
+def test_selective_fc_ids_mode_matches_mask_mode(rng):
+    """ids-mode gathers exactly the candidate columns the mask-mode keeps."""
+    Din, Vout, C = 6, 20, 5
+    x = rng.randn(B, Din).astype(np.float32)
+    sel_ids = np.stack([rng.choice(Vout, C, replace=False) for _ in range(B)]).astype(np.int32)
+    sel_mask = np.zeros((B, Vout), np.float32)
+    for i in range(B):
+        sel_mask[i, sel_ids[i]] = 1.0
+
+    nn.reset_naming()
+    xin = nn.data("x", size=Din)
+    sel = nn.data("sel", size=C, dtype="int32")
+    o_ids = nn.selective_fc(xin, sel, Vout, act="linear", name="sfc",
+                            select_mode="ids", param_attr=nn.ParamAttr(name="W"),
+                            bias_attr=nn.ParamAttr(name="bias", init="normal"))
+    topo_i = nn.Topology(o_ids)
+    params, state = topo_i.init(jax.random.PRNGKey(2))
+    got_i, _ = topo_i.apply(params, state, {"x": x, "sel": sel_ids})
+
+    nn.reset_naming()
+    xin2 = nn.data("x", size=Din)
+    sel2 = nn.data("sel", size=Vout)
+    o_mask = nn.selective_fc(xin2, sel2, Vout, act="linear", name="sfc2",
+                             param_attr=nn.ParamAttr(name="W"),
+                             bias_attr=nn.ParamAttr(name="bias", init="normal"))
+    topo_m = nn.Topology(o_mask)
+    got_m, _ = topo_m.apply(params, state, {"x": x, "sel": sel_mask})
+
+    vi = np.asarray(got_i["sfc"].value)           # [B, C]
+    vm = np.asarray(got_m["sfc2"].value)          # [B, Vout]
+    for i in range(B):
+        np.testing.assert_allclose(vi[i], vm[i, sel_ids[i]], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_selective_fc_over_sparse_input(rng):
+    ids, nnz = _sparse_batch(rng)
+    ones = (np.arange(N)[None] < nnz[:, None]).astype(np.float32)
+    sel_mask = (rng.rand(B, 7) > 0.4).astype(np.float32)
+
+    nn.reset_naming()
+    sw = nn.data("w_sparse", size=V, sparse="binary")
+    sel = nn.data("sel", size=7)
+    o = nn.selective_fc(sw, sel, 7, act="linear", name="sfc",
+                        param_attr=nn.ParamAttr(name="W"),
+                        bias_attr=nn.ParamAttr(name="bias", init="normal"))
+    topo = nn.Topology(o)
+    params, state = topo.init(jax.random.PRNGKey(3))
+    got, _ = topo.apply(params, state, {"w_sparse": (ids, nnz), "sel": sel_mask})
+    want = (_densify(ids, ones, nnz) @ np.asarray(params["W"])
+            + np.asarray(params["bias"])) * sel_mask
+    np.testing.assert_allclose(np.asarray(got["sfc"].value), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_feeder_sparse_kinds():
+    feeder = DataFeeder({"bow": "sparse_ids", "tfidf": "sparse_pairs",
+                         "label": "int"})
+    rows = [
+        ([3, 7, 1], [(2, 0.5), (4, 1.5)], 1),
+        ([9], [(0, 2.0)], 0),
+    ]
+    feed = feeder(rows)
+    ids, nnz = feed["bow"]
+    assert ids.shape[0] == 2 and ids.shape[1] >= 3
+    np.testing.assert_array_equal(nnz, [3, 1])
+    np.testing.assert_array_equal(ids[0, :3], [3, 7, 1])
+    fids, fw, fnnz = feed["tfidf"]
+    np.testing.assert_array_equal(fnnz, [2, 1])
+    np.testing.assert_array_equal(fids[0, :2], [2, 4])
+    np.testing.assert_allclose(fw[0, :2], [0.5, 1.5])
+    np.testing.assert_allclose(fw[1, 1:], 0)
+
+
+def test_sparse_lr_trains(rng):
+    """quick_start lr_sparse analog: LR over sparse bag-of-words learns."""
+    nn.reset_naming()
+    words = nn.data("words", size=V, sparse="binary")
+    out = nn.fc(words, 2, act="softmax", name="out",
+                param_attr=nn.ParamAttr(name="lr_w", sparse_grad=True))
+    lbl = nn.data("label", size=2, dtype="int32")
+    cost = nn.classification_cost(input=out, label=lbl, name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+
+    # label = presence of feature 0
+    def make(bsz):
+        rows_ids = np.zeros((bsz, N), np.int32)
+        nnz = np.full((bsz,), 3, np.int32)
+        y = rng.randint(0, 2, bsz)
+        for i in range(bsz):
+            pool = rng.choice(np.arange(1, V), 3, replace=False)
+            if y[i]:
+                pool[0] = 0
+            rows_ids[i, :3] = pool
+        return {"words": (rows_ids, nnz), "label": y}
+
+    losses = [tr.train_batch(make(32)) for _ in range(30)]
+    assert float(losses[-1]) < float(losses[0]) * 0.7
+
+
+def test_v2_sparse_data_types():
+    import paddle_tpu.v2 as paddle
+
+    t = paddle.data_type.sparse_binary_vector(100)
+    assert t.feeder_kind == "sparse_ids"
+    tf = paddle.data_type.sparse_float_vector(100)
+    assert tf.feeder_kind == "sparse_pairs"
+    nn.reset_naming()
+    lay = paddle.layer.data("bow", t)
+    assert lay.meta["sparse"] == "binary"

@@ -144,3 +144,33 @@ def test_synthetic_datasets_shapes():
     assert trg[0] == 0 and nxt[-1] == 1 and len(trg) == len(nxt)
     u, m, r = next(data.datasets.movielens("train", n=4)())
     assert 1.0 <= r <= 5.0
+
+
+def test_stat_timers_populate(rng):
+    """--enable_timers wires Stat spans around data-wait/step (Stat.h
+    analog); the registry fills during train() and prints per pass."""
+    from paddle_tpu.utils.flags import FLAGS
+    from paddle_tpu.utils.stat import global_stat, reset_stats
+
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    cost = nn.mse_cost(input=nn.fc(x, 2, name="o"), label=nn.data("y", size=2))
+    tr = SGDTrainer(cost, Adam(learning_rate=0.01), seed=0)
+
+    def reader():
+        for _ in range(3):
+            yield {"x": rng.rand(4, 4).astype(np.float32),
+                   "y": rng.rand(4, 2).astype(np.float32)}
+
+    reset_stats()
+    old = FLAGS.enable_timers
+    FLAGS.enable_timers = True
+    try:
+        tr.train(reader, num_passes=1)
+    finally:
+        FLAGS.enable_timers = old
+    names = {s.name for s in global_stat._stats.values()}
+    assert {"DataWaitTimer", "TrainBatch"} <= names
+    assert global_stat.get("TrainBatch").count == 3
+    assert global_stat.get("TrainBatch").total > 0
+    reset_stats()

@@ -340,7 +340,7 @@ def bench_pallas_lstm_ab(rtt, peak):
         "xla_scan_ms": round(xla_sec * 1e3, 3),
         "pallas_ms": round(pallas_sec * 1e3, 3) if pallas_sec else None,
         "winner": winner,
-        "default_flag": True,  # keep in sync with FLAGS.use_pallas_rnn default
+        "default_flag": bool(FLAGS.use_pallas_rnn),
     }
 
 

@@ -76,24 +76,28 @@ class DataFeeder:
         subSequenceStartPositions analog, Argument.h:90) -> padded
         (value [B, To, Ti(, D)], outer_lengths [B], sub_lengths [B, To])."""
         outer = np.asarray([len(s) for s in col], np.int32)
-        To = bucket_length(max(int(outer.max()) if len(outer) else 1, 1),
-                           self.buckets)
+        n_outer = max(int(outer.max()) if len(outer) else 1, 1)
         ti_max = max((len(sub) for row in col for sub in row), default=1)
+        if self.max_len:  # cap BOTH levels, like the flat _pad_seq path
+            n_outer = min(n_outer, self.max_len)
+            ti_max = min(max(ti_max, 1), self.max_len)
+            outer = np.minimum(outer, self.max_len)
+        To = bucket_length(n_outer, self.buckets)
         Ti = bucket_length(max(ti_max, 1), self.buckets)
         sub_lengths = np.zeros((len(col), To), np.int32)
         if kind == "ids_nested":
             out = np.zeros((len(col), To, Ti), np.int32)
             for i, row in enumerate(col):
-                for j, sub in enumerate(row):
+                for j, sub in enumerate(list(row)[:To]):
                     sub = list(sub)[:Ti]
                     out[i, j, : len(sub)] = sub
                     sub_lengths[i, j] = len(sub)
         else:
-            D = len(col[0][0][0])
+            D = next((len(sub[0]) for row in col for sub in row if len(sub)), 1)
             out = np.zeros((len(col), To, Ti, D), self.dtype)
             for i, row in enumerate(col):
-                for j, sub in enumerate(row):
-                    sub = np.asarray(sub, self.dtype)[:Ti]
+                for j, sub in enumerate(list(row)[:To]):
+                    sub = np.asarray(sub, self.dtype).reshape(-1, D)[:Ti]
                     out[i, j, : len(sub)] = sub
                     sub_lengths[i, j] = len(sub)
         return out, outer, sub_lengths

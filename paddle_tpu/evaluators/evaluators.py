@@ -30,6 +30,7 @@ from paddle_tpu.utils.registry import Registry
 __all__ = [
     "EVALUATORS",
     "Evaluator",
+    "DeviceAccumulator",
     "ClassificationError",
     "SumEvaluator",
     "ColumnSumEvaluator",
@@ -51,6 +52,10 @@ EVALUATORS: Registry = Registry("evaluator")
 
 class Evaluator:
     name = "evaluator"
+    #: True when ``batch_stats`` dicts combine across batches by elementwise
+    #: sum — enables device-side accumulation (DeviceAccumulator).  Printers
+    #: and row-collecting evaluators (pnpair) override to False.
+    additive = True
 
     def start(self) -> None:
         raise NotImplementedError
@@ -256,6 +261,7 @@ class PnpairEvaluator(Evaluator):
     for each query id, counts concordant score pairs between pos & neg."""
 
     name = "pnpair"
+    additive = False  # collects raw rows; pairs need the full pass
 
     def start(self):
         self.rows: List[np.ndarray] = []
@@ -339,6 +345,7 @@ class ChunkEvaluator(Evaluator):
     decode (string-ish logic has no place on the MXU)."""
 
     name = "chunk"
+    additive = False  # raw tag rows, decoded per batch on host
 
     def start(self):
         self.correct = self.pred = self.label = 0.0
@@ -383,6 +390,7 @@ class CTCErrorEvaluator(Evaluator):
     (CTCErrorEvaluator.cpp)."""
 
     name = "ctc_edit_distance"
+    additive = False  # raw argmax paths, collapsed per batch on host
 
     def __init__(self, blank: int = 0):
         self.blank = blank
@@ -416,6 +424,8 @@ class CTCErrorEvaluator(Evaluator):
 
 
 class _Printer(Evaluator):
+    additive = False  # side-effecting: every batch is materialized
+
     def start(self):
         self.lines: List[str] = []
 
@@ -456,3 +466,70 @@ class MaxFramePrinter(_Printer):
 
     def batch_stats(self, *, value):
         return {"frame": jnp.argmax(jnp.linalg.norm(value, axis=-1), axis=-1)}
+
+
+# ---------------------------------------------------------------------------
+# device-side accumulation
+# ---------------------------------------------------------------------------
+
+
+class DeviceAccumulator:
+    """Accumulate an additive evaluator's batch stats ON DEVICE.
+
+    The host-side ``eval_batch`` path pulls every batch's stats to the host —
+    a device sync per batch, expensive over a TPU link.  This wrapper keeps
+    the running totals in HBM: ``add(**kw)`` dispatches one jitted
+    stats-and-add program (async — it does NOT block the host), and only
+    ``result()`` syncs, once.  The reference's evaluators accumulate in
+    device memory the same way during GPU eval passes
+    (paddle/gserver/evaluators/Evaluator.cpp:46-120 totalScore_/numSamples_
+    updated from device reductions).
+
+    Usage::
+
+        acc = DeviceAccumulator(ClassificationError())
+        for batch in reader():
+            out = infer_fn(params, state, batch)        # device arrays
+            acc.add(logits=out["logits"], labels=batch["labels"])
+        err = acc.result()                              # single host pull
+    """
+
+    def __init__(self, evaluator: Evaluator):
+        if not evaluator.additive:
+            raise ValueError(
+                f"evaluator {evaluator.name!r} is not additive; use eval_batch"
+            )
+        self.evaluator = evaluator
+        self._acc: Optional[Dict[str, Any]] = None
+        self._jit_add = None
+
+    def add(self, **kw) -> None:
+        import jax
+
+        if self._jit_add is None:
+            ev = self.evaluator
+
+            def first(**kw):
+                return ev.batch_stats(**kw)
+
+            def step(acc, **kw):
+                s = ev.batch_stats(**kw)
+                return jax.tree_util.tree_map(jnp.add, acc, s)
+
+            self._jit_first = jax.jit(first)
+            self._jit_add = jax.jit(step)
+        if self._acc is None:
+            self._acc = self._jit_first(**kw)
+        else:
+            self._acc = self._jit_add(self._acc, **kw)
+
+    def result(self) -> float:
+        self.evaluator.start()
+        if self._acc is not None:
+            self.evaluator.update(
+                {k: np.asarray(v) for k, v in self._acc.items()}
+            )
+        return self.evaluator.result()
+
+    def reset(self) -> None:
+        self._acc = None

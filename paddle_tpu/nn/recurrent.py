@@ -199,9 +199,34 @@ class SequenceGenerator:
         self.eos = eos_id
 
     def generate(self, params, mems0, *, batch_size: int, beam_size: int = 3,
-                 max_len: int = 50, length_penalty: float = 0.0):
+                 max_len: int = 50, length_penalty: float = 0.0,
+                 candidate_adjust_fn=None, drop_fn=None, return_trace: bool = False):
         """mems0: pytree with leading dim B. Returns (tokens [B,K,max_len],
-        scores [B,K]) best-first."""
+        scores [B,K]) best-first.
+
+        Beam-search control callbacks — the analog of the reference's
+        ``registerBeamSearchControlCallbacks`` / ``...StatisticsCallbacks``
+        (reference: RecurrentGradientMachine.h:73-188):
+
+        - ``candidate_adjust_fn(step_logp [B,K,V], tokens, t)`` → adjusted
+          per-candidate log-probs, applied before top-k each step
+          (beamSearchCandidateAdjust: user re-scoring / constrained decoding).
+          ``tokens`` is the FULL [B,K,max_len+1] buffer; slots ``> t`` are eos
+          padding — index with ``t`` (e.g. ``tokens[:, :, t]`` is the last
+          generated token), not ``-1``.
+        - ``drop_fn(tokens [B,K,max_len+1], scores [B,K], t)`` → bool [B,K];
+          True drops that beam after expansion (DropCallback).  Same padding
+          caveat: the newest token is at slot ``t+1``.
+        - ``return_trace=True`` additionally returns a per-step expansion
+          record dict with ``parent`` [T,B,K] (beam each slot came from),
+          ``token`` [T,B,K], ``score`` [T,B,K] — the statistics-callback
+          analog, materialized as arrays instead of host callbacks so the
+          whole search stays one XLA program.  Trace arrays are in the
+          search's native (pre-sort) beam order; the returned tokens/scores
+          are sorted best-first, and ``trace["order"]`` [B,K] maps output
+          slot -> native slot (``trace["token"][T-1, b, order[b, 0]]`` is
+          the last token of the best returned beam).
+        """
         B, K, V = batch_size, beam_size, self.V
         step_fn = self.step_fn
 
@@ -221,6 +246,9 @@ class SequenceGenerator:
             logits, mems_new = step_fn(params, y.reshape(B * K), mems)
             step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1).reshape(B, K, V)
             step_logp = jnp.where(finished[..., None], eos_only[None, None], step_logp)
+            if candidate_adjust_fn is not None:
+                step_logp = candidate_adjust_fn(step_logp, tokens, t)
+                step_logp = jnp.where(finished[..., None], eos_only[None, None], step_logp)
             flat = (logp[..., None] + step_logp).reshape(B, K * V)
             new_logp, idx = lax.top_k(flat, K)
             beam_idx, tok = idx // V, (idx % V).astype(jnp.int32)
@@ -234,9 +262,14 @@ class SequenceGenerator:
             tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
             tokens = tokens.at[:, :, t + 1].set(tok)
             finished = jnp.take_along_axis(finished, beam_idx, axis=1) | (tok == self.eos)
-            return (tokens, new_logp, mems_new, finished), None
+            if drop_fn is not None:
+                dropped = drop_fn(tokens, new_logp, t)
+                new_logp = jnp.where(dropped, -1e9, new_logp)
+                finished = finished | dropped
+            rec = (beam_idx, tok, new_logp) if return_trace else None
+            return (tokens, new_logp, mems_new, finished), rec
 
-        (tokens, logp, _, _), _ = lax.scan(
+        (tokens, logp, _, _), trace = lax.scan(
             scan_step, (tokens, logp, mems, finished), jnp.arange(max_len))
         out = tokens[:, :, 1:]
         if length_penalty > 0:
@@ -246,4 +279,9 @@ class SequenceGenerator:
             scores = logp
         order = jnp.argsort(-scores, axis=1)
         out = jnp.take_along_axis(out, order[..., None], axis=1)
-        return out, jnp.take_along_axis(scores, order, axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        if return_trace:
+            parent, tok, sc = trace
+            return out, scores, {"parent": parent, "token": tok, "score": sc,
+                                 "order": order}
+        return out, scores

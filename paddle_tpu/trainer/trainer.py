@@ -324,40 +324,91 @@ class SGDTrainer:
 
     # ------------------------------------------------------------------
 
-    def _infer_fn(self, output_names: Sequence[str], train: bool = False):
-        topo = self.topology
-
-        @jax.jit
-        def fn(params, state, feed):
-            outs, _ = topo.apply(params, state, feed, train=False)
-            return {k: outs[k].value for k in output_names}
-
-        return fn
-
-    def test(self, reader: Callable, *, feeder: Optional[Callable] = None) -> Dict[str, float]:
+    def test(self, reader: Callable, *, feeder: Optional[Callable] = None,
+             evaluators: Optional[Dict] = None) -> Dict[str, float]:
         """Eval loop — Tester analog (paddle/trainer/Tester.h:40).
 
         Reports the same weighted joint cost the train step optimizes (all
         cost heads, not just the first), plus per-cost values when training
-        is multi-cost."""
-        fn = getattr(self, "_test_fn", None)
+        is multi-cost.
+
+        Cost sums accumulate ON DEVICE (one jitted add per batch, async
+        dispatch) and sync to the host exactly once at the end — no per-batch
+        round-trip over the TPU link.  ``evaluators`` optionally maps
+        ``{evaluator: wire_fn}`` where ``wire_fn(outs, feed) -> kwargs`` for
+        the evaluator's ``batch_stats``; additive evaluators ride the same
+        device-side accumulation (DeviceAccumulator), non-additive ones fall
+        back to per-batch host pulls."""
+        from paddle_tpu.evaluators import DeviceAccumulator
+
+        evaluators = evaluators or {}
+        # two cached variants: costs-only lets XLA dead-code-eliminate every
+        # unused activation; the evaluator variant materializes all outputs
+        want_outs = bool(evaluators)
+        cache = getattr(self, "_test_fns", None)
+        if cache is None:
+            cache = self._test_fns = {}
+        fn = cache.get(want_outs)
         if fn is None:
-            fn = self._test_fn = self._infer_fn(self.cost_names)
+            topo, names = self.topology, self.cost_names
+
+            @jax.jit
+            def fn(params, state, feed):
+                outs, _ = topo.apply(params, state, feed, train=False)
+                costs = {k: outs[k].value for k in names}
+                if want_outs:
+                    return costs, {k: a.value for k, a in outs.items()}
+                return costs, {}
+
+            cache[want_outs] = fn
         params = self.avg_params if self.avg_params is not None else self.params
-        totals: List[float] = []
-        per_cost: Dict[str, List[float]] = {n: [] for n in self.cost_names}
+        accs = {ev: (DeviceAccumulator(ev) if ev.additive else None)
+                for ev in evaluators}
+        for ev, acc in accs.items():
+            if acc is None:
+                ev.start()
+        totals = None  # device-side {name: (sum, count)} accumulators
+        nb = 0
         for data_batch in reader():
             feed = feeder(data_batch) if feeder else data_batch
-            out = fn(params, self.state, feed)
-            vals = {n: float(out[n]) for n in self.cost_names}
-            totals.append(sum(w * vals[n]
-                              for n, w in zip(self.cost_names, self.cost_weights)))
-            for n, v in vals.items():
-                per_cost[n].append(v)
-        result = {"cost": float(np.mean(totals)) if totals else float("nan")}
+            costs, outs = fn(params, self.state, feed)
+            if totals is None:
+                totals = costs
+            else:
+                totals = jax.tree_util.tree_map(jnp.add, totals, costs)
+            nb += 1
+            for ev, wire in evaluators.items():
+                kw = wire(outs, feed)
+                acc = accs[ev]
+                if acc is not None:
+                    acc.add(**kw)
+                else:
+                    ev.eval_batch(**kw)
+        def ev_key(ev, seen):
+            # instances of the same evaluator class get numbered keys so
+            # multi-head eval never silently overwrites a metric
+            k, i = ev.name, 2
+            while k in seen:
+                k, i = f"{ev.name}:{i}", i + 1
+            return k
+
+        if totals is None:  # empty reader: all keys present, nan-filled
+            result = {"cost": float("nan")}
+            if len(self.cost_names) > 1:
+                for n in self.cost_names:
+                    result[f"cost:{n}"] = float("nan")
+            for ev in accs:
+                result[ev_key(ev, result)] = float("nan")
+            return result
+        vals = {n: float(totals[n]) / nb for n in self.cost_names}
+        result = {"cost": sum(w * vals[n]
+                              for n, w in zip(self.cost_names, self.cost_weights))}
         if len(self.cost_names) > 1:
-            for n, vs in per_cost.items():
-                result[f"cost:{n}"] = float(np.mean(vs)) if vs else float("nan")
+            for n, v in vals.items():
+                result[f"cost:{n}"] = v
+        for ev, acc in accs.items():
+            result[ev_key(ev, result)] = (
+                acc.result() if acc is not None else ev.result())
         return result
 
     def infer(self, output_layers, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:

@@ -65,7 +65,21 @@ def init(argv: Optional[list] = None) -> list:
         if FLAGS.platform:
             os.environ["JAX_PLATFORMS"] = FLAGS.platform
         _initialized = True
+    apply_numeric_traps()
     return rest
+
+
+def apply_numeric_traps() -> None:
+    """Install/remove the NaN/Inf trap per --check_nan — the
+    feenableexcept(FE_INVALID|...) analog (reference:
+    paddle/trainer/TrainerMain.cpp:49).  jax_debug_nans re-runs the offending
+    jitted program op-by-op and raises at the producing primitive."""
+    import jax
+
+    from paddle_tpu.utils.flags import FLAGS
+
+    jax.config.update("jax_debug_nans", bool(FLAGS.check_nan))
+    jax.config.update("jax_debug_infs", bool(FLAGS.check_nan))
 
 
 def devices() -> List:

@@ -183,13 +183,24 @@ define_flag("beam_size", 3, "default beam width for sequence generation")
 define_flag("max_gen_length", 100, "max generated sequence length")
 
 # Kernel selection
-# A/B on v5e with round-trip-calibrated chained timing (bench.py
-# bench_pallas_lstm_ab, B=64,T=100,H=256, fwd+bwd): Pallas fused time-loop
-# 0.470 ms vs XLA scan 0.498 ms — the fused kernel wins, so it is the
-# default on TPU for tile-aligned default-cell shapes (see
-# ops/rnn.py:_use_pallas_rnn for the exact gate; everything else falls back
-# to the scan path automatically).
+# Decided by the END-TO-END seqToseq A/B on v5e (paired, alternating order,
+# same process): pallas on = 15.4-17.6 ms/batch, off = 17.3-19.2 — the fused
+# kernel wins or ties every pairing, so it stays default-on.  The micro
+# LSTM-only A/B (bench_pallas_lstm_ab, B=64,T=100,H=256) is NOISY through
+# the remote tunnel (winner flips between runs: 0.470-vs-0.498 round 1,
+# 0.494-vs-0.194 round 2, 0.393-vs-0.560 re-run) — treat the pallas_lstm_ab
+# row in BENCH_r*.json as informational; the seq2seq headline is decisive.
+# Gate: ops/rnn.py:_use_pallas_rnn; non-tile-aligned shapes always use scan.
 define_flag("use_pallas_rnn", True, "use fused Pallas LSTM/GRU time-loop kernels on TPU")
+
+# Numeric traps — the feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)
+# analog (reference: paddle/trainer/TrainerMain.cpp:49 installs FP traps for
+# the whole trainer process).  On XLA the equivalent is jax_debug_nans /
+# jax_debug_infs: every jitted computation is re-run op-by-op when a
+# nan/inf escapes, pinpointing the producing primitive.
+define_flag("check_nan", False,
+            "trap NaN/Inf escaping any jitted computation (jax_debug_nans; "
+            "feenableexcept analog)")
 
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")

@@ -136,3 +136,43 @@ def test_printers():
         ev.start()
         ev.eval_batch(**kw)
         assert ev.result() == 1.0 and ev.lines
+
+
+def test_device_accumulator_matches_host_path():
+    """DeviceAccumulator (one device pull per pass) == per-batch eval_batch."""
+    import jax.numpy as jnp
+    from paddle_tpu.evaluators import Auc, ClassificationError, DeviceAccumulator
+
+    rng = np.random.RandomState(7)
+    batches = [
+        (rng.randn(16, 5).astype(np.float32), rng.randint(0, 5, 16))
+        for _ in range(4)
+    ]
+    host = ClassificationError()
+    host.start()
+    acc = DeviceAccumulator(ClassificationError())
+    for logits, labels in batches:
+        host.eval_batch(logits=logits, labels=labels)
+        acc.add(logits=jnp.asarray(logits), labels=jnp.asarray(labels))
+    assert abs(host.result() - acc.result()) < 1e-6
+
+    auc_host = Auc(num_bins=64)
+    auc_host.start()
+    auc_acc = DeviceAccumulator(Auc(num_bins=64))
+    for _ in range(3):
+        p = rng.rand(32).astype(np.float32)
+        y = rng.randint(0, 2, 32)
+        auc_host.eval_batch(prob=p, labels=y)
+        auc_acc.add(prob=jnp.asarray(p), labels=jnp.asarray(y))
+    assert abs(auc_host.result() - auc_acc.result()) < 1e-6
+
+
+def test_device_accumulator_rejects_non_additive():
+    from paddle_tpu.evaluators import DeviceAccumulator, PnpairEvaluator, ValuePrinter
+
+    for ev in (PnpairEvaluator(), ValuePrinter()):
+        try:
+            DeviceAccumulator(ev)
+            assert False, "expected ValueError"
+        except ValueError:
+            pass

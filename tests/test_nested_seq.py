@@ -202,3 +202,21 @@ def test_feeder_nested_kind():
     np.testing.assert_array_equal(sub[0, :2], [3, 1])
     np.testing.assert_array_equal(vals[0, 0, :3], [1, 2, 3])
     np.testing.assert_array_equal(sub[1], [1] + [0] * (sub.shape[1] - 1))
+
+
+def test_feeder_nested_respects_max_len_and_empty_first_row():
+    # max_len caps BOTH nesting levels (flat _pad_seq parity)
+    feeder = DataFeeder({"x": "ids_nested"}, buckets=(2, 4, 8), max_len=4)
+    rows = [([list(range(9)), [1]],), ([[2], [3], [4], [5], [6], [7]],)]
+    vals, outer, sub = feeder(rows)["x"]
+    assert vals.shape[1] <= 4 and vals.shape[2] <= 4
+    assert outer.max() <= 4 and sub.max() <= 4
+
+    # dense_nested with an empty first outer row must not crash; feature dim
+    # comes from the first non-empty sub-sequence
+    feeder2 = DataFeeder({"x": "dense_nested"}, buckets=(2, 4))
+    rows2 = [([],), ([[[1.0, 2.0], [3.0, 4.0]]],)]
+    vals2, outer2, sub2 = feeder2(rows2)["x"]
+    assert vals2.shape[-1] == 2
+    np.testing.assert_array_equal(outer2, [0, 1])
+    np.testing.assert_array_equal(vals2[1, 0, :2, :], [[1.0, 2.0], [3.0, 4.0]])

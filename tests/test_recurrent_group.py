@@ -150,3 +150,64 @@ class TestSequenceGenerator:
             h = mems["h"]
             y = jnp.argmax(logits, -1).astype(jnp.int32)
             np.testing.assert_array_equal(np.asarray(toks[:, 0, t]), np.asarray(y))
+
+    def test_candidate_adjust_callback_bans_token(self, rng):
+        """beamSearchCandidateAdjust analog: a callback that forbids one token
+        must produce generations that never contain it (reference:
+        RecurrentGradientMachine.h:73-110)."""
+        V = 20
+        params, step_fn = self._tiny_lm(rng, V=V)
+        gen = nn.SequenceGenerator(step_fn, vocab_size=V)
+        mems0 = {"h": jnp.zeros((2, 8))}
+        banned = 7
+
+        def adjust(step_logp, tokens, t):
+            return step_logp.at[:, :, banned].set(-1e9)
+
+        toks, _ = gen.generate(params, mems0, batch_size=2, beam_size=3,
+                               max_len=6, candidate_adjust_fn=adjust)
+        assert not np.any(np.asarray(toks) == banned)
+
+    def test_drop_callback_kills_beams(self, rng):
+        """DropCallback analog: dropping every beam except slot 0 after step 0
+        leaves slots 1+ frozen (finished) from then on."""
+        V = 20
+        params, step_fn = self._tiny_lm(rng, V=V)
+        gen = nn.SequenceGenerator(step_fn, vocab_size=V)
+        mems0 = {"h": jnp.zeros((2, 8))}
+
+        def drop(tokens, scores, t):
+            k = scores.shape[1]
+            return jnp.tile((jnp.arange(k) > 0)[None], (scores.shape[0], 1))
+
+        toks, scores = gen.generate(params, mems0, batch_size=2, beam_size=3,
+                                    max_len=6, drop_fn=drop)
+        s = np.asarray(scores)
+        assert np.all(s[:, 1:] <= -1e8)  # dropped beams carry the kill score
+        assert np.all(s[:, 0] > -1e8)
+
+    def test_return_trace_reconstructs_best_beam(self, rng):
+        """Statistics-callback analog: the per-step (parent, token) trace must
+        re-derive the winning token sequence by walking parents backward."""
+        V = 20
+        params, step_fn = self._tiny_lm(rng, V=V)
+        gen = nn.SequenceGenerator(step_fn, vocab_size=V)
+        mems0 = {"h": jnp.zeros((2, 8))}
+        T = 6
+        toks, scores, trace = gen.generate(
+            params, mems0, batch_size=2, beam_size=3, max_len=T,
+            return_trace=True)
+        parent, token = np.asarray(trace["parent"]), np.asarray(trace["token"])
+        order = np.asarray(trace["order"])
+        assert parent.shape == (T, 2, 3) and token.shape == (T, 2, 3)
+        # trace arrays are in native (pre-sort) beam order; order[b, k] maps
+        # returned slot k to its native slot.  Walking parents backward from
+        # the best returned beam's native slot must reproduce toks[b, 0].
+        for b in range(2):
+            k = order[b, 0]
+            seq = []
+            for t in range(T - 1, -1, -1):
+                seq.append(token[t, b, k])
+                k = parent[t, b, k]
+            seq = np.asarray(seq[::-1])
+            np.testing.assert_array_equal(np.asarray(toks[b, 0]), seq)

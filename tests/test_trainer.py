@@ -174,3 +174,69 @@ def test_stat_timers_populate(rng):
     assert global_stat.get("TrainBatch").count == 3
     assert global_stat.get("TrainBatch").total > 0
     reset_stats()
+
+
+def test_trainer_test_with_wired_evaluators(rng):
+    """SGDTrainer.test(evaluators=...) — device-accumulated metric matches a
+    manual host-side eval over the same reader."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.evaluators import ClassificationError
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    x = nn.data("x", size=6)
+    y = nn.data("y", size=1, dtype="int32")
+    logits = nn.fc(x, size=3, act="linear", name="logits")
+    cost = nn.classification_cost(logits, y)
+    tr = SGDTrainer(cost=cost, optimizer=SGD(learning_rate=0.1), seed=3)
+
+    feeds = []
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        feeds.append({
+            "x": rs.randn(8, 6).astype(np.float32),
+            "y": rs.randint(0, 3, (8,)),
+        })
+
+    def reader():
+        return iter(feeds)
+
+    def wire(outs, feed):
+        return {"logits": outs["logits"], "labels": feed["y"]}
+
+    res = tr.test(reader, evaluators={ClassificationError(): wire})
+    assert "cost" in res and "classification_error" in res
+
+    host = ClassificationError()
+    host.start()
+    for f in feeds:
+        out = tr.infer([logits], f)
+        host.eval_batch(logits=out["logits"], labels=f["y"])
+    assert abs(res["classification_error"] - host.result()) < 1e-6
+
+
+def test_trainer_test_duplicate_evaluators_get_distinct_keys(rng):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.evaluators import ClassificationError
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=1, dtype="int32")
+    logits = nn.fc(x, size=2, act="linear", name="lg")
+    tr = SGDTrainer(cost=nn.classification_cost(logits, y),
+                    optimizer=SGD(learning_rate=0.1), seed=5)
+    feeds = [{"x": np.zeros((4, 4), np.float32), "y": np.zeros((4,), np.int64)}]
+
+    def wire(outs, feed):
+        return {"logits": outs["lg"], "labels": feed["y"]}
+
+    res = tr.test(lambda: iter(feeds),
+                  evaluators={ClassificationError(): wire,
+                              ClassificationError(): wire})
+    assert "classification_error" in res and "classification_error:2" in res
+
+    # empty reader: evaluator keys present but nan (never a fake-perfect 0.0)
+    res2 = tr.test(lambda: iter([]), evaluators={ClassificationError(): wire})
+    assert np.isnan(res2["classification_error"])

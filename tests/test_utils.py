@@ -56,3 +56,27 @@ def test_virtual_devices_mesh():
     assert mesh.shape == {"data": 4, "model": 2}
     mesh1 = devices.make_mesh()
     assert mesh1.shape == {"data": 8}
+
+
+def test_check_nan_flag_traps():
+    """--check_nan installs the feenableexcept analog: a NaN escaping a
+    jitted computation raises instead of propagating silently
+    (reference: TrainerMain.cpp:49)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils.devices import apply_numeric_traps
+    from paddle_tpu.utils.flags import FLAGS
+
+    old = FLAGS.check_nan
+    try:
+        FLAGS.check_nan = True
+        apply_numeric_traps()
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+    finally:
+        FLAGS.check_nan = old
+        apply_numeric_traps()
+    # trap removed: silent nan again
+    out = jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0))
+    assert bool(jnp.isnan(out))

@@ -84,20 +84,24 @@ class DataFeeder:
             outer = np.minimum(outer, self.max_len)
         To = bucket_length(n_outer, self.buckets)
         Ti = bucket_length(max(ti_max, 1), self.buckets)
+        # buckets round To/Ti UP, so slice to the max_len caps themselves —
+        # data beyond the cap must not survive (mirrors _pad_seq's lengths[i])
+        row_cap = min(To, self.max_len) if self.max_len else To
+        ti_cap = min(Ti, self.max_len) if self.max_len else Ti
         sub_lengths = np.zeros((len(col), To), np.int32)
         if kind == "ids_nested":
             out = np.zeros((len(col), To, Ti), np.int32)
             for i, row in enumerate(col):
-                for j, sub in enumerate(list(row)[:To]):
-                    sub = list(sub)[:Ti]
+                for j, sub in enumerate(list(row)[:row_cap]):
+                    sub = list(sub)[:ti_cap]
                     out[i, j, : len(sub)] = sub
                     sub_lengths[i, j] = len(sub)
         else:
             D = next((len(sub[0]) for row in col for sub in row if len(sub)), 1)
             out = np.zeros((len(col), To, Ti, D), self.dtype)
             for i, row in enumerate(col):
-                for j, sub in enumerate(list(row)[:To]):
-                    sub = np.asarray(sub, self.dtype).reshape(-1, D)[:Ti]
+                for j, sub in enumerate(list(row)[:row_cap]):
+                    sub = np.asarray(sub, self.dtype).reshape(-1, D)[:ti_cap]
                     out[i, j, : len(sub)] = sub
                     sub_lengths[i, j] = len(sub)
         return out, outer, sub_lengths

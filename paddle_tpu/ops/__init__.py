@@ -52,6 +52,7 @@ from paddle_tpu.ops.attention import (
     attend,
     dot_product_attention,
 )
+from paddle_tpu.ops.attention_decoder import attention_gru_decoder
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
 from paddle_tpu.ops.sparse import (
     sparse_gather_matmul,

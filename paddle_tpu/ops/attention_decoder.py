@@ -1,0 +1,231 @@
+"""Fused-backward attention GRU decoder — the seq2seq training hot loop.
+
+Semantically identical to scanning ``additive_attention_scores`` + ``attend``
++ concat + ``linear`` + ``gru_step`` over the target sequence (the Bahdanau
+decoder of demo/seqToseq, reference: demo/seqToseq/api_train_v2.py:90-189,
+gserver/gradientmachines/RecurrentGradientMachine.cpp) — but with a
+hand-written VJP that restructures the backward pass for TPU HBM bandwidth.
+
+Why: XLA's autodiff of that scan carries the cotangent accumulators
+``d_enc`` [B,S,2H] and the weight grads through HBM on EVERY reverse step —
+at WMT14 bench shapes that is ~45+ MB of accumulator read+write per step,
+~10x the cost of the forward scan (measured 4.4 ms backward vs 0.45 ms
+forward on v5e).  The custom VJP instead:
+
+- emits the SMALL per-step cotangents (``d_xp`` [B,3D], ``d_ctx`` [B,2H])
+  as stacked scan outputs,
+- reconstructs every big gradient AFTER the scan as one batched MXU
+  contraction each: ``d_enc = einsum('tbs,tbh->bsh', probs, d_ctx)``,
+  ``d_Wx = einsum('tbi,tbo->io', x, d_xp)``, ``d_y = d_xp @ Wx^T``,
+- keeps only genuinely sequential accumulators (``d_enc_proj``, ``d_Wh``,
+  attention weight grads) in the reverse scan, with the ``d_enc_proj``
+  accumulator in the compute dtype.
+
+Forward saves (probs [T,B,S], ctx [T,B,2H], states) — O(B·T·(S+2H+D))
+residuals, ~100 MB at bench shapes vs the ~1.3 GB/step-loop accumulator
+traffic it removes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.matmul import linear
+from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+
+__all__ = ["attention_gru_decoder"]
+
+
+def _fwd_step(s, y_t, enc, enc_proj, src_mask, att_w, att_v, wx, b, wh):
+    """One decoder step; mirrors additive_attention_scores/attend/gru_step
+    numerics exactly (bf16 matmul operands, f32 accumulation)."""
+    D = s.shape[-1]
+    # --- additive_attention_scores ---
+    q = linear(s, att_w)[:, None, :]
+    enc_proj_c, q_c = mxu_cast(enc_proj, q)
+    pre = jnp.tanh(enc_proj_c + q_c)                       # [B,S,A]
+    scores = jnp.einsum("bsa,a->bs", pre, att_v.astype(pre.dtype),
+                        preferred_element_type=acc_dtype())
+    # --- attend ---
+    neg = jnp.finfo(scores.dtype).min
+    z = jnp.where(src_mask > 0, scores, neg)
+    w0 = jax.nn.softmax(z, axis=-1)
+    w1 = w0 * src_mask.astype(scores.dtype)
+    n = jnp.maximum(jnp.sum(w1, axis=-1, keepdims=True), 1e-9)
+    w = w1 / n
+    wc, vc = mxu_cast(w, enc)
+    ctx = jnp.einsum("bs,bsd->bd", wc, vc,
+                     preferred_element_type=acc_dtype()).astype(acc_dtype())
+    # --- input projection + gru_step ---
+    x = jnp.concatenate([y_t, ctx.astype(y_t.dtype)], axis=-1)
+    xp = linear(x, wx, b)
+    zr = xp[..., : 2 * D] + linear(s, wh[:, : 2 * D])
+    r, u = jnp.split(jax.nn.sigmoid(zr), 2, axis=-1)
+    cand = jnp.tanh(xp[..., 2 * D:] + linear(r * s, wh[:, 2 * D:]))
+    s_new = u * s + (1.0 - u) * cand
+    return s_new, (w, ctx, pre)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=())
+def attention_gru_decoder(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
+                          att_w, att_v, wx, b, wh):
+    """y_emb [B,T,E], s0 [B,D], enc [B,S,2H], enc_proj [B,S,A],
+    src_mask [B,S], trg_mask [B,T] -> states [B,T,D] (zeroed at padded
+    target steps, carry held — scan_rnn masking semantics)."""
+    states, _ = _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask,
+                                  trg_mask, att_w, att_v, wx, b, wh)
+    return states
+
+
+def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
+                      att_w, att_v, wx, b, wh):
+    y_tb = jnp.moveaxis(y_emb, 1, 0)                       # [T,B,E]
+    m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
+
+    def step(s, inp):
+        y_t, m_t = inp
+        s_new, (w, ctx, _pre) = _fwd_step(s, y_t, enc, enc_proj, src_mask,
+                                          att_w, att_v, wx, b, wh)
+        keep = (m_t > 0)[:, None]
+        s_out = jnp.where(keep, s_new, s)
+        out = s_out * m_t[:, None].astype(s_out.dtype)
+        return s_out, (out, w, ctx)
+
+    _, (outs, probs, ctxs) = lax.scan(step, s0, (y_tb, m_tb))
+    states = jnp.moveaxis(outs, 0, 1)                      # [B,T,D]
+    return states, (probs, ctxs)
+
+
+def _agd_fwd(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
+             att_w, att_v, wx, b, wh):
+    states, (probs, ctxs) = _decoder_fwd_scan(
+        y_emb, s0, enc, enc_proj, src_mask, trg_mask, att_w, att_v, wx, b, wh)
+    res = (y_emb, s0, enc, enc_proj, src_mask, trg_mask,
+           att_w, att_v, wx, b, wh, states, probs, ctxs)
+    return states, res
+
+
+def _agd_bwd(res, d_states):
+    (y_emb, s0, enc, enc_proj, src_mask, trg_mask,
+     att_w, att_v, wx, b, wh, states, probs, ctxs) = res
+    B, T, D = states.shape
+    S = enc.shape[1]
+    E = y_emb.shape[-1]
+    f32 = jnp.float32
+    cd = enc_proj.dtype  # compute dtype of the cached encoder tensors
+
+    y_tb = jnp.moveaxis(y_emb, 1, 0)                       # [T,B,E]
+    m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
+    d_out_tb = jnp.moveaxis(d_states, 1, 0).astype(f32)    # [T,B,D]
+    # s_prev[t]: carry entering step t.  The saved states are the zeroed
+    # outputs (out = carry*m), so at masked steps the HELD carry must be
+    # reconstructed by forward-filling the last live output:
+    def carry_fix(c, om):
+        out_t, m_t = om
+        c_t = jnp.where((m_t > 0)[:, None], out_t, c)
+        return c_t, c_t
+    _, carries = lax.scan(carry_fix, s0, (jnp.moveaxis(states, 1, 0), m_tb))
+    s_prev = jnp.concatenate([s0[None], carries[:-1]], 0)  # [T,B,D]
+
+    att_w_f, att_v_f = att_w.astype(f32), att_v.astype(f32)
+    wx_f, wh_f = wx.astype(f32), wh.astype(f32)
+    neg = jnp.finfo(f32).min
+    maskb = (src_mask > 0)
+    mask_f = src_mask.astype(f32)
+
+    def rev_step(carry, inp):
+        d_s, d_encP, d_attw, d_v, d_wh, d_b = carry
+        d_out_t, m_t, y_t, w_t, ctx_t, sp_t = inp
+        mcol = (m_t > 0)[:, None].astype(f32)
+        d_snew = mcol * (d_out_t + d_s)
+
+        # ---- recompute GRU internals ----
+        x = jnp.concatenate([y_t, ctx_t.astype(y_t.dtype)], axis=-1)
+        xp = linear(x, wx, b).astype(f32)
+        sp = sp_t.astype(f32)
+        zr = xp[..., : 2 * D] + linear(sp_t, wh[:, : 2 * D]).astype(f32)
+        ru = jax.nn.sigmoid(zr)
+        r, u = jnp.split(ru, 2, axis=-1)
+        cand = jnp.tanh(xp[..., 2 * D:]
+                        + linear(r * sp_t, wh[:, 2 * D:]).astype(f32))
+
+        # ---- GRU backward ----
+        d_u = d_snew * (sp - cand)
+        d_cand = d_snew * (1.0 - u)
+        d_h = d_snew * u
+        d_zc = d_cand * (1.0 - cand * cand)
+        d_rh = d_zc @ wh_f[:, 2 * D:].T
+        d_r = d_rh * sp
+        d_h = d_h + d_rh * r
+        d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
+        d_h = d_h + d_zr @ wh_f[:, : 2 * D].T
+        d_xp = jnp.concatenate([d_zr, d_zc], -1)           # [B,3D]
+        d_wh = d_wh + jnp.concatenate(
+            [sp.T @ d_zr, (r * sp).T @ d_zc], axis=1)
+        d_b = d_b + jnp.sum(d_xp, axis=0)
+        d_ctx = d_xp @ wx_f[E:].T                          # [B,2H]
+
+        # ---- attention backward (attend) ----
+        d_w = jnp.einsum("bh,bsh->bs", d_ctx.astype(enc.dtype), enc,
+                         preferred_element_type=f32)
+        # recompute softmax chain
+        q = linear(sp_t, att_w)[:, None, :]
+        enc_proj_c, q_c = mxu_cast(enc_proj, q)
+        pre = jnp.tanh(enc_proj_c + q_c)                   # [B,S,A] cd
+        scores = jnp.einsum("bsa,a->bs", pre, att_v.astype(pre.dtype),
+                            preferred_element_type=f32)
+        z = jnp.where(maskb, scores, neg)
+        w0 = jax.nn.softmax(z, axis=-1)
+        w1 = w0 * mask_f
+        n = jnp.maximum(jnp.sum(w1, axis=-1, keepdims=True), 1e-9)
+        # w = w1/n
+        d_w1 = d_w / n
+        d_n = -jnp.sum(d_w * w1, axis=-1, keepdims=True) / (n * n)
+        d_w1 = d_w1 + d_n * (jnp.sum(w1, -1, keepdims=True) > 1e-9).astype(f32)
+        d_w0 = d_w1 * mask_f
+        d_z = w0 * (d_w0 - jnp.sum(w0 * d_w0, axis=-1, keepdims=True))
+        d_scores = jnp.where(maskb, d_z, 0.0)
+        pre_f = pre.astype(f32)
+        d_pre = (1.0 - pre_f * pre_f) * (d_scores[..., None] * att_v_f)
+        d_encP = d_encP + d_pre.astype(cd)
+        sum_dpre = jnp.sum(d_pre, axis=1)                  # [B,A]
+        d_h = d_h + sum_dpre @ att_w_f.T
+        d_attw = d_attw + sp.T @ sum_dpre
+        d_v = d_v + jnp.einsum("bs,bsa->a", d_scores, pre_f)
+
+        d_s_out = (1.0 - mcol) * d_s + d_h
+        return (d_s_out, d_encP, d_attw, d_v, d_wh, d_b), (d_xp, d_ctx)
+
+    A = enc_proj.shape[-1]
+    acc0 = (jnp.zeros((B, D), f32),
+            jnp.zeros((B, S, A), cd),
+            jnp.zeros(att_w.shape, f32),
+            jnp.zeros(att_v.shape, f32),
+            jnp.zeros(wh.shape, f32),
+            jnp.zeros(b.shape, f32))
+    (d_s0, d_encP, d_attw, d_v, d_wh, d_b), (d_xp_tb, d_ctx_tb) = lax.scan(
+        rev_step, acc0,
+        (d_out_tb, m_tb, y_tb, probs, ctxs, s_prev),
+        reverse=True)
+
+    # ---- batched post-scan contractions ----
+    # d_enc: the only use of enc is ctx_t = w_t @ enc
+    d_enc = jnp.einsum("tbs,tbh->bsh", probs, d_ctx_tb).astype(enc.dtype)
+    # d_wx over all steps at once: x = [y, ctx]
+    x_all = jnp.concatenate([y_tb.astype(f32), ctxs], axis=-1)  # [T,B,E+2H]
+    d_wx = jnp.einsum("tbi,tbo->io", x_all, d_xp_tb)
+    d_y = (d_xp_tb @ wx_f[:E].T).astype(y_emb.dtype)       # [T,B,E]
+    d_y_emb = jnp.moveaxis(d_y, 0, 1)
+
+    return (d_y_emb, d_s0.astype(s0.dtype), d_enc, d_encP,
+            None, None,
+            d_attw.astype(att_w.dtype), d_v.astype(att_v.dtype),
+            d_wx.astype(wx.dtype), d_b.astype(b.dtype),
+            d_wh.astype(wh.dtype))
+
+
+attention_gru_decoder.defvjp(_agd_fwd, _agd_bwd)

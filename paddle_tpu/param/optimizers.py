@@ -161,8 +161,21 @@ class Optimizer:
         update semantics (SparseRowCpuMatrix / SparseMomentum,
         paddle/math/SparseRowMatrix.h, FirstOrderOptimizer.h:52), where
         momentum decay and regularization do not advance untouched rows.
-        Implemented as a per-row touched mask over the dense scatter-add
-        gradient — static shapes, jit/pjit-safe, fuses into the update."""
+
+        Two implementations, chosen per-parameter by the dict value:
+
+        - ``True`` — per-row touched mask over the dense scatter-add
+          gradient (jnp.where); correct for any touched count but still
+          reads/writes the FULL table and slots every step.
+        - an int ``K`` — gather-update-scatter fast path: top_k selects up
+          to K touched row indices, only those rows of the parameter and
+          its slots are gathered, updated, and scattered back in place
+          (donated buffers make this a true O(K·D) row update instead of
+          O(V·D) — the SparseRowCpuMatrix locality argument, on HBM
+          bandwidth instead of CPU cache).  ``K`` MUST upper-bound the
+          number of rows a batch can touch (e.g. batch·seq_len per lookup
+          of the table); excess touched rows beyond K would be dropped.
+        """
         step = opt_state["step"] + 1
         lr = self.lr_at(step)
         if self.gradient_clipping_threshold > 0:
@@ -174,14 +187,43 @@ class Optimizer:
                 new_params[k], new_slots[k] = p, opt_state["slots"][k]
                 continue
             decay = (decays.get(k, 0.0) if decays else 0.0) + self.l2_rate
+            scale = lr_scales.get(k, 1.0) if lr_scales else 1.0
+            old_slots = opt_state["slots"][k]
+            kind = sparse_rows.get(k) if sparse_rows else None
+            if (kind is not None and kind is not True and kind is not False
+                    and isinstance(kind, int) and p.ndim >= 2
+                    and 0 < kind < p.shape[0]):
+                # ---- row fast path: touch only K candidate rows ----
+                K = int(kind)
+                raw = grads[k]
+                touched = jnp.any(raw != 0, axis=tuple(range(1, p.ndim)))
+                live_score, rows = jax.lax.top_k(touched.astype(jnp.float32), K)
+                live = (live_score > 0).reshape((-1,) + (1,) * (p.ndim - 1))
+                p_r, g_r = p[rows], raw[rows]
+                if decay:
+                    g_r = g_r + decay * p_r
+                if self.l1_rate:
+                    g_r = g_r + self.l1_rate * jnp.sign(p_r)
+                s_r = jax.tree_util.tree_map(
+                    lambda s: s[rows]
+                    if getattr(s, "shape", None) == p.shape else s, old_slots)
+                p2_r, s2_r = self.update_leaf(p_r, g_r, s_r, lr * scale, step)
+                p2_r = jnp.where(live, p2_r, p_r)
+                # top_k indices are distinct -> unique scatter
+                new_params[k] = p.at[rows].set(
+                    p2_r.astype(p.dtype), unique_indices=True)
+                new_slots[k] = jax.tree_util.tree_map(
+                    lambda o, n2: o.at[rows].set(
+                        jnp.where(live, n2, o[rows]), unique_indices=True)
+                    if getattr(o, "shape", None) == p.shape else n2,
+                    old_slots, s2_r)
+                continue
             if decay:
                 g = g + decay * p
             if self.l1_rate:
                 g = g + self.l1_rate * jnp.sign(p)
-            scale = lr_scales.get(k, 1.0) if lr_scales else 1.0
-            old_slots = opt_state["slots"][k]
             p2, s2 = self.update_leaf(p, g, old_slots, lr * scale, step)
-            if sparse_rows and sparse_rows.get(k) and p.ndim >= 2:
+            if kind and p.ndim >= 2:
                 touched = jnp.any(grads[k] != 0, axis=tuple(range(1, p.ndim)))
                 row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
 

@@ -212,6 +212,14 @@ def test_feeder_nested_respects_max_len_and_empty_first_row():
     assert vals.shape[1] <= 4 and vals.shape[2] <= 4
     assert outer.max() <= 4 and sub.max() <= 4
 
+    # max_len between buckets: data/lengths beyond the cap must not survive
+    # even though the padded width rounds up to the next bucket
+    feeder_b = DataFeeder({"x": "ids_nested"}, buckets=(2, 4, 8), max_len=5)
+    vals_b, outer_b, sub_b = feeder_b([([[9] * 6] * 7,)])["x"]
+    assert outer_b[0] == 5 and sub_b.max() <= 5
+    assert np.all(sub_b[0, 5:] == 0)  # no sub_lengths beyond outer
+    assert np.all(vals_b[0, 5:] == 0) and np.all(vals_b[0, :, 5:] == 0)
+
     # dense_nested with an empty first outer row must not crash; feature dim
     # comes from the first non-empty sub-sequence
     feeder2 = DataFeeder({"x": "dense_nested"}, buckets=(2, 4))

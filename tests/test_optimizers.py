@@ -115,3 +115,57 @@ def test_optimizer_update_jits():
 
     p2, s2 = step(params, s)
     assert int(s2["step"]) == 1
+
+
+def test_sparse_rows_fast_path_matches_mask_path():
+    """sparse_rows=K (gather-update-scatter) == sparse_rows=True (where-mask)
+    for every optimizer with row-shaped slots."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.param.optimizers import Adam, AdaGrad, Momentum, SGD
+
+    rs = np.random.RandomState(3)
+    V, D = 50, 8
+    params = {"emb": jnp.asarray(rs.randn(V, D).astype(np.float32)),
+              "w": jnp.asarray(rs.randn(D, 4).astype(np.float32))}
+    # row-sparse grad: only rows 3, 7, 20 touched
+    ge = np.zeros((V, D), np.float32)
+    for r in (3, 7, 20):
+        ge[r] = rs.randn(D)
+    grads = {"emb": jnp.asarray(ge),
+             "w": jnp.asarray(rs.randn(D, 4).astype(np.float32))}
+
+    for opt_cls in (SGD, Momentum, AdaGrad, Adam):
+        kw = {"learning_rate": 0.1}
+        a, b = opt_cls(**kw), opt_cls(**kw)
+        sa, sb = a.init_state(params), b.init_state(params)
+        pa, pb = dict(params), dict(params)
+        for _ in range(3):
+            pa, sa = a.update(pa, grads, sa, sparse_rows={"emb": True})
+            pb, sb = b.update(pb, grads, sb, sparse_rows={"emb": 8})
+        for k in params:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=1e-6, atol=1e-6,
+                                       err_msg=f"{opt_cls.__name__}/{k}")
+        fa = jax.tree_util.tree_leaves(sa)
+        fb = jax.tree_util.tree_leaves(sb)
+        for x, y in zip(fa, fb):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_sparse_rows_fast_path_with_decay_only_advances_touched():
+    """l2 decay under the K fast path must not move untouched rows (lazy
+    regularization, FirstOrderOptimizer.h:52)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.param.optimizers import SGD
+
+    V, D = 20, 4
+    p0 = jnp.ones((V, D))
+    g = jnp.zeros((V, D)).at[5].set(1.0)
+    opt = SGD(learning_rate=0.1, l2_rate=0.01)
+    st = opt.init_state({"emb": p0})
+    p1, _ = opt.update({"emb": p0}, {"emb": g}, st, sparse_rows={"emb": 4})
+    moved = np.where(np.any(np.asarray(p1["emb"]) != 1.0, axis=1))[0]
+    np.testing.assert_array_equal(moved, [5])

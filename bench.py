@@ -86,7 +86,7 @@ def _time_chain(one_step, carry, *, iters, rtt, reps=3):
     except Exception:
         pass
 
-    for _ in range(8):  # grow the chain until it dominates the round-trip
+    for attempt in range(8):  # grow the chain until it dominates the RTT
         chain = make_chain(iters)
         _, probe = chain(carry)  # compile + first run
         _fetch(probe)
@@ -97,10 +97,10 @@ def _time_chain(one_step, carry, *, iters, rtt, reps=3):
             _fetch(probe)
             times.append(time.perf_counter() - t0)
         total = float(np.median(times))
-        if total - rtt >= max(rtt, 0.02):
+        if total - rtt >= max(rtt, 0.02) or attempt == 7:
             break
         iters *= 2
-    sec = max(total - rtt, 1e-9) / iters
+    sec = max(total - rtt, 1e-9) / iters  # iters == the length just timed
     return sec, flops
 
 

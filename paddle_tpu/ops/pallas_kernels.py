@@ -155,7 +155,12 @@ def lstm_forward_pallas(xp, mask, w_h):
     """xp: [B,T,4H] input projection (+bias), mask [B,T], w_h [H,4H].
     Returns (h_seq [B,T,H], h_final, c_final), always float32; h_seq is zero
     at padded timesteps (same semantics as the scan path). No peepholes
-    (gated upstream)."""
+    (gated upstream).
+
+    Direct kernel entry (tests exercise it in interpret mode; backward is
+    autodiff-of-reference).  The PRODUCTION path is
+    ops/rnn_fused.lstm_sequence_fused, which pairs the same raw kernel with
+    the hand-written fast backward."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
     h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
@@ -266,7 +271,10 @@ def _gru_reference(xp, mask, w_h):
 @jax.custom_vjp
 def gru_forward_pallas(xp, mask, w_h):
     """xp: [B,T,3H], mask [B,T], w_h [H,3H] -> (h_seq [B,T,H], h_final),
-    always float32; h_seq is zero at padded timesteps."""
+    always float32; h_seq is zero at padded timesteps.
+
+    Direct kernel entry (tests/interpret mode); production uses
+    ops/rnn_fused.gru_sequence_fused — see lstm_forward_pallas."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
     h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))

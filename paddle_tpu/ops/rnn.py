@@ -148,11 +148,23 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 4H]
-    if _use_pallas_rnn(B, H, h0, c0, peep_i, peep_f, peep_o, act, gate_act,
-                       state_act, reverse):
-        from paddle_tpu.ops.pallas_kernels import lstm_forward_pallas
+    if (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh") and not any(
+            p is not None for p in (peep_i, peep_f, peep_o)):
+        # default cell: fused-backward sequence op (hand-written VJP batches
+        # d_w_h after the reverse scan; Pallas forward when the gate allows
+        # — see ops/rnn_fused.py).  reverse rides a flip: identical to
+        # scan_rnn(reverse=True) including mask hold/zero semantics.
+        from paddle_tpu.ops.rnn_fused import lstm_sequence_fused
 
-        h_seq, h_fin, c_fin = lstm_forward_pallas(xp, mask, w_h)
+        allow_pallas = h0 is None and c0 is None
+        h0a = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
+        c0a = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
+        xp_r = jnp.flip(xp, 1) if reverse else xp
+        m_r = jnp.flip(mask, 1) if reverse else mask
+        h_seq, h_fin, c_fin = lstm_sequence_fused(xp_r, m_r, w_h, h0a, c0a,
+                                                  allow_pallas)
+        if reverse:
+            h_seq = jnp.flip(h_seq, 1)
         return h_seq, (h_fin, c_fin)
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
     c0 = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
@@ -179,11 +191,17 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = linear(x, w_x, b)  # [B, T, 3H]
-    if _use_pallas_rnn(B, H, h0, None, None, None, None, act, gate_act,
-                       "tanh", reverse):
-        from paddle_tpu.ops.pallas_kernels import gru_forward_pallas
+    if (act, gate_act) == ("tanh", "sigmoid"):
+        # default cell: fused-backward sequence op (see lstm_layer above)
+        from paddle_tpu.ops.rnn_fused import gru_sequence_fused
 
-        h_seq, h_fin = gru_forward_pallas(xp, mask, w_h)
+        allow_pallas = h0 is None
+        h0a = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
+        xp_r = jnp.flip(xp, 1) if reverse else xp
+        m_r = jnp.flip(mask, 1) if reverse else mask
+        h_seq, h_fin = gru_sequence_fused(xp_r, m_r, w_h, h0a, allow_pallas)
+        if reverse:
+            h_seq = jnp.flip(h_seq, 1)
         return h_seq, h_fin
     h0 = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
 

@@ -153,7 +153,7 @@ class Optimizer:
         lr_scales: Optional[Dict[str, float]] = None,
         decays: Optional[Dict[str, float]] = None,
         statics: Optional[Dict[str, bool]] = None,
-        sparse_rows: Optional[Dict[str, bool]] = None,
+        sparse_rows: Optional[Dict[str, Any]] = None,  # bool mask path or int K
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """``sparse_rows`` marks row-sparse parameters (embedding tables with
         ParamAttr(sparse_grad=True)): rows a batch never touched keep their
@@ -195,11 +195,10 @@ class Optimizer:
                     and 0 < kind < p.shape[0]):
                 # ---- row fast path: touch only K candidate rows ----
                 K = int(kind)
-                raw = grads[k]
-                touched = jnp.any(raw != 0, axis=tuple(range(1, p.ndim)))
+                touched = jnp.any(g != 0, axis=tuple(range(1, p.ndim)))
                 live_score, rows = jax.lax.top_k(touched.astype(jnp.float32), K)
                 live = (live_score > 0).reshape((-1,) + (1,) * (p.ndim - 1))
-                p_r, g_r = p[rows], raw[rows]
+                p_r, g_r = p[rows], g[rows]
                 if decay:
                     g_r = g_r + decay * p_r
                 if self.l1_rate:

@@ -1,0 +1,143 @@
+"""Fused-backward GRU/LSTM sequence ops vs scan_rnn autodiff — values and
+gradients, covering masks, reverse (flip routing in gru_layer/lstm_layer),
+and non-zero boot state."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.ops as O
+from paddle_tpu.ops.rnn_fused import gru_sequence_fused, lstm_sequence_fused
+
+
+def _mask(lens, T):
+    return jnp.asarray((np.arange(T)[None]
+                        < np.asarray(lens)[:, None]).astype(np.float32))
+
+
+class TestGruFused:
+    def _ref(self, xp, mask, wh, h0):
+        def step(h, xp_t):
+            return (lambda h2: (h2, h2))(O.gru_step(xp_t, h, wh))
+        return O.scan_rnn(step, h0, xp, mask)
+
+    @pytest.mark.parametrize("lens", [(5, 3, 1), (5, 5, 5)])
+    def test_forward_and_grads(self, lens):
+        rs = np.random.RandomState(0)
+        B, T, H = 3, 5, 4
+        xp = jnp.asarray(rs.randn(B, T, 3 * H).astype(np.float32))
+        mask = _mask(lens, T)
+        wh = jnp.asarray(0.4 * rs.randn(H, 3 * H).astype(np.float32))
+        h0 = jnp.asarray(rs.randn(B, H).astype(np.float32))
+        ct_seq = jnp.asarray(rs.randn(B, T, H).astype(np.float32))
+        ct_fin = jnp.asarray(rs.randn(B, H).astype(np.float32))
+
+        ref_fin, ref_seq = self._ref(xp, mask, wh, h0)
+        new_seq, new_fin = gru_sequence_fused(xp, mask, wh, h0, False)
+        np.testing.assert_allclose(np.asarray(ref_seq), np.asarray(new_seq),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(ref_fin), np.asarray(new_fin),
+                                   rtol=1e-5, atol=1e-6)
+
+        def loss_ref(xp, wh, h0):
+            fin, seq = self._ref(xp, mask, wh, h0)
+            return jnp.sum(seq * ct_seq) + jnp.sum(fin * ct_fin)
+
+        def loss_new(xp, wh, h0):
+            seq, fin = gru_sequence_fused(xp, mask, wh, h0, False)
+            return jnp.sum(seq * ct_seq) + jnp.sum(fin * ct_fin)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(xp, wh, h0)
+        g_new = jax.grad(loss_new, argnums=(0, 1, 2))(xp, wh, h0)
+        for name, a, b in zip(("xp", "wh", "h0"), g_ref, g_new):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5, err_msg=name)
+
+    def test_gru_layer_reverse_matches_scan_reference(self):
+        """gru_layer's flip-routed reverse == scan_rnn(reverse=True)."""
+        rs = np.random.RandomState(1)
+        B, T, D, H = 3, 6, 5, 4
+        x = jnp.asarray(rs.randn(B, T, D).astype(np.float32))
+        mask = _mask((6, 4, 2), T)
+        wx = jnp.asarray(0.4 * rs.randn(D, 3 * H).astype(np.float32))
+        wh = jnp.asarray(0.4 * rs.randn(H, 3 * H).astype(np.float32))
+        b = jnp.asarray(0.1 * rs.randn(3 * H).astype(np.float32))
+
+        h_seq, h_fin = O.gru_layer(x, mask, wx, wh, b, reverse=True)
+
+        xp = O.linear(x, wx, b)
+        def step(h, xp_t):
+            h2 = O.gru_step(xp_t, h, wh)
+            return h2, h2
+        rf, rseq = O.scan_rnn(step, jnp.zeros((B, H)), xp, mask, reverse=True)
+        np.testing.assert_allclose(np.asarray(rseq), np.asarray(h_seq),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rf), np.asarray(h_fin),
+                                   rtol=1e-5, atol=1e-6)
+
+        # grads through the layer stay finite and match the scan reference
+        def loss_layer(wx, wh):
+            s, f = O.gru_layer(x, mask, wx, wh, b, reverse=True)
+            return jnp.sum(s ** 2) + jnp.sum(f ** 2)
+
+        def loss_ref(wx, wh):
+            xp = O.linear(x, wx, b)
+            f, s = O.scan_rnn(step_w(wh), jnp.zeros((B, H)), xp, mask,
+                              reverse=True)
+            return jnp.sum(s ** 2) + jnp.sum(f ** 2)
+
+        def step_w(wh):
+            def step(h, xp_t):
+                h2 = O.gru_step(xp_t, h, wh)
+                return h2, h2
+            return step
+
+        ga = jax.grad(loss_layer, argnums=(0, 1))(wx, wh)
+        gb = jax.grad(loss_ref, argnums=(0, 1))(wx, wh)
+        for name, a, b2 in zip(("wx", "wh"), ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+class TestLstmFused:
+    def _ref(self, xp, mask, wh, h0, c0):
+        def step(carry, xp_t):
+            h, c = carry
+            h2, c2 = O.lstm_step(xp_t, h, c, wh)
+            return (h2, c2), h2
+        return O.scan_rnn(step, (h0, c0), xp, mask)
+
+    @pytest.mark.parametrize("lens", [(5, 3, 1), (5, 5, 5)])
+    def test_forward_and_grads(self, lens):
+        rs = np.random.RandomState(2)
+        B, T, H = 3, 5, 4
+        xp = jnp.asarray(rs.randn(B, T, 4 * H).astype(np.float32))
+        mask = _mask(lens, T)
+        wh = jnp.asarray(0.4 * rs.randn(H, 4 * H).astype(np.float32))
+        h0 = jnp.asarray(rs.randn(B, H).astype(np.float32))
+        c0 = jnp.asarray(rs.randn(B, H).astype(np.float32))
+        ct_seq = jnp.asarray(rs.randn(B, T, H).astype(np.float32))
+
+        (rf, rc), rseq = self._ref(xp, mask, wh, h0, c0)
+        nseq, nf, nc = lstm_sequence_fused(xp, mask, wh, h0, c0, False)
+        np.testing.assert_allclose(np.asarray(rseq), np.asarray(nseq),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rf), np.asarray(nf),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rc), np.asarray(nc),
+                                   rtol=1e-5, atol=1e-6)
+
+        def loss_ref(xp, wh, h0, c0):
+            (f, c), seq = self._ref(xp, mask, wh, h0, c0)
+            return jnp.sum(seq * ct_seq) + jnp.sum(f) + jnp.sum(c)
+
+        def loss_new(xp, wh, h0, c0):
+            seq, f, c = lstm_sequence_fused(xp, mask, wh, h0, c0, False)
+            return jnp.sum(seq * ct_seq) + jnp.sum(f) + jnp.sum(c)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xp, wh, h0, c0)
+        g_new = jax.grad(loss_new, argnums=(0, 1, 2, 3))(xp, wh, h0, c0)
+        for name, a, b in zip(("xp", "wh", "h0", "c0"), g_ref, g_new):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5, err_msg=name)

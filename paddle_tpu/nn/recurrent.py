@@ -34,7 +34,8 @@ from paddle_tpu.nn.graph import Act, LayerOutput, Topology, next_name
 from paddle_tpu.nn.layers import data as data_layer
 from paddle_tpu.utils.error import ConfigError
 
-__all__ = ["Memory", "StaticInput", "recurrent_group", "SequenceGenerator"]
+__all__ = ["Memory", "StaticInput", "GeneratedInput", "recurrent_group",
+           "beam_search", "SequenceGenerator"]
 
 
 @dataclass
@@ -54,6 +55,19 @@ class StaticInput:
     of the reference's StaticInput (layers.py)."""
 
     input: LayerOutput
+
+
+@dataclass
+class GeneratedInput:
+    """Marks the generated-token slot of a ``beam_search`` step — the analog
+    of the reference's GeneratedInput (trainer_config_helpers/layers.py:3556):
+    at step t the slot carries the token chosen at t-1 (``bos_id`` at t=0).
+    The step net embeds it itself (declare an ``embedding`` layer inside the
+    step), rather than naming an external embedding parameter."""
+
+    size: int          # vocabulary size
+    bos_id: int = 0
+    eos_id: int = 1
 
 
 def recurrent_group(
@@ -173,6 +187,121 @@ def recurrent_group(
         return Act(value=out_seq, lengths=ref.lengths, mask=ref.mask)
 
     return LayerOutput(name, "recurrent_group", out_layer.size, parents, forward, specs)
+
+
+def beam_search(
+    step: Callable[..., Sequence[LayerOutput]],
+    input: Sequence[GeneratedInput | StaticInput],
+    memories: Sequence[Memory],
+    *,
+    beam_size: int = 3,
+    max_length: int = 50,
+    length_penalty: float = 0.0,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Generation-mode recurrent group — the trainer_config_helpers
+    ``beam_search`` analog (reference: layers.py:3693 + GeneratedInput
+    :3556, RecurrentGradientMachine::generateSequence).
+
+    ``step(gen_layer, *static_layers, *memory_layers) -> [vocab_logits,
+    *mem_updates]`` builds the per-token sub-network ONCE at config time:
+    ``gen_layer`` carries the previous token ids [N] (int32), the step must
+    end in an un-normalized vocab-size logits layer.  Forward runs the whole
+    jitted beam search (SequenceGenerator) on device.
+
+    Output Act: ``value`` [B, beam_size, max_length] token ids best-first;
+    ``state['scores']`` [B, beam_size] log-prob scores.
+    """
+    name = name or next_name("beam_search")
+    gens = [i for i in input if isinstance(i, GeneratedInput)]
+    static_inputs = [i.input for i in input if isinstance(i, StaticInput)]
+    if len(gens) != 1:
+        raise ConfigError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    if not memories:
+        raise ConfigError("beam_search needs at least one memory")
+
+    if not static_inputs and all(m.boot is None for m in memories):
+        raise ConfigError(
+            "beam_search needs at least one StaticInput or a booted memory "
+            "to derive the batch size (an unconditioned generator has no "
+            "batch-shaped input)"
+        )
+    gen_layer = data_layer(f"__{name}_gen__", size=gen.size, dtype="int32")
+    static_layers = [
+        data_layer(f"__{name}_static{i}__", size=l.size)
+        for i, l in enumerate(static_inputs)
+    ]
+    mem_layers = [data_layer(f"__{name}_mem_{m.name}__", size=m.size) for m in memories]
+    result = step(gen_layer, *static_layers, *mem_layers)
+    if isinstance(result, LayerOutput):
+        result = [result]
+    out_layer, mem_updates = result[0], list(result[1:])
+    if len(mem_updates) != len(memories):
+        raise ConfigError(
+            f"step returned {len(mem_updates)} memory updates for "
+            f"{len(memories)} memories"
+        )
+    if out_layer.size != gen.size:
+        raise ConfigError(
+            f"beam_search step must end in a vocab-size ({gen.size}) logits "
+            f"layer, got size {out_layer.size}"
+        )
+    sub_topo = Topology([out_layer, *mem_updates])
+    specs = list(sub_topo.param_specs.values())
+    parents = static_inputs + [m.boot for m in memories if m.boot is not None]
+    boot_ix: Dict[int, int] = {}
+    k = len(static_inputs)
+    for mi, m in enumerate(memories):
+        if m.boot is not None:
+            boot_ix[mi] = k
+            k += 1
+
+    def forward(ctx, params, *acts: Act) -> Act:
+        static_acts = acts[: len(static_inputs)]
+        if static_acts:
+            B = static_acts[0].value.shape[0]
+        else:
+            B = acts[boot_ix[min(boot_ix)]].value.shape[0]
+        K = beam_size
+
+        # statics are per-sequence: tile rows per beam ([B,...] -> [B*K,...])
+        tiled_statics = [
+            Act(value=jnp.repeat(a.value, K, axis=0),
+                lengths=(jnp.repeat(a.lengths, K, axis=0)
+                         if a.lengths is not None else None),
+                mask=(jnp.repeat(a.mask, K, axis=0)
+                      if a.mask is not None else None))
+            for a in static_acts
+        ]
+
+        mems0 = {}
+        for mi, m in enumerate(memories):
+            if mi in boot_ix:
+                mems0[m.name] = acts[boot_ix[mi]].value
+            else:
+                mems0[m.name] = jnp.zeros((B, m.size), jnp.float32)
+
+        def step_fn(p, tokens, mems):
+            feed = {gen_layer.name: Act(value=tokens)}
+            for sl, sa in zip(static_layers, tiled_statics):
+                feed[sl.name] = sa
+            for ml, m in zip(mem_layers, memories):
+                feed[ml.name] = Act(value=mems[m.name])
+            outs, _ = sub_topo.apply(p, {}, feed, train=False)
+            logits = outs[out_layer.name].value
+            new_mems = {m.name: outs[u.name].value
+                        for m, u in zip(memories, mem_updates)}
+            return logits, new_mems
+
+        generator = SequenceGenerator(step_fn, vocab_size=gen.size,
+                                      bos_id=gen.bos_id, eos_id=gen.eos_id)
+        tokens, scores = generator.generate(
+            params, mems0, batch_size=B, beam_size=K, max_len=max_length,
+            length_penalty=length_penalty)
+        return Act(value=tokens, state={"scores": scores})
+
+    return LayerOutput(name, "beam_search", gen.size, parents, forward, specs)
 
 
 # ---------------------------------------------------------------------------

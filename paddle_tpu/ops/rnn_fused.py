@@ -27,6 +27,8 @@ activation to route through the plain scan if forward-mode is ever needed.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -60,9 +62,6 @@ def _gru_fwd_scan(xp, mask, w_h, h0):
 
     h_fin, outs = lax.scan(step, h0, (xp_tb, m_tb))
     return jnp.moveaxis(outs, 0, 1), h_fin
-
-
-from functools import partial
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -212,11 +211,11 @@ def _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas):
 
 def _lstm_seq_fwd(xp, mask, w_h, h0, c0, allow_pallas):
     h_seq, h_fin, c_fin = _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas)
-    return (h_seq, h_fin, c_fin), (xp, mask, w_h, h0, c0, h_seq)
+    return (h_seq, h_fin, c_fin), (xp, mask, w_h, h0, c0)
 
 
 def _lstm_seq_bwd(allow_pallas, res, ct):
-    xp, mask, w_h, h0, c0, h_seq = res
+    xp, mask, w_h, h0, c0 = res
     d_hseq, d_hfin, d_cfin = ct
     B, T, H4 = xp.shape
     H = H4 // 4
@@ -227,8 +226,10 @@ def _lstm_seq_bwd(allow_pallas, res, ct):
     m_tb = jnp.moveaxis(mask, 1, 0)
     d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
 
-    # reconstruct held h carry; c must be recomputed (not saved) by a
-    # forward replay that also yields c_prev per step
+    # forward replay: the only sequential recurrent matmul of the backward —
+    # emits h_prev and the pre-activations z so rev_step is matmul-free on
+    # the recompute side (the c carry is not saved by fwd, so a replay is
+    # needed either way)
     def replay(carry, inp):
         h, c = carry
         xp_t, m_t = inp
@@ -242,18 +243,18 @@ def _lstm_seq_bwd(allow_pallas, res, ct):
         keep = (m_t > 0)[:, None]
         h_out = jnp.where(keep, h_new, h)
         c_out = jnp.where(keep, c_new, c)
-        return (h_out, c_out), (h, c)
+        return (h_out, c_out), (h, c, z)
 
-    _, (h_prev, c_prev) = lax.scan(replay, (h0, c0), (xp_tb, m_tb))
+    _, (h_prev, c_prev, z_all) = lax.scan(replay, (h0, c0), (xp_tb, m_tb))
 
     def rev_step(carry, inp):
         d_h, d_c = carry
-        d_out_t, m_t, xp_t, hp_t, cp_t = inp
+        d_out_t, m_t, z_t, cp_t = inp
         mcol = (m_t > 0)[:, None].astype(f32)
         d_hnew = mcol * (d_out_t + d_h)
         d_cnew = mcol * d_c
-        hp, cp = hp_t.astype(f32), cp_t.astype(f32)
-        z = (xp_t + linear(hp_t, w_h)).astype(f32)
+        cp = cp_t.astype(f32)
+        z = z_t.astype(f32)
         i = jax.nn.sigmoid(z[..., :H])
         f = jax.nn.sigmoid(z[..., H: 2 * H])
         o = jax.nn.sigmoid(z[..., 2 * H: 3 * H])
@@ -276,7 +277,7 @@ def _lstm_seq_bwd(allow_pallas, res, ct):
 
     (d_h0, d_c0), d_z_tb = lax.scan(
         rev_step, (d_hfin.astype(f32), d_cfin.astype(f32)),
-        (d_out_tb, m_tb, xp_tb, h_prev, c_prev), reverse=True)
+        (d_out_tb, m_tb, z_all, c_prev), reverse=True)
 
     d_wh = jnp.einsum("tbh,tbz->hz", h_prev.astype(f32), d_z_tb).astype(w_h.dtype)
     d_xp = jnp.moveaxis(d_z_tb, 0, 1).astype(xp.dtype)

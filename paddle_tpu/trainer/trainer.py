@@ -243,6 +243,27 @@ class SGDTrainer:
         self.params = apply_masks(self.params, self.masks)
         self._step = self._build_step()
 
+    def _log_parameter_stats(self) -> None:
+        """Per-parameter mean/|max|/min table — the
+        --show_parameter_stats_period plane (reference:
+        TrainerInternal.cpp:162 showParameterStats, Stat printing of
+        ParameterName.mean/max/min per period).  One jitted reduction per
+        call; only scalars cross the host link."""
+        fn = getattr(self, "_param_stats_fn", None)
+        if fn is None:
+            @jax.jit
+            def fn(params):
+                return {
+                    k: (jnp.mean(v), jnp.max(jnp.abs(v)), jnp.min(v))
+                    for k, v in params.items()
+                }
+            self._param_stats_fn = fn
+        stats = fn(self.params)
+        for k in sorted(stats):
+            mean, amax, mn = (float(x) for x in stats[k])
+            logger.info("param %-28s mean=% .5e absmax=% .5e min=% .5e",
+                        k, mean, amax, mn)
+
     def train_batch(self, feed: Dict[str, Any]) -> float:
         """Run one optimizer step on a prepared feed dict; returns cost."""
         self._rng, key = jax.random.split(self._rng)
@@ -305,6 +326,9 @@ class SGDTrainer:
                             log_period / max(time.time() - t0, 1e-9),
                         )
                         t0 = time.time()
+                    psp = FLAGS.show_parameter_stats_period
+                    if psp and (batch_id + 1) % psp == 0:
+                        self._log_parameter_stats()
                     batch_id += 1
                 result = {}
                 if test_reader is not None:

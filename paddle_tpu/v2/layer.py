@@ -86,6 +86,8 @@ img_conv_transpose = _nn.img_conv_transpose
 mdlstmemory = _nn.mdlstmemory
 recurrent_group = _nn.recurrent_group
 memory = _nn.Memory
+beam_search = _nn.beam_search
+GeneratedInput = _nn.GeneratedInput
 StaticInput = _nn.StaticInput
 
 

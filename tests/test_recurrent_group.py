@@ -211,3 +211,79 @@ class TestSequenceGenerator:
                 k = parent[t, b, k]
             seq = np.asarray(seq[::-1])
             np.testing.assert_array_equal(np.asarray(toks[b, 0]), seq)
+
+
+class TestBeamSearchLayer:
+    """nn.beam_search — the trainer_config_helpers beam_search analog
+    (reference: layers.py:3693, GeneratedInput :3556)."""
+
+    def _build(self, V=15, H=8, E=6):
+        ctx_in = nn.data("ctx", size=H)
+
+        def step(prev_tok, ctx_static, mem):
+            e = nn.embedding(prev_tok, E, name="gen_emb")
+            h = nn.fc(nn.concat([e, ctx_static, mem]), H, act="tanh",
+                      name="gen_h")
+            logits = nn.fc(h, V, act="linear", name="gen_out")
+            return [logits, h]
+
+        out = nn.beam_search(
+            step,
+            input=[nn.GeneratedInput(size=V), nn.StaticInput(ctx_in)],
+            memories=[nn.Memory("m", H, boot=ctx_in)],
+            beam_size=3, max_length=7)
+        return out, ctx_in, V, H
+
+    def test_generates_and_scores(self, rng):
+        nn.reset_naming()
+        out, ctx_in, V, H = self._build()
+        topo = nn.Topology([out])
+        params, state = topo.init(jax.random.PRNGKey(0))
+        ctx = jnp.asarray(np.random.RandomState(0).randn(4, H).astype(np.float32))
+        outs, _ = topo.apply(params, state, {"ctx": ctx}, train=False)
+        act = outs[out.name]
+        assert act.value.shape == (4, 3, 7)
+        scores = act.state["scores"]
+        assert scores.shape == (4, 3)
+        s = np.asarray(scores)
+        assert np.all(np.diff(s, axis=1) <= 1e-5)  # best-first
+
+    def test_matches_manual_generator(self, rng):
+        """The DSL layer must produce exactly what driving SequenceGenerator
+        with the equivalent functional step produces."""
+        nn.reset_naming()
+        out, ctx_in, V, H = self._build()
+        topo = nn.Topology([out])
+        params, state = topo.init(jax.random.PRNGKey(1))
+        ctx = jnp.asarray(np.random.RandomState(1).randn(2, H).astype(np.float32))
+        outs, _ = topo.apply(params, state, {"ctx": ctx}, train=False)
+        toks_dsl = np.asarray(outs[out.name].value)
+
+        # manual: same params, same math
+        K = 3
+        ctx_t = jnp.repeat(ctx, K, axis=0)
+
+        def step_fn(p, tokens, mems):
+            e = jnp.take(p["_gen_emb.w0"], tokens, axis=0)
+            x = jnp.concatenate([e, ctx_t, mems["m"]], -1)
+            h = jnp.tanh(O.linear(x, p["_gen_h.w0"], p["_gen_h.wbias"]))
+            return (O.linear(h, p["_gen_out.w0"], p["_gen_out.wbias"]),
+                    {"m": h})
+
+        gen = nn.SequenceGenerator(step_fn, vocab_size=V)
+        toks_man, _ = gen.generate(params, {"m": ctx}, batch_size=2,
+                                   beam_size=K, max_len=7)
+        np.testing.assert_array_equal(toks_dsl, np.asarray(toks_man))
+
+    def test_unconditioned_generator_raises_config_error(self):
+        from paddle_tpu.utils.error import ConfigError
+        nn.reset_naming()
+
+        def step(prev_tok, mem):
+            e = nn.embedding(prev_tok, 4)
+            h = nn.fc(nn.concat([e, mem]), 6, act="tanh")
+            return [nn.fc(h, 10, act="linear"), h]
+
+        with pytest.raises(ConfigError):
+            nn.beam_search(step, input=[nn.GeneratedInput(size=10)],
+                           memories=[nn.Memory("m", 6)])

@@ -240,3 +240,39 @@ def test_trainer_test_duplicate_evaluators_get_distinct_keys(rng):
     # empty reader: evaluator keys present but nan (never a fake-perfect 0.0)
     res2 = tr.test(lambda: iter([]), evaluators={ClassificationError(): wire})
     assert np.isnan(res2["classification_error"])
+
+
+def test_show_parameter_stats_period(rng):
+    """--show_parameter_stats_period logs a per-parameter stats table
+    (TrainerInternal.cpp showParameterStats analog)."""
+    import logging
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+    from paddle_tpu.utils.flags import FLAGS
+
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=1, dtype="int32")
+    cost = nn.classification_cost(nn.fc(x, 2, act="linear", name="w0"), y)
+    tr = SGDTrainer(cost=cost, optimizer=SGD(learning_rate=0.1), seed=2)
+    feeds = [{"x": np.zeros((4, 4), np.float32), "y": np.zeros((4,), np.int64)}
+             for _ in range(2)]
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    from paddle_tpu.utils.log import logger as ptlog
+    h = Grab(level=logging.INFO)
+    ptlog.addHandler(h)
+    old = FLAGS.show_parameter_stats_period
+    try:
+        FLAGS.show_parameter_stats_period = 2
+        tr.train(lambda: iter(feeds), num_passes=1)
+    finally:
+        FLAGS.show_parameter_stats_period = old
+        ptlog.removeHandler(h)
+    assert any("absmax" in m for m in records)

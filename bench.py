@@ -315,6 +315,67 @@ def bench_smallnet(rtt, peak):
     }
 
 
+def _image_net_step(build, B, H, W, opt):
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    cost, _ = build()
+    rng = np.random.RandomState(0)
+    feeds = {
+        "pixel": jnp.asarray(rng.rand(B, H, W, 3).astype(np.float32)),
+        "label": jnp.asarray(rng.randint(0, 1000, (B, 1))),
+    }
+    return _topology_step(cost, opt, feeds)
+
+
+def bench_alexnet(rtt, peak, batch_size=128):
+    """Published AlexNet rows: 195/334/602/1629 ms/batch at bs=64/128/256/512
+    on 1x K40m (reference: benchmark/README.md:33-38, benchmark/paddle/image/
+    alexnet.py — 227x227, 1000 classes)."""
+    from paddle_tpu.models import alexnet
+    from paddle_tpu.param.optimizers import Momentum
+
+    published = {64: 195.0, 128: 334.0, 256: 602.0, 512: 1629.0}
+    one_step, carry = _image_net_step(
+        lambda: alexnet(num_classes=1000), batch_size, 227, 227,
+        Momentum(learning_rate=0.01))
+    sec, flops = _time_chain(one_step, carry, iters=10, rtt=rtt)
+    ms = sec * 1e3
+    base = published.get(batch_size)
+    return {
+        "metric": f"alexnet_train_ms_per_batch(b{batch_size},227px,1000cls)",
+        "value": round(ms, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(base / ms, 3) if base else None,
+        "mfu": _mfu(sec, flops, peak),  # conv nets: no scans, XLA count exact
+    }
+
+
+def bench_googlenet(rtt, peak, batch_size=128):
+    """Published GoogLeNet rows: 613/1149/2348 ms/batch at bs=64/128/256 on
+    1x K40m (reference: benchmark/README.md:45-50, googlenet.py — v1, no aux
+    heads, 224x224, 1000 classes)."""
+    from paddle_tpu.models import googlenet
+    from paddle_tpu.param.optimizers import Momentum
+
+    published = {64: 613.0, 128: 1149.0, 256: 2348.0}
+    one_step, carry = _image_net_step(
+        lambda: googlenet(num_classes=1000), batch_size, 224, 224,
+        Momentum(learning_rate=0.01))
+    sec, flops = _time_chain(one_step, carry, iters=10, rtt=rtt)
+    ms = sec * 1e3
+    base = published.get(batch_size)
+    return {
+        "metric": f"googlenet_train_ms_per_batch(b{batch_size},224px,1000cls)",
+        "value": round(ms, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(base / ms, 3) if base else None,
+        "mfu": _mfu(sec, flops, peak),
+    }
+
+
 def bench_pallas_lstm_ab(rtt, peak):
     """A/B the fused Pallas LSTM time-loop kernel vs the XLA scan path at
     tile-aligned shapes (B%8==0, H%128==0) — settles FLAGS.use_pallas_rnn."""
@@ -396,6 +457,8 @@ def main() -> None:
         bench_lstm_textclf(rtt, peak),
         bench_resnet_cifar(rtt, peak),
         bench_smallnet(rtt, peak),
+        bench_alexnet(rtt, peak),
+        bench_googlenet(rtt, peak),
         bench_pallas_lstm_ab(rtt, peak),
     ]
     out = dict(headline)

@@ -1,0 +1,91 @@
+"""AlexNet and GoogLeNet-v1 — the reference's published image benchmarks.
+
+Architectures follow the reference benchmark configs
+(reference: benchmark/paddle/image/alexnet.py — 227x227, conv11s4p1 ->
+LRN -> pool3s2 -> conv5p2(256) -> LRN -> pool -> 3x conv3 -> pool ->
+fc4096 x2 (dropout 0.5) -> softmax 1000;
+benchmark/paddle/image/googlenet.py — the standard GoogLeNet v1 stage
+table without the two auxiliary heads, as benchmarked).  Built in this
+framework's DSL: NHWC convs with explicit integer padding, inception
+branches concatenated on the channel axis.  Stride-2 pools use SAME
+(ceil-mode) padding — legacy paddle pooling is ceil-mode
+(reference: paddle/math/MathUtils.cpp outputSize caffeMode=false), which
+is what makes the 112/56/28/14/7 GoogLeNet stage table land on a 7x7 map
+for the final average pool.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["alexnet", "googlenet"]
+
+
+def alexnet(*, num_classes: int = 1000, height: int = 227, width: int = 227):
+    """Returns (cost, logits). Feed: pixel [B, H, W, 3] + label [B, 1]."""
+    img = nn.data("pixel", size=3, height=height, width=width)
+    label = nn.data("label", size=1, dtype="int32")
+
+    net = nn.img_conv(img, filter_size=11, num_filters=96, stride=4, padding=1)
+    net = nn.img_cmrnorm(net, size=5, scale=0.0001, power=0.75)
+    net = nn.img_pool(net, pool_size=3, stride=2)
+
+    net = nn.img_conv(net, filter_size=5, num_filters=256, stride=1, padding=2)
+    net = nn.img_cmrnorm(net, size=5, scale=0.0001, power=0.75)
+    net = nn.img_pool(net, pool_size=3, stride=2)
+
+    net = nn.img_conv(net, filter_size=3, num_filters=384, stride=1, padding=1)
+    net = nn.img_conv(net, filter_size=3, num_filters=384, stride=1, padding=1)
+    net = nn.img_conv(net, filter_size=3, num_filters=256, stride=1, padding=1)
+    net = nn.img_pool(net, pool_size=3, stride=2)
+
+    net = nn.fc(net, 4096, act="relu")
+    net = nn.dropout(net, 0.5)
+    net = nn.fc(net, 4096, act="relu")
+    net = nn.dropout(net, 0.5)
+    logits = nn.fc(net, num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits
+
+
+def _inception(x, f1, f3r, f3, f5r, f5, proj):
+    b1 = nn.img_conv(x, filter_size=1, num_filters=f1, padding=0)
+    b3 = nn.img_conv(nn.img_conv(x, filter_size=1, num_filters=f3r, padding=0),
+                     filter_size=3, num_filters=f3, padding=1)
+    b5 = nn.img_conv(nn.img_conv(x, filter_size=1, num_filters=f5r, padding=0),
+                     filter_size=5, num_filters=f5, padding=2)
+    bp = nn.img_conv(nn.img_pool(x, pool_size=3, stride=1, padding=1),
+                     filter_size=1, num_filters=proj, padding=0)
+    return nn.concat([b1, b3, b5, bp])
+
+
+def googlenet(*, num_classes: int = 1000, height: int = 224, width: int = 224):
+    """GoogLeNet v1 (no aux heads, as the reference benchmarks it).
+    Returns (cost, logits). Feed: pixel [B, H, W, 3] + label [B, 1]."""
+    img = nn.data("pixel", size=3, height=height, width=width)
+    label = nn.data("label", size=1, dtype="int32")
+
+    net = nn.img_conv(img, filter_size=7, num_filters=64, stride=2, padding=3)
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 56
+    net = nn.img_conv(net, filter_size=1, num_filters=64, padding=0)
+    net = nn.img_conv(net, filter_size=3, num_filters=192, padding=1)
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 28
+
+    net = _inception(net, 64, 96, 128, 16, 32, 32)     # 3a -> 256
+    net = _inception(net, 128, 128, 192, 32, 96, 64)   # 3b -> 480
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 14
+
+    net = _inception(net, 192, 96, 208, 16, 48, 64)    # 4a -> 512
+    net = _inception(net, 160, 112, 224, 24, 64, 64)   # 4b
+    net = _inception(net, 128, 128, 256, 24, 64, 64)   # 4c
+    net = _inception(net, 112, 144, 288, 32, 64, 64)   # 4d -> 528
+    net = _inception(net, 256, 160, 320, 32, 128, 128) # 4e -> 832
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 7
+
+    net = _inception(net, 256, 160, 320, 32, 128, 128) # 5a
+    net = _inception(net, 384, 192, 384, 48, 128, 128) # 5b -> 1024
+    net = nn.img_pool(net, pool_size=7, stride=7, pool_type="avg")
+
+    logits = nn.fc(net, num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits

@@ -35,6 +35,7 @@ __all__ = [
     "addto",
     "concat",
     "dropout",
+    "error_clip",
     "mixed",
     "img_conv",
     "img_pool",
@@ -264,7 +265,13 @@ def concat(input: Sequence[LayerOutput], *, name: Optional[str] = None) -> Layer
         ref = acts[0]
         return _seq_like(ref, out) if ref.is_seq else Act(value=out)
 
-    return LayerOutput(name, "concat", size, inputs, forward, [])
+    node = LayerOutput(name, "concat", size, inputs, forward, [])
+    # channel concat of same-size feature maps keeps the spatial dims
+    # (inception-style branches, ConcatenateLayer on conv outputs)
+    hws = {i.meta.get("hw") for i in inputs}
+    if len(hws) == 1 and None not in hws:
+        node.meta["hw"] = hws.pop()
+    return node
 
 
 def dropout(input: LayerOutput, rate: float, *, name: Optional[str] = None) -> LayerOutput:
@@ -276,6 +283,38 @@ def dropout(input: LayerOutput, rate: float, *, name: Optional[str] = None) -> L
         return _seq_like(a, out) if a.is_seq else Act(value=out)
 
     node = LayerOutput(name, "dropout", input.size, [input], forward, [])
+    node.meta.update(input.meta)
+    return node
+
+
+def error_clip(input: LayerOutput, threshold: float,
+               *, name: Optional[str] = None) -> LayerOutput:
+    """Clip the BACKWARD error signal flowing through this point to
+    [-threshold, threshold] — the ExtraLayerAttribute
+    ``error_clipping_threshold`` analog (reference:
+    trainer_config_helpers/attrs.py:183, Layer.cpp backwardActivation
+    error clipping), used by the reference's NMT configs for training
+    stability.  Identity in the forward pass."""
+    name = name or next_name("error_clip")
+    t = float(threshold)
+
+    @jax.custom_vjp
+    def _clip_grad(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, g):
+        return (jnp.clip(g, -t, t),)
+
+    _clip_grad.defvjp(_fwd, _bwd)
+
+    def forward(ctx, params, a: Act) -> Act:
+        out = _clip_grad(a.value)
+        return _seq_like(a, out) if a.is_seq else Act(value=out)
+
+    node = LayerOutput(name, "error_clip", input.size, [input], forward, [])
     node.meta.update(input.meta)
     return node
 
@@ -299,11 +338,13 @@ def _spatial(ipt: LayerOutput):
 
 
 def img_conv(input: LayerOutput, *, filter_size: int, num_filters: int,
-             stride: int = 1, padding: str = "SAME", groups: int = 1,
+             stride: int = 1, padding: Union[str, int] = "SAME", groups: int = 1,
              act: str = "relu", name: Optional[str] = None,
              param_attr: AttrLike = None, bias_attr: AttrLike = True) -> LayerOutput:
     """2-D convolution — analog of img_conv_layer (layers.py:2126,
-    ExpandConvLayer/CudnnConvLayer). NHWC + HWIO, MXU-friendly."""
+    ExpandConvLayer/CudnnConvLayer). NHWC + HWIO, MXU-friendly.
+    ``padding`` may be 'SAME', 'VALID', or an int (explicit symmetric pixel
+    padding — the reference's padding= argument)."""
     name = name or next_name("conv")
     h, w = _spatial(input)
     cin = input.size
@@ -316,15 +357,27 @@ def img_conv(input: LayerOutput, *, filter_size: int, num_filters: int,
     if ba:
         specs.append(ParamSpec(name=ba.name, shape=(num_filters,), attr=ba))
     act_fn = O.get_activation(act)
-    if padding == "SAME":
+    if isinstance(padding, int):
+        oh = (h + 2 * padding - filter_size) // stride + 1
+        ow = (w + 2 * padding - filter_size) // stride + 1
+        pad_arg = [(padding, padding), (padding, padding)]
+    elif padding == "SAME":
         oh, ow = -(-h // stride), -(-w // stride)
+        pad_arg = padding
     else:
         oh = (h - filter_size) // stride + 1
         ow = (w - filter_size) // stride + 1
+        pad_arg = padding
+
+    if oh <= 0 or ow <= 0:
+        raise ConfigError(
+            f"conv {name!r}: output spatial dims ({oh}, {ow}) are not "
+            f"positive — filter {filter_size}/stride {stride}/padding "
+            f"{padding!r} does not fit the {h}x{w} input")
 
     def forward(ctx, params, a: Act) -> Act:
         y = O.conv2d(a.value, params[wspec.name], stride=(stride, stride),
-                     padding=padding, groups=groups)
+                     padding=pad_arg, groups=groups)
         if ba:
             y = y + params[ba.name].astype(y.dtype)
         return Act(value=act_fn(y))
@@ -342,15 +395,26 @@ def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None
     name = name or next_name("pool")
     stride = stride or pool_size
     h, w = _spatial(input)
-    if padding == "SAME":
+    if isinstance(padding, int):
+        oh = (h + 2 * padding - pool_size) // stride + 1
+        ow = (w + 2 * padding - pool_size) // stride + 1
+        pad_arg = ((0, 0), (padding, padding), (padding, padding), (0, 0))
+    elif padding == "SAME":
         oh, ow = -(-h // stride), -(-w // stride)
+        pad_arg = padding
     else:
         oh = (h - pool_size) // stride + 1
         ow = (w - pool_size) // stride + 1
+        pad_arg = padding
+    if oh <= 0 or ow <= 0:
+        raise ConfigError(
+            f"pool {name!r}: output spatial dims ({oh}, {ow}) are not "
+            f"positive — window {pool_size}/stride {stride}/padding "
+            f"{padding!r} does not fit the {h}x{w} input")
     op = O.max_pool2d if pool_type == "max" else O.avg_pool2d
 
     def forward(ctx, params, a: Act) -> Act:
-        return Act(value=op(a.value, (pool_size, pool_size), (stride, stride), padding))
+        return Act(value=op(a.value, (pool_size, pool_size), (stride, stride), pad_arg))
 
     out = LayerOutput(name, "pool", input.size, [input], forward, [])
     out.meta["hw"] = (oh, ow)

@@ -182,3 +182,17 @@ def test_selective_outputs(rng):
     # logits only — label feed not required
     outs, _ = topo.apply(params, state, feed, outputs=["logits"])
     assert outs["logits"].value.shape == (2, 4)
+
+
+def test_conv_pool_nonpositive_output_raises():
+    """A window that does not fit the input must fail at config time, not
+    silently produce a (B, 0) tensor (bias-only network)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.utils.error import ConfigError
+
+    nn.reset_naming()
+    img = nn.data("img", size=3, height=6, width=6)
+    with pytest.raises(ConfigError):
+        nn.img_pool(img, pool_size=7, stride=7)
+    with pytest.raises(ConfigError):
+        nn.img_conv(img, filter_size=8, num_filters=4, padding="VALID")

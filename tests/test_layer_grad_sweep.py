@@ -91,6 +91,14 @@ def case_dropout(rng):
     return nn.dropout(_pre_fc(x), 0.5), feed  # eval mode: identity
 
 
+def case_error_clip(rng):
+    # FD uses a large threshold so clipping is inactive and the FD check
+    # remains exact; the clipped-backward behavior itself is pinned in
+    # test_layers_extra2.test_error_clip_identity_forward_clipped_backward
+    x, feed = _dense(rng)
+    return nn.error_clip(_pre_fc(x), 1e6), feed
+
+
 def case_mixed(rng):
     x, feed = _dense(rng)
     return nn.mixed([nn.full_matrix_projection(x, size=4),
@@ -535,6 +543,7 @@ EXCLUDED = {
     "device_pin",      # sharding annotation wrapper (test_sparse_hooks)
     "mixed",           # projection container (test_graph covers projections)
     "classification_cost",  # included below via CASES
+    "beam_search",     # emits int token ids — no gradient path by design
 }
 
 

@@ -243,3 +243,34 @@ def test_selective_fc_multi_input(rng):
              + np.asarray(params["_sfc.wbias"]))
     assert np.all(v[sv == 0] == 0)
     np.testing.assert_allclose(v[sv == 1], dense[sv == 1], rtol=1e-4, atol=1e-5)
+
+
+def test_error_clip_identity_forward_clipped_backward():
+    """error_clip: identity forward; backward error clipped to threshold
+    (ExtraLayerAttribute.error_clipping_threshold analog)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    x = nn.data("x", size=3)
+    clipped = nn.error_clip(x, 0.1)
+    out = nn.fc(clipped, 1, act="linear", name="head",
+                param_attr=nn.ParamAttr(initial_std=0.0))
+    topo = nn.Topology([out])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    params = {k: jnp.ones_like(v) * 5.0 for k, v in params.items()}
+
+    xv = jnp.asarray(np.ones((2, 3), np.float32))
+    outs, _ = topo.apply(params, state, {"x": xv}, train=False)
+    np.testing.assert_allclose(np.asarray(outs[out.name].value),
+                               np.asarray((xv @ (5.0 * np.ones((3, 1)))) + 5.0))
+
+    # grad wrt input flows through fc (weight 5.0) then gets clipped to 0.1
+    def loss(xv):
+        outs, _ = topo.apply(params, state, {"x": xv}, train=False)
+        return jnp.sum(outs[out.name].value)
+
+    g = jax.grad(loss)(xv)
+    np.testing.assert_allclose(np.asarray(g), 0.1 * np.ones((2, 3)), rtol=1e-6)

@@ -176,3 +176,50 @@ class TestSeq2Seq:
         _, s4 = m.beam_search(params, batch["src_ids"], batch["src_len"],
                               beam_size=4, max_len=8)
         assert np.all(np.asarray(s4[:, 0]) >= np.asarray(s1[:, 0]) - 1e-4)
+
+
+class TestImageBenchNets:
+    """AlexNet / GoogLeNet v1 — the reference's published image benchmark
+    configs (benchmark/paddle/image/{alexnet,googlenet}.py)."""
+
+    def test_alexnet_shapes_and_train_step(self, rng):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.models import alexnet
+        from paddle_tpu.param.optimizers import Momentum
+        from paddle_tpu.trainer import SGDTrainer
+
+        nn.reset_naming()
+        cost, logits = alexnet(num_classes=10, height=67, width=67)  # small img
+        tr = SGDTrainer(cost=cost, optimizer=Momentum(learning_rate=0.01),
+                        seed=1)
+        feed = {"pixel": np.random.RandomState(0).rand(2, 67, 67, 3).astype(np.float32),
+                "label": np.zeros((2, 1), np.int64)}
+        c0 = float(tr.train_batch(feed))
+        c1 = float(tr.train_batch(feed))
+        assert np.isfinite(c0) and np.isfinite(c1)
+
+    def test_googlenet_inception_channels(self, rng):
+        import jax
+
+        import paddle_tpu.nn as nn
+        from paddle_tpu.models import googlenet
+
+        nn.reset_naming()
+        cost, logits = googlenet(num_classes=10, height=224, width=224)
+        # the stage table must land on a 7x7 map before the final avg pool,
+        # then 1x1 (a degenerate 0x0 map silently made logits = bias once)
+        pre_fc = logits.parents[0]
+        assert pre_fc.meta.get("hw") == (1, 1), pre_fc.meta
+        topo = nn.Topology([cost, logits])
+        params, state = topo.init(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        feed = {"pixel": rs.rand(2, 224, 224, 3).astype(np.float32),
+                "label": np.zeros((2, 1), np.int64)}
+        outs, _ = topo.apply(params, state, feed, train=False)
+        lg = np.asarray(outs[logits.name].value)
+        assert lg.shape == (2, 10)
+        assert np.isfinite(float(outs[cost.name].value))
+        # logits must actually depend on the pixels
+        feed2 = dict(feed, pixel=rs.rand(2, 224, 224, 3).astype(np.float32))
+        outs2, _ = topo.apply(params, state, feed2, train=False)
+        assert np.abs(lg - np.asarray(outs2[logits.name].value)).max() > 1e-6

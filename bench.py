@@ -330,22 +330,16 @@ def _image_net_step(build, B, H, W, opt):
     return _topology_step(cost, opt, feeds)
 
 
-def bench_alexnet(rtt, peak, batch_size=128):
-    """Published AlexNet rows: 195/334/602/1629 ms/batch at bs=64/128/256/512
-    on 1x K40m (reference: benchmark/README.md:33-38, benchmark/paddle/image/
-    alexnet.py — 227x227, 1000 classes)."""
-    from paddle_tpu.models import alexnet
+def _bench_image_net(rtt, peak, *, build, batch_size, hw, label, published):
     from paddle_tpu.param.optimizers import Momentum
 
-    published = {64: 195.0, 128: 334.0, 256: 602.0, 512: 1629.0}
-    one_step, carry = _image_net_step(
-        lambda: alexnet(num_classes=1000), batch_size, 227, 227,
-        Momentum(learning_rate=0.01))
+    one_step, carry = _image_net_step(build, batch_size, hw, hw,
+                                      Momentum(learning_rate=0.01))
     sec, flops = _time_chain(one_step, carry, iters=10, rtt=rtt)
     ms = sec * 1e3
     base = published.get(batch_size)
     return {
-        "metric": f"alexnet_train_ms_per_batch(b{batch_size},227px,1000cls)",
+        "metric": f"{label}_train_ms_per_batch(b{batch_size},{hw}px,1000cls)",
         "value": round(ms, 3),
         "unit": "ms/batch",
         "vs_baseline": round(base / ms, 3) if base else None,
@@ -353,27 +347,28 @@ def bench_alexnet(rtt, peak, batch_size=128):
     }
 
 
+def bench_alexnet(rtt, peak, batch_size=128):
+    """Published AlexNet rows: 195/334/602/1629 ms/batch at bs=64/128/256/512
+    on 1x K40m (reference: benchmark/README.md:33-38, benchmark/paddle/image/
+    alexnet.py — 227x227, 1000 classes)."""
+    from paddle_tpu.models import alexnet
+
+    return _bench_image_net(
+        rtt, peak, build=lambda: alexnet(num_classes=1000),
+        batch_size=batch_size, hw=227, label="alexnet",
+        published={64: 195.0, 128: 334.0, 256: 602.0, 512: 1629.0})
+
+
 def bench_googlenet(rtt, peak, batch_size=128):
     """Published GoogLeNet rows: 613/1149/2348 ms/batch at bs=64/128/256 on
     1x K40m (reference: benchmark/README.md:45-50, googlenet.py — v1, no aux
     heads, 224x224, 1000 classes)."""
     from paddle_tpu.models import googlenet
-    from paddle_tpu.param.optimizers import Momentum
 
-    published = {64: 613.0, 128: 1149.0, 256: 2348.0}
-    one_step, carry = _image_net_step(
-        lambda: googlenet(num_classes=1000), batch_size, 224, 224,
-        Momentum(learning_rate=0.01))
-    sec, flops = _time_chain(one_step, carry, iters=10, rtt=rtt)
-    ms = sec * 1e3
-    base = published.get(batch_size)
-    return {
-        "metric": f"googlenet_train_ms_per_batch(b{batch_size},224px,1000cls)",
-        "value": round(ms, 3),
-        "unit": "ms/batch",
-        "vs_baseline": round(base / ms, 3) if base else None,
-        "mfu": _mfu(sec, flops, peak),
-    }
+    return _bench_image_net(
+        rtt, peak, build=lambda: googlenet(num_classes=1000),
+        batch_size=batch_size, hw=224, label="googlenet",
+        published={64: 613.0, 128: 1149.0, 256: 2348.0})
 
 
 def bench_pallas_lstm_ab(rtt, peak):

@@ -388,10 +388,11 @@ def img_conv(input: LayerOutput, *, filter_size: int, num_filters: int,
 
 
 def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None,
-             pool_type: str = "max", padding: str = "VALID",
+             pool_type: str = "max", padding: Union[str, int] = "VALID",
              name: Optional[str] = None) -> LayerOutput:
     """Spatial pooling — analog of img_pool_layer (PoolLayer.cpp,
-    hl_maxpool/avgpool kernels)."""
+    hl_maxpool/avgpool kernels).  ``padding`` may be 'SAME'/'VALID' or an
+    int (explicit symmetric pixel padding, as in the reference)."""
     name = name or next_name("pool")
     stride = stride or pool_size
     h, w = _spatial(input)

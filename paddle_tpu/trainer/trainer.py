@@ -329,6 +329,15 @@ class SGDTrainer:
                     psp = FLAGS.show_parameter_stats_period
                     if psp and (batch_id + 1) % psp == 0:
                         self._log_parameter_stats()
+                    tp = FLAGS.test_period
+                    if (tp and test_reader is not None
+                            and (batch_id + 1) % tp == 0):
+                        # mid-pass eval — test_period batches (Trainer.cpp
+                        # trainOneBatch "testing" branch; 0 = per pass only)
+                        with timer("TestTimer"):
+                            mid = self.test(test_reader, feeder=feeder)
+                        logger.info("Pass %d, Batch %d, Test cost %.5f",
+                                    pass_id, batch_id + 1, mid["cost"])
                     batch_id += 1
                 result = {}
                 if test_reader is not None:

@@ -276,3 +276,37 @@ def test_show_parameter_stats_period(rng):
         FLAGS.show_parameter_stats_period = old
         ptlog.removeHandler(h)
     assert any("absmax" in m for m in records)
+
+
+def test_test_period_mid_pass_eval(rng):
+    """--test_period runs a mid-pass eval every N batches (Trainer.cpp
+    trainOneBatch testing branch)."""
+    import logging
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+    from paddle_tpu.utils.flags import FLAGS
+    from paddle_tpu.utils.log import logger as ptlog
+
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=1, dtype="int32")
+    tr = SGDTrainer(cost=nn.classification_cost(nn.fc(x, 2, act="linear"), y),
+                    optimizer=SGD(learning_rate=0.1), seed=3)
+    feeds = [{"x": np.zeros((2, 4), np.float32), "y": np.zeros((2,), np.int64)}
+             for _ in range(4)]
+    msgs = []
+    h = logging.Handler()
+    h.emit = lambda r: msgs.append(r.getMessage())
+    ptlog.addHandler(h)
+    old = FLAGS.test_period
+    try:
+        FLAGS.test_period = 2
+        tr.train(lambda: iter(feeds), num_passes=1,
+                 test_reader=lambda: iter(feeds[:1]))
+    finally:
+        FLAGS.test_period = old
+        ptlog.removeHandler(h)
+    mid = [m for m in msgs if "Test cost" in m]
+    assert len(mid) == 2  # batches 2 and 4

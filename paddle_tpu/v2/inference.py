@@ -17,18 +17,47 @@ __all__ = ["infer"]
 
 def infer(output_layer, parameters: Parameters, input: Sequence,
           feeding: Optional[Dict[str, int]] = None,
-          field: str = "value") -> np.ndarray:
-    """``paddle.infer(output_layer=out, parameters=params, input=rows)``."""
+          field="value"):
+    """``paddle.infer(output_layer=out, parameters=params, input=rows)``.
+
+    ``field`` selects what to pull from each output layer — the reference's
+    generation contract (python/paddle/v2/inference.py:117 field=['prob',
+    'id'] for beam_search outputs): ``"value"``/``"id"`` → the layer value
+    (token ids for a beam_search layer), ``"prob"``/``"score"`` → the
+    auxiliary scores from the layer's state (beam log-probs).  Pass a list
+    of field names to get a list back, e.g. ``field=['prob', 'id']``."""
     outputs = ([output_layer] if isinstance(output_layer, LayerOutput)
                else list(output_layer))
     topo = Topology(outputs)
     feeder = _auto_feeder(topo, feeding)
     feed = feeder(list(input))
+    fields_l = field if isinstance(field, (list, tuple)) else [field]
+    # only ship auxiliary state out of the jit when a score field is asked
+    # for — value-only inference lets XLA drop unused aux tensors
+    need_state = any(f in ("prob", "score") for f in fields_l)
 
     def run(params, state, feed):
         outs, _ = topo.apply(params, state, feed, train=False)
-        return [outs[o.name].value for o in outputs]
+        return [(outs[o.name].value,
+                 (outs[o.name].state or {}) if need_state else {})
+                for o in outputs]
 
-    vals = jax.jit(run)(parameters.params, parameters.state, feed)
-    res = [np.asarray(v) for v in vals]
+    pairs = jax.jit(run)(parameters.params, parameters.state, feed)
+
+    def pick(value, state, f):
+        if f in ("value", "id"):
+            return np.asarray(value)
+        if f in ("prob", "score"):
+            for k in ("scores", "prob", "score"):
+                if k in state:
+                    return np.asarray(state[k])
+            raise KeyError(
+                f"output layer has no auxiliary {f!r} field; state keys: "
+                f"{sorted(state)}")
+        raise KeyError(f"unknown field {f!r}; use value/id/prob/score")
+
+    res = []
+    for f in fields_l:
+        got = [pick(v, s, f) for v, s in pairs]
+        res.append(got[0] if len(got) == 1 else got)
     return res[0] if len(res) == 1 else res

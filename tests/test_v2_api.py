@@ -129,3 +129,35 @@ def test_v2_parameters_from_tar_unknown_name_raises():
         paddle.layer.classification_cost(input=out, label=lbl), seed=2)
     with pytest.raises(ValueError, match="unknown parameter"):
         p2.from_tar(buf)
+
+
+def test_v2_infer_generation_fields():
+    """paddle.infer(field=['prob','id']) over a beam_search layer — the v2
+    generation contract (reference python/paddle/v2/inference.py:117)."""
+    import paddle_tpu.nn as nn
+
+    V, H, E = 12, 6, 5
+    ctx_in = paddle.layer.data(name="ctx", type=paddle.data_type.dense_vector(H))
+
+    def step(prev_tok, ctx, mem):
+        e = nn.embedding(prev_tok, E)
+        h = nn.fc(nn.concat([e, ctx, mem]), H, act="tanh")
+        return [nn.fc(h, V, act="linear"), h]
+
+    gen = paddle.layer.beam_search(
+        step,
+        input=[paddle.layer.GeneratedInput(size=V),
+               paddle.layer.StaticInput(ctx_in)],
+        memories=[paddle.layer.memory("m", H, boot=ctx_in)],
+        beam_size=3, max_length=5)
+    params = paddle.parameters.create(gen)
+    rows = [(np.random.RandomState(i).randn(H).astype(np.float32),)
+            for i in range(2)]
+    ids = paddle.infer(output_layer=gen, parameters=params, input=rows,
+                       field="id")
+    prob, ids2 = paddle.infer(output_layer=gen, parameters=params,
+                              input=rows, field=["prob", "id"])
+    assert ids.shape == (2, 3, 5) and ids.dtype == np.int32
+    np.testing.assert_array_equal(ids, ids2)
+    assert prob.shape == (2, 3)
+    assert np.all(np.diff(prob, axis=1) <= 1e-5)  # best-first

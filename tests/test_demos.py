@@ -13,6 +13,8 @@ CASES = [
     ("mnist", ["--passes", "1", "--n", "128", "--batch-size", "32"]),
     ("image_classification",
      ["--passes", "1", "--n", "64", "--batch-size", "16", "--depth", "8"]),
+    ("image_classification",
+     ["--passes", "1", "--n", "32", "--batch-size", "8", "--model", "alexnet"]),
     ("quick_start", ["--passes", "1", "--n", "64", "--config", "lr"]),
     ("quick_start", ["--passes", "1", "--n", "64", "--config", "cnn"]),
     ("sentiment", ["--passes", "1", "--n", "64", "--vocab", "200",

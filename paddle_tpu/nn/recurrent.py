@@ -136,7 +136,10 @@ def recurrent_group(
         def base_feed(mems):
             feed = {}
             for sl, sa in zip(static_layers, static_acts):
-                feed[sl.name] = Act(value=sa.value)
+                # the whole Act passes through: a static input may be an
+                # encoded sequence the step attends over (simple_attention),
+                # so its lengths/mask/state must survive
+                feed[sl.name] = sa
             for ml, mv in zip(mem_layers, mems):
                 feed[ml.name] = Act(value=mv)
             return feed

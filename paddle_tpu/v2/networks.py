@@ -1,0 +1,141 @@
+"""``paddle.v2.networks`` facade — the prebuilt network helpers the
+reference's demos import (reference:
+python/paddle/trainer_config_helpers/networks.py re-exported as
+paddle.v2.networks: simple_img_conv_pool :71, img_conv_group :140,
+simple_lstm :478, bidirectional_lstm :639, simple_gru :560,
+sequence_conv_pool :295, simple_attention :1288).
+
+Each helper composes this framework's layer DSL exactly like the reference
+composes its wrappers; parameter shapes and dataflow match the reference's
+definitions, the internals are the TPU-native layers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as _nn
+import paddle_tpu.ops as O
+from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_name
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "simple_lstm",
+    "simple_gru",
+    "bidirectional_lstm",
+    "bidirectional_gru",
+    "sequence_conv_pool",
+    "simple_attention",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size, *,
+                         pool_stride=None, padding="SAME", act="relu",
+                         pool_type="max", name=None):
+    """conv + pool block (networks.py:71) — the mnist/LeNet building block."""
+    conv = _nn.img_conv(input, filter_size=filter_size,
+                        num_filters=num_filters, padding=padding, act=act,
+                        name=name and f"{name}_conv")
+    return _nn.img_pool(conv, pool_size=pool_size, stride=pool_stride,
+                        pool_type=pool_type, name=name and f"{name}_pool")
+
+
+def img_conv_group(input, conv_num_filter: Sequence[int], *,
+                   conv_filter_size=3, conv_act="relu", conv_padding="SAME",
+                   pool_size=2, pool_stride=2, pool_type="max",
+                   conv_batchnorm=False, name=None):
+    """N stacked convs then one pool (networks.py:140) — the VGG block."""
+    h = input
+    for i, nf in enumerate(conv_num_filter):
+        h = _nn.img_conv(h, filter_size=conv_filter_size, num_filters=nf,
+                         padding=conv_padding,
+                         act="linear" if conv_batchnorm else conv_act,
+                         name=name and f"{name}_conv{i}")
+        if conv_batchnorm:
+            h = _nn.batch_norm(h, act=conv_act,
+                               name=name and f"{name}_bn{i}")
+    return _nn.img_pool(h, pool_size=pool_size, stride=pool_stride,
+                        pool_type=pool_type, name=name and f"{name}_pool")
+
+
+def simple_lstm(input, size, *, act="tanh", gate_act="sigmoid", name=None):
+    """mixed/fc projection + lstmemory (networks.py:478).  This framework's
+    lstmemory owns its input projection, so the helper adds the reference's
+    extra linear mixing stage in front — same dataflow, fused matmuls."""
+    proj = _nn.fc(input, size, act="linear",
+                  name=name and f"{name}_proj", bias_attr=False)
+    return _nn.lstmemory(proj, size, act=act, gate_act=gate_act, name=name)
+
+
+def simple_gru(input, size, *, act="tanh", gate_act="sigmoid", name=None):
+    """fc projection + grumemory (networks.py:560); see simple_lstm."""
+    proj = _nn.fc(input, size, act="linear",
+                  name=name and f"{name}_proj", bias_attr=False)
+    return _nn.grumemory(proj, size, act=act, gate_act=gate_act, name=name)
+
+
+def bidirectional_lstm(input, size, *, return_unmerged=False, name=None):
+    """Forward + backward LSTM, concatenated (networks.py:639)."""
+    fwd = _nn.lstmemory(input, size, name=name and f"{name}_fw")
+    bwd = _nn.lstmemory(input, size, reverse=True,
+                        name=name and f"{name}_bw")
+    if return_unmerged:
+        return fwd, bwd
+    return _nn.concat([fwd, bwd], name=name)
+
+
+def bidirectional_gru(input, size, *, return_unmerged=False, name=None):
+    fwd = _nn.grumemory(input, size, name=name and f"{name}_fw")
+    bwd = _nn.grumemory(input, size, reverse=True,
+                        name=name and f"{name}_bw")
+    if return_unmerged:
+        return fwd, bwd
+    return _nn.concat([fwd, bwd], name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, *,
+                       context_start=None, pool_type="max", act="tanh",
+                       name=None):
+    """context window projection + fc + sequence pool (networks.py:295) —
+    the text-CNN building block."""
+    ctx = _nn.context_projection(input, context_len=context_len,
+                                 context_start=context_start,
+                                 name=name and f"{name}_ctx")
+    h = _nn.fc(ctx, hidden_size, act=act, name=name and f"{name}_fc")
+    return _nn.pooling(h, pooling_type=pool_type,
+                       name=name and f"{name}_pool")
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state, *,
+                     name: Optional[str] = None):
+    """Bahdanau additive attention (networks.py:1288) — for use inside a
+    ``recurrent_group``/``beam_search`` step: ``encoded_sequence`` [B,S,D]
+    and ``encoded_proj`` [B,S,A] arrive as StaticInputs (sequence metadata
+    preserved), ``decoder_state`` is the [B,H] memory.  Returns the
+    [B, D] context vector.  Owns the attention parameters
+    (decoder-state projection + the scoring vector v)."""
+    name = name or next_name("attention")
+    H = decoder_state.size
+    A = encoded_proj.size
+    w_spec = ParamSpec(name=f"_{name}.w0", shape=(H, A),
+                       attr=ParamAttr(name=f"_{name}.w0"))
+    v_spec = ParamSpec(name=f"_{name}.v", shape=(A,),
+                       attr=ParamAttr(name=f"_{name}.v", initial_std=0.05))
+
+    def forward(ctx, params, enc_a: Act, proj_a: Act, state_a: Act) -> Act:
+        enc, proj, st = enc_a.value, proj_a.value, state_a.value
+        scores = O.additive_attention_scores(proj, st, params[w_spec.name],
+                                             params[v_spec.name])
+        if enc_a.mask is not None:
+            mask = enc_a.mask
+        else:
+            mask = jnp.ones(enc.shape[:2], jnp.float32)
+        context, weights = O.attend(scores, enc, mask)
+        return Act(value=context, state={"weights": weights})
+
+    return LayerOutput(name, "simple_attention", encoded_sequence.size,
+                       [encoded_sequence, encoded_proj, decoder_state],
+                       forward, [w_spec, v_spec])

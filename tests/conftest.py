@@ -9,11 +9,17 @@ import os
 
 os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
 
-# force_virtual_devices both sets the env vars and overrides the jax_platforms
-# config value locked in by the container sitecustomize's early jax import.
-from paddle_tpu.utils.devices import force_virtual_devices
+# PADDLE_TPU_TEST_BACKEND=tpu runs tests against the real chip — meant for
+# the op/kernel files (test_ops, test_rnn_fused, test_attention_decoder,
+# test_crf_ctc): numeric tolerances widen and FD checks skip via
+# on_accelerator(); mesh/device-count-dependent tests still assume the
+# 8-virtual-device CPU mesh and are skipped on hardware.
+if os.environ.get("PADDLE_TPU_TEST_BACKEND") != "tpu":
+    # force_virtual_devices both sets the env vars and overrides the
+    # jax_platforms config locked in by sitecustomize's early jax import.
+    from paddle_tpu.utils.devices import force_virtual_devices
 
-force_virtual_devices(8)
+    force_virtual_devices(8)
 
 import numpy as np
 import pytest
@@ -22,3 +28,12 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+def on_accelerator() -> bool:
+    """True when tests run on real TPU hardware (PADDLE_TPU_TEST_BACKEND=tpu):
+    matmul precision is bf16-passes, FD checks are meaningless, and the
+    8-virtual-device mesh assumptions do not hold."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")

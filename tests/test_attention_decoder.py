@@ -15,6 +15,16 @@ ORDER = ["y_emb", "s0", "enc", "enc_proj", "src_mask", "trg_mask",
          "att_w", "att_v", "wx", "b", "wh"]
 
 
+def _tols():
+    """On TPU, f32 dots default to bf16-passes precision, so AD-vs-manual
+    gradient agreement is ~1e-3 instead of the CPU's 1e-5."""
+    from conftest import on_accelerator
+
+    if on_accelerator():
+        return dict(rtol=2e-2, atol=3e-3)
+    return dict(rtol=2e-4, atol=2e-5)
+
+
 def reference(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
               att_w, att_v, wx, b, wh):
     def step(s, y_t):
@@ -78,7 +88,7 @@ def test_all_gradients_match_autodiff(seed):
                      argnums=tuple(range(len(dv))))(*dv)
     for i, (a, b) in enumerate(zip(g_ref, g_new)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5,
+                                   **_tols(),
                                    err_msg=f"grad {ORDER[diff_idx[i]]}")
 
 

@@ -29,7 +29,24 @@ def fd_grad(f, x, eps=1e-4):
     return g
 
 
+def np_tol(cpu_rtol=1e-5, cpu_atol=1e-5):
+    """Numpy-compare tolerance by backend: TPU f32 matmuls run at
+    bf16-passes precision (~1e-2 relative on O(1) dots)."""
+    from conftest import on_accelerator
+
+    if on_accelerator():
+        return dict(rtol=2e-2, atol=2e-2)
+    return dict(rtol=cpu_rtol, atol=cpu_atol)
+
+
 def check_grad(f, x, rtol=2e-2, atol=1e-3):
+    from conftest import on_accelerator
+
+    if on_accelerator():
+        # finite differences at TPU matmul precision are rounding noise —
+        # FD checks are a CPU-reference concern (same split as the
+        # reference: FD on the CPU side of its CPU-vs-GPU compares)
+        pytest.skip("FD gradient checks run on the CPU backend only")
     jg = np.asarray(jax.grad(lambda a: f(a))(jnp.asarray(x, jnp.float32)))
     ng = fd_grad(lambda a: f(jnp.asarray(a, jnp.float32)), x)
     np.testing.assert_allclose(jg, ng, rtol=rtol, atol=atol)
@@ -41,13 +58,13 @@ class TestDense:
         w = rng.randn(7, 5).astype(np.float32)
         b = rng.randn(5).astype(np.float32)
         out = ops.linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
-        np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out), x @ w + b, **np_tol())
 
     def test_matmul_transpose_flags(self, rng):
         a = rng.randn(3, 4).astype(np.float32)
         b = rng.randn(5, 4).astype(np.float32)
         out = ops.matmul(jnp.asarray(a), jnp.asarray(b), transpose_b=True)
-        np.testing.assert_allclose(np.asarray(out), a @ b.T, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out), a @ b.T, **np_tol())
 
     def test_cross_entropy_matches_numpy(self, rng):
         logits = rng.randn(6, 9).astype(np.float32)
@@ -55,7 +72,8 @@ class TestDense:
         out = np.asarray(ops.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
         p = np.exp(logits - logits.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
-        np.testing.assert_allclose(out, -np.log(p[np.arange(6), labels]), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out, -np.log(p[np.arange(6), labels]),
+                                   **np_tol())
 
     def test_cross_entropy_grad(self, rng):
         logits = rng.randn(3, 5).astype(np.float32)
@@ -165,7 +183,7 @@ class TestConv:
         for i in range(3):
             for j in range(3):
                 ref[0, i, j, 0] = np.sum(x[0, i : i + 2, j : j + 2, 0] * w[:, :, 0, 0])
-        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out, ref, **np_tol(cpu_rtol=1e-4))
 
     def test_pooling(self, rng):
         x = jnp.asarray(rng.randn(2, 4, 4, 3).astype(np.float32))
@@ -232,12 +250,16 @@ class TestRNN:
 
         h = np.zeros((B, H), np.float32)
         c = np.zeros((B, H), np.float32)
+        from conftest import on_accelerator
+
+        tol = (dict(rtol=5e-2, atol=1e-3) if on_accelerator()
+               else dict(rtol=1e-4, atol=1e-5))
         for t in range(T):
             z = x[:, t] @ w_x + b + h @ w_h
             i, f, o, g = np.split(z, 4, -1)
             c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
             h = sigmoid(o) * np.tanh(c)
-            np.testing.assert_allclose(np.asarray(h_seq[:, t]), h, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(h_seq[:, t]), h, **tol)
 
     def test_gru_padding_invariance(self, rng):
         B, T, D, H = 3, 5, 4, 6
@@ -288,8 +310,13 @@ class TestAttention:
         k = jnp.ones((1, 1, 3, 4))
         v = jnp.asarray(rng.randn(1, 1, 3, 4).astype(np.float32))
         out = ops.dot_product_attention(q, k, v)
+        from conftest import on_accelerator
+
+        # TPU f32 softmax/dot runs at bf16-passes precision: wider tolerance
+        tol = 4e-3 if on_accelerator() else 1e-3
         np.testing.assert_allclose(
-            np.asarray(out)[0, 0, 0], np.asarray(v)[0, 0].mean(0), rtol=1e-3, atol=1e-3
+            np.asarray(out)[0, 0, 0], np.asarray(v)[0, 0].mean(0),
+            rtol=tol, atol=tol
         )
 
 

@@ -1,0 +1,131 @@
+"""paddle.v2.networks helpers — the trainer_config_helpers/networks.py
+prebuilt-block facade (simple_img_conv_pool, img_conv_group, simple_lstm,
+bidirectional_lstm, sequence_conv_pool, simple_attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2 import networks
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_simple_img_conv_pool_mnist_block(rng):
+    img = nn.data("pixel", size=1, height=12, width=12)
+    lab = nn.data("label", size=1, dtype="int32")
+    h = networks.simple_img_conv_pool(img, filter_size=3, num_filters=4,
+                                      pool_size=2)
+    cost = nn.classification_cost(nn.fc(h, 3, act="linear"), lab)
+    topo = nn.Topology([cost])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {"pixel": rng.rand(2, 12, 12, 1).astype(np.float32),
+            "label": np.zeros((2, 1), np.int64)}
+    outs, _ = topo.apply(params, state, feed, train=False)
+    assert np.isfinite(float(outs[cost.name].value))
+
+
+def test_img_conv_group_vgg_block(rng):
+    img = nn.data("pixel", size=3, height=8, width=8)
+    h = networks.img_conv_group(img, [4, 4], conv_batchnorm=True)
+    assert h.meta["hw"] == (4, 4)
+    topo = nn.Topology([h])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    outs, _ = topo.apply(params, state,
+                         {"pixel": rng.rand(2, 8, 8, 3).astype(np.float32)},
+                         train=True, rng=jax.random.PRNGKey(1))
+    assert outs[h.name].value.shape == (2, 4, 4, 4)
+
+
+def test_simple_lstm_and_gru_train(rng):
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    xs = nn.data("xs", size=6, is_seq=True)
+    lab = nn.data("label", size=1, dtype="int32")
+    h1 = networks.simple_lstm(xs, 8)
+    h2 = networks.simple_gru(xs, 8)
+    pooled = nn.pooling(nn.concat([h1, h2]), pooling_type="max")
+    cost = nn.classification_cost(nn.fc(pooled, 2, act="linear"), lab)
+    tr = SGDTrainer(cost=cost, optimizer=SGD(learning_rate=0.1), seed=0)
+    lens = rng.randint(2, 6, 4).astype(np.int32)
+    feed = {"xs": (rng.randn(4, 5, 6).astype(np.float32), lens),
+            "label": rng.randint(0, 2, 4)}
+    c0 = float(tr.train_batch(feed))
+    for _ in range(10):
+        c = float(tr.train_batch(feed))
+    assert np.isfinite(c) and c < c0
+
+
+def test_bidirectional_lstm_matches_manual_concat(rng):
+    xs = nn.data("xs", size=5, is_seq=True)
+    merged = networks.bidirectional_lstm(xs, 4, name="bd")
+    fw, bw = networks.bidirectional_lstm(xs, 4, name="bd2",
+                                         return_unmerged=True)
+    topo = nn.Topology([merged, fw, bw])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    # tie bd2's params to bd's so outputs must match
+    for k in list(params):
+        if "bd2" in k:
+            params[k] = params[k.replace("bd2", "bd")]
+    lens = np.asarray([5, 3], np.int32)
+    feed = {"xs": (rng.randn(2, 5, 5).astype(np.float32), lens)}
+    outs, _ = topo.apply(params, state, feed, train=False)
+    man = jnp.concatenate([outs[fw.name].value, outs[bw.name].value], -1)
+    np.testing.assert_allclose(np.asarray(outs[merged.name].value),
+                               np.asarray(man), rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_conv_pool(rng):
+    xs = nn.data("xs", size=6, is_seq=True)
+    out = networks.sequence_conv_pool(xs, context_len=3, hidden_size=7)
+    topo = nn.Topology([out])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    lens = np.asarray([5, 2], np.int32)
+    outs, _ = topo.apply(params, state,
+                         {"xs": (rng.randn(2, 5, 6).astype(np.float32), lens)})
+    assert outs[out.name].value.shape == (2, 7)
+
+
+def test_simple_attention_in_recurrent_group(rng):
+    """simple_attention inside a recurrent_group step attends over a
+    StaticInput encoded sequence with its real mask."""
+    B, S, T, D, H = 2, 4, 3, 6, 5
+    enc_seq = nn.data("enc", size=D, is_seq=True)
+    proj = nn.fc(enc_seq, D, act="linear", name="encproj")
+    frames = nn.data("frames", size=3, is_seq=True)
+
+    def step(frame, enc_static, proj_static, mem):
+        ctx = networks.simple_attention(enc_static, proj_static, mem)
+        h = nn.fc(nn.concat([frame, ctx]), H, act="tanh", name="steph")
+        return [h, h]
+
+    out = nn.recurrent_group(
+        step,
+        input=[frames, nn.StaticInput(enc_seq), nn.StaticInput(proj)],
+        memories=[nn.Memory("m", H)])
+    topo = nn.Topology([out])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = {
+        "enc": (rng.randn(B, S, D).astype(np.float32),
+                np.asarray([4, 2], np.int32)),
+        "frames": (rng.randn(B, T, 3).astype(np.float32),
+                   np.asarray([3, 2], np.int32)),
+    }
+    outs, _ = topo.apply(params, state, feed, train=False)
+    v = outs[out.name].value
+    assert v.shape == (B, T, H)
+    assert np.isfinite(np.asarray(v)).all()
+    # grads flow into the attention parameters
+    def loss(p):
+        o, _ = topo.apply(p, state, feed, train=False)
+        return jnp.sum(o[out.name].value ** 2)
+    g = jax.grad(loss)(params)
+    att = [k for k in g if "attention" in k]
+    assert att and all(np.abs(np.asarray(g[k])).max() > 0 for k in att)

@@ -28,7 +28,7 @@ reference user's training script ports with minimal edits:
 
 from paddle_tpu.utils.devices import init  # noqa: F401
 from paddle_tpu.v2 import activation, attr, data_type, pooling  # noqa: F401
-from paddle_tpu.v2 import dataset, event, layer, networks, optimizer  # noqa: F401
+from paddle_tpu.v2 import dataset, event, evaluator, layer, networks, optimizer  # noqa: F401
 from paddle_tpu.v2 import parameters, trainer  # noqa: F401
 from paddle_tpu.v2.inference import infer  # noqa: F401
 from paddle_tpu.data.reader import batch  # noqa: F401

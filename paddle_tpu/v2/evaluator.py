@@ -1,0 +1,101 @@
+"""``paddle.v2.evaluator`` facade — declare metrics against topology layers
+(reference: python/paddle/v2/evaluator.py auto-generates one function per
+registered evaluator: classification_error(input=, label=), auc(...), ...).
+
+Here each factory returns an ``(evaluator, wire)`` pair: the evaluator is
+the metric state machine from ``paddle_tpu.evaluators`` and ``wire`` maps a
+batch's layer outputs + feed to the evaluator's ``batch_stats`` kwargs —
+exactly the shape ``SGDTrainer.test(evaluators={ev: wire})`` consumes, so
+
+    ev, wire = paddle.evaluator.classification_error(input=logits, label=lab)
+    result = trainer.test(reader, evaluators={ev: wire})
+
+mirrors the reference's declare-then-read-per-pass flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu import evaluators as _E
+from paddle_tpu.nn.graph import LayerOutput
+
+__all__ = ["classification_error", "auc", "precision_recall", "rankauc",
+           "sum", "column_sum", "chunk", "ctc_error"]
+
+
+def _grab(layer: LayerOutput):
+    name = layer.name
+
+    def get(outs, feed):
+        if name in outs:
+            return outs[name]
+        v = feed[name]
+        if isinstance(v, tuple):  # sequence feeds are (values, lengths, ...)
+            return v[0]
+        return v
+
+    return get
+
+
+def classification_error(*, input: LayerOutput, label: LayerOutput):
+    gi, gl = _grab(input), _grab(label)
+    ev = _E.ClassificationError()
+    return ev, lambda outs, feed: {"logits": gi(outs, feed),
+                                   "labels": gl(outs, feed)}
+
+
+def auc(*, input: LayerOutput, label: LayerOutput, num_bins: int = 4096):
+    gi, gl = _grab(input), _grab(label)
+    ev = _E.Auc(num_bins=num_bins)
+    return ev, lambda outs, feed: {"prob": gi(outs, feed),
+                                   "labels": gl(outs, feed)}
+
+
+def precision_recall(*, input: LayerOutput, label: LayerOutput,
+                     num_classes: int = 2,
+                     positive_label: Optional[int] = None):
+    gi, gl = _grab(input), _grab(label)
+    ev = _E.PrecisionRecall(num_classes=num_classes,
+                            positive_label=positive_label)
+    return ev, lambda outs, feed: {"logits": gi(outs, feed),
+                                   "labels": gl(outs, feed)}
+
+
+def rankauc(*, input: LayerOutput, label: LayerOutput):
+    gi, gl = _grab(input), _grab(label)
+    ev = _E.RankAuc()
+    return ev, lambda outs, feed: {"score": gi(outs, feed),
+                                   "labels": gl(outs, feed)}
+
+
+def sum(*, input: LayerOutput):  # noqa: A001 - reference uses this name
+    gi = _grab(input)
+    ev = _E.SumEvaluator()
+    return ev, lambda outs, feed: {"value": gi(outs, feed)}
+
+
+def column_sum(*, input: LayerOutput):
+    gi = _grab(input)
+    ev = _E.ColumnSumEvaluator()
+    return ev, lambda outs, feed: {"value": gi(outs, feed)}
+
+
+def chunk(*, input: LayerOutput, label: LayerOutput, lengths: LayerOutput):
+    gi, gl, gn = _grab(input), _grab(label), _grab(lengths)
+    ev = _E.ChunkEvaluator()
+    return ev, lambda outs, feed: {"pred_tags": gi(outs, feed),
+                                   "label_tags": gl(outs, feed),
+                                   "lengths": gn(outs, feed)}
+
+
+def ctc_error(*, input: LayerOutput, label: LayerOutput,
+              in_lengths: LayerOutput, label_lengths: LayerOutput,
+              blank: int = 0):
+    gi, gl = _grab(input), _grab(label)
+    gil, gll = _grab(in_lengths), _grab(label_lengths)
+    ev = _E.CTCErrorEvaluator(blank=blank)
+    return ev, lambda outs, feed: {"log_probs": gi(outs, feed),
+                                   "labels": gl(outs, feed),
+                                   "in_lengths": gil(outs, feed),
+                                   "label_lengths": gll(outs, feed)}

@@ -33,21 +33,28 @@ __all__ = [
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size, *,
-                         pool_stride=None, padding="SAME", act="relu",
-                         pool_type="max", name=None):
-    """conv + pool block (networks.py:71) — the mnist/LeNet building block."""
+                         conv_stride=1, conv_padding=0, pool_stride=1,
+                         pool_padding=0, act="relu", pool_type="max",
+                         name=None):
+    """conv + pool block (networks.py:145) — the mnist/LeNet building block.
+    Defaults mirror the reference: VALID conv (conv_padding=0) and
+    stride-1 pooling."""
     conv = _nn.img_conv(input, filter_size=filter_size,
-                        num_filters=num_filters, padding=padding, act=act,
+                        num_filters=num_filters, stride=conv_stride,
+                        padding=conv_padding, act=act,
                         name=name and f"{name}_conv")
     return _nn.img_pool(conv, pool_size=pool_size, stride=pool_stride,
-                        pool_type=pool_type, name=name and f"{name}_pool")
+                        padding=pool_padding, pool_type=pool_type,
+                        name=name and f"{name}_pool")
 
 
 def img_conv_group(input, conv_num_filter: Sequence[int], *,
-                   conv_filter_size=3, conv_act="relu", conv_padding="SAME",
-                   pool_size=2, pool_stride=2, pool_type="max",
+                   conv_filter_size=3, conv_act="relu", conv_padding=1,
+                   pool_size=2, pool_stride=1, pool_type="max",
                    conv_batchnorm=False, name=None):
-    """N stacked convs then one pool (networks.py:140) — the VGG block."""
+    """N stacked convs then one pool (networks.py:330) — the VGG block.
+    Defaults mirror the reference: 3x3 convs with padding 1, stride-1
+    pooling."""
     h = input
     for i, nf in enumerate(conv_num_filter):
         h = _nn.img_conv(h, filter_size=conv_filter_size, num_filters=nf,
@@ -62,19 +69,17 @@ def img_conv_group(input, conv_num_filter: Sequence[int], *,
 
 
 def simple_lstm(input, size, *, act="tanh", gate_act="sigmoid", name=None):
-    """mixed/fc projection + lstmemory (networks.py:478).  This framework's
-    lstmemory owns its input projection, so the helper adds the reference's
-    extra linear mixing stage in front — same dataflow, fused matmuls."""
-    proj = _nn.fc(input, size, act="linear",
-                  name=name and f"{name}_proj", bias_attr=False)
-    return _nn.lstmemory(proj, size, act=act, gate_act=gate_act, name=name)
+    """D->4H input mixing + recurrent LSTM (networks.py:478).  This
+    framework's lstmemory OWNS the D->4H input projection the reference
+    delegates to a mixed layer, so the faithful port is lstmemory alone —
+    same dataflow and parameter shapes (wx [D,4H] + wh [H,4H]), no extra
+    bottleneck stage."""
+    return _nn.lstmemory(input, size, act=act, gate_act=gate_act, name=name)
 
 
 def simple_gru(input, size, *, act="tanh", gate_act="sigmoid", name=None):
-    """fc projection + grumemory (networks.py:560); see simple_lstm."""
-    proj = _nn.fc(input, size, act="linear",
-                  name=name and f"{name}_proj", bias_attr=False)
-    return _nn.grumemory(proj, size, act=act, gate_act=gate_act, name=name)
+    """D->3H mixing + recurrent GRU (networks.py:560); see simple_lstm."""
+    return _nn.grumemory(input, size, act=act, gate_act=gate_act, name=name)
 
 
 def bidirectional_lstm(input, size, *, return_unmerged=False, name=None):

@@ -31,9 +31,10 @@ def rng():
 
 
 def on_accelerator() -> bool:
-    """True when tests run on real TPU hardware (PADDLE_TPU_TEST_BACKEND=tpu):
-    matmul precision is bf16-passes, FD checks are meaningless, and the
-    8-virtual-device mesh assumptions do not hold."""
-    import jax
-
-    return jax.default_backend() in ("tpu", "axon")
+    """True when the suite was launched in hardware mode
+    (PADDLE_TPU_TEST_BACKEND=tpu): matmul precision is bf16-passes, FD
+    checks are meaningless, and the 8-virtual-device mesh assumptions do
+    not hold.  Keyed on the SAME env var as the conftest platform branch so
+    the two can never disagree (a tpu-mode run that fell back to CPU still
+    skips mesh tests and widens tolerances — harmless both ways)."""
+    return os.environ.get("PADDLE_TPU_TEST_BACKEND") == "tpu"

@@ -5,16 +5,9 @@ bidirectional_lstm, sequence_conv_pool, simple_attention)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
-
 import paddle_tpu.nn as nn
 import paddle_tpu.v2 as paddle
 from paddle_tpu.v2 import networks
-
-
-@pytest.fixture
-def rng():
-    return np.random.RandomState(0)
 
 
 def test_simple_img_conv_pool_mnist_block(rng):
@@ -33,14 +26,19 @@ def test_simple_img_conv_pool_mnist_block(rng):
 
 def test_img_conv_group_vgg_block(rng):
     img = nn.data("pixel", size=3, height=8, width=8)
+    # reference defaults: 3x3 convs pad 1 (spatial-preserving), pool2 s1 -> 7
     h = networks.img_conv_group(img, [4, 4], conv_batchnorm=True)
-    assert h.meta["hw"] == (4, 4)
-    topo = nn.Topology([h])
+    assert h.meta["hw"] == (7, 7)
+    # VGG-style downsampling block: pool stride 2 -> 4
+    h2 = networks.img_conv_group(img, [4], pool_stride=2, name="g2")
+    assert h2.meta["hw"] == (4, 4)
+    topo = nn.Topology([h, h2])
     params, state = topo.init(jax.random.PRNGKey(0))
     outs, _ = topo.apply(params, state,
                          {"pixel": rng.rand(2, 8, 8, 3).astype(np.float32)},
                          train=True, rng=jax.random.PRNGKey(1))
-    assert outs[h.name].value.shape == (2, 4, 4, 4)
+    assert outs[h.name].value.shape == (2, 7, 7, 4)
+    assert outs[h2.name].value.shape == (2, 4, 4, 4)
 
 
 def test_simple_lstm_and_gru_train(rng):
@@ -129,3 +127,27 @@ def test_simple_attention_in_recurrent_group(rng):
     g = jax.grad(loss)(params)
     att = [k for k in g if "attention" in k]
     assert att and all(np.abs(np.asarray(g[k])).max() > 0 for k in att)
+
+
+def test_v2_evaluator_facade(rng):
+    """paddle.evaluator.* declare-then-test flow over topology layers
+    (reference python/paddle/v2/evaluator.py)."""
+    from paddle_tpu.param.optimizers import SGD
+    from paddle_tpu.trainer import SGDTrainer
+
+    x = nn.data("x", size=6)
+    y = nn.data("y", size=1, dtype="int32")
+    logits = nn.fc(x, 3, act="linear", name="lg")
+    cost = nn.classification_cost(logits, y)
+    tr = SGDTrainer(cost=cost, optimizer=SGD(learning_rate=0.1), seed=2)
+
+    ev, wire = paddle.evaluator.classification_error(input=logits, label=y)
+    feeds = [{"x": rng.randn(8, 6).astype(np.float32),
+              "y": rng.randint(0, 3, (8,))} for _ in range(3)]
+    res = tr.test(lambda: iter(feeds), evaluators={ev: wire})
+    assert "classification_error" in res
+    assert 0.0 <= res["classification_error"] <= 1.0
+
+    ev2, wire2 = paddle.evaluator.sum(input=logits)
+    res2 = tr.test(lambda: iter(feeds), evaluators={ev2: wire2})
+    assert np.isfinite(res2["sum"])

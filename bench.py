@@ -222,16 +222,20 @@ def bench_seq2seq(rtt, peak):
     }
 
 
-def bench_lstm_textclf(rtt, peak):
-    """Published RNN benchmark row: 2-layer LSTM text-clf, b64 h256 T100
-    vocab 30k — 83 ms/batch on 1x K40m."""
+def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256):
+    """Published RNN benchmark rows: 2-layer LSTM text-clf, T100 vocab 30k
+    on 1x K40m — 83 ms (b64 h256), 184 (b64 h512), 641 (b64 h1280),
+    110 (b128 h256), 170 (b256 h256) (reference: benchmark/README.md:112-135,
+    benchmark/paddle/rnn/rnn.py)."""
     import jax.numpy as jnp
 
     import paddle_tpu.nn as nn
     from paddle_tpu.models import lstm_benchmark_net
     from paddle_tpu.param.optimizers import Adam
 
-    VOCAB, B, T, HID, EMB, L = 30000, 64, 100, 256, 128, 2
+    published = {(64, 256): 83.0, (64, 512): 184.0, (64, 1280): 641.0,
+                 (128, 256): 110.0, (256, 256): 170.0}
+    VOCAB, B, T, HID, EMB, L = 30000, batch_size, 100, hidden, 128, 2
     nn.reset_naming()
     cost, _ = lstm_benchmark_net(VOCAB, emb_dim=EMB, hid_dim=HID, num_layers=L)
     rng = np.random.RandomState(0)
@@ -248,11 +252,12 @@ def bench_lstm_textclf(rtt, peak):
     fwd = (B * T * EMB * 4 * HID * 2 + B * T * HID * 4 * HID * 2     # layer 1
            + (L - 1) * (B * T * HID * 4 * HID * 2 * 2)               # deeper
            + B * HID * 2 * 2)
+    base = published.get((B, HID))
     return {
-        "metric": "lstm_textclf_train_ms_per_batch(b64,h256,T100,vocab30k)",
+        "metric": f"lstm_textclf_train_ms_per_batch(b{B},h{HID},T100,vocab30k)",
         "value": round(ms, 3),
         "unit": "ms/batch",
-        "vs_baseline": round(83.0 / ms, 3),
+        "vs_baseline": round(base / ms, 3) if base else None,
         "mfu": _mfu(sec, 3.0 * fwd, peak),
     }
 
@@ -450,6 +455,8 @@ def main() -> None:
     headline = bench_seq2seq(rtt, peak)
     extra = [
         bench_lstm_textclf(rtt, peak),
+        bench_lstm_textclf(rtt, peak, batch_size=64, hidden=512),
+        bench_lstm_textclf(rtt, peak, batch_size=256, hidden=256),
         bench_resnet_cifar(rtt, peak),
         bench_smallnet(rtt, peak),
         bench_alexnet(rtt, peak),

@@ -52,8 +52,21 @@ _PA_DEFAULTS = ParamAttr()
 
 
 def _encode(v: Any, where: str) -> Any:
+    from paddle_tpu.nn.projections import Projection
+
     if isinstance(v, LayerOutput):
         return {"__ref__": v.name}
+    if isinstance(v, Projection):
+        # a mixed-layer input: serialize the recorded factory call so replay
+        # rebuilds the identical projection (origins become __ref__ entries)
+        if not v.config:
+            raise SerializationError(
+                f"layer {where!r}: projection {v.kind!r} carries no recorded "
+                f"factory call and cannot be serialized")
+        return {"__projection__": {
+            "fn": v.config["fn"],
+            "kwargs": {k: _encode(x, where) for k, x in v.config["kwargs"].items()},
+        }}
     if isinstance(v, ParamAttr):
         d = {
             f.name: getattr(v, f.name)
@@ -92,6 +105,14 @@ def _decode(v: Any, env: Dict[str, LayerOutput]) -> Any:
                 raise ConfigError(f"config references unknown layer {v['__ref__']!r}")
         if "__param_attr__" in v:
             return ParamAttr(**v["__param_attr__"])
+        if "__projection__" in v:
+            import paddle_tpu.nn as nn
+
+            pj = v["__projection__"]
+            fn = getattr(nn, pj["fn"], None)
+            if fn is None or not callable(fn):
+                raise ConfigError(f"unknown projection factory {pj['fn']!r}")
+            return fn(**{k: _decode(x, env) for k, x in pj["kwargs"].items()})
         if "__tuple__" in v:
             return tuple(_decode(x, env) for x in v["__tuple__"])
         if "__array__" in v:

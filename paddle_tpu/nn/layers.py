@@ -36,7 +36,6 @@ __all__ = [
     "concat",
     "dropout",
     "error_clip",
-    "mixed",
     "img_conv",
     "img_pool",
     "batch_norm",
@@ -317,13 +316,6 @@ def error_clip(input: LayerOutput, threshold: float,
     node = LayerOutput(name, "error_clip", input.size, [input], forward, [])
     node.meta.update(input.meta)
     return node
-
-
-def mixed(input: Sequence[LayerOutput], size: int, **kw) -> LayerOutput:
-    """Mixed layer: sum of projections — in this framework ``fc`` with
-    multiple inputs already implements full_matrix projections summed
-    (reference: MixedLayer.cpp + Projection.h); provided as an alias."""
-    return fc(input, size, **kw)
 
 
 # ---------------------------------------------------------------------------

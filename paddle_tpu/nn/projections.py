@@ -1,0 +1,587 @@
+"""Mixed-layer projection/operator system.
+
+Analog of the reference's MixedLayer tier: a mixed layer sums the outputs of
+*projections* (one input, optionally with their own weight) and *operators*
+(several inputs, no weight), then applies bias + activation (reference:
+paddle/gserver/layers/MixedLayer.cpp:22-108, Projection.h:26-100,
+Operator.h:34-78; python wrappers trainer_config_helpers/layers.py:556-874,
+conv ops :3864-4050).
+
+TPU-first: each projection is a closure contributing one term to a fused sum
+— XLA fuses the whole mixed layer (all matmuls feeding one add-tree + bias +
+activation) into a handful of MXU ops, where the reference dispatched one
+virtual Projection::forward per input with intermediate buffers.  Projections
+defer parameter creation until the owning ``mixed`` finalizes, so parameter
+names follow the reference's ``_<layer>.w<i>`` convention.
+
+Usage (both reference styles work)::
+
+    m = mixed(size=256, input=[full_matrix_projection(a), identity_projection(b)])
+
+    with mixed(size=256) as m:
+        m += full_matrix_projection(input=a)
+        m += dotmul_operator(a=x, b=y, scale=0.5)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops as O
+from paddle_tpu.nn.graph import (
+    Act,
+    LayerOutput,
+    ParamAttr,
+    ParamSpec,
+    next_name,
+)
+from paddle_tpu.nn.layers import AttrLike, _bias_attr, _flat_in_size, _pa, _seq_like
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = [
+    "Projection",
+    "Operator",
+    "mixed",
+    "full_matrix_projection",
+    "trans_full_matrix_projection",
+    "table_projection",
+    "identity_projection",
+    "dotmul_projection",
+    "scaling_projection",
+    "context_projection_input",
+    "conv_projection",
+    "dotmul_operator",
+    "conv_operator",
+]
+
+
+@dataclass
+class Projection:
+    """One summand of a mixed layer.  ``finalize`` is called by the owning
+    mixed layer with (mixed_name, input_index, mixed_size) and must return
+    (out_size, param_specs, forward) where forward(ctx, params, *acts) ->
+    contribution array."""
+
+    kind: str
+    origins: List[LayerOutput]
+    finalize: Callable[[str, int, int], tuple]
+    #: size hint: 0 = inherit the mixed layer's size (full_matrix/table/...)
+    size: int = 0
+    #: (oh, ow) for image-shaped contributions (conv projection/operator)
+    hw: Optional[tuple] = None
+    #: recorded factory call {fn, kwargs} for config serialization
+    config: Optional[dict] = None
+
+
+class Operator(Projection):
+    """Marker subclass — operators take several inputs and own no weight
+    (reference Operator.h:34: 'Operator like Projection, but takes more than
+    one Arguments')."""
+
+
+def _recorded(fn):
+    """Record the factory call on the returned Projection so mixed layers
+    serialize through the config tier (config/config_parser.py encodes a
+    Projection as its replayable factory call)."""
+    import functools
+    import inspect
+
+    sig = inspect.signature(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        proj = fn(*args, **kwargs)
+        try:
+            bound = sig.bind(*args, **kwargs)
+            raw = dict(bound.arguments)
+            for p in sig.parameters.values():
+                if p.kind is inspect.Parameter.VAR_KEYWORD and p.name in raw:
+                    raw.update(raw.pop(p.name))
+        except TypeError:
+            raw = dict(kwargs)
+        proj.config = {"fn": fn.__name__, "kwargs": raw}
+        return proj
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# projections
+# ---------------------------------------------------------------------------
+
+
+@_recorded
+def full_matrix_projection(input: LayerOutput, size: int = 0,
+                           param_attr: AttrLike = None) -> Projection:
+    """out += x @ W, W: [in_size, size] (reference FullMatrixProjection,
+    layers.py:345-380)."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        out = size or mixed_size
+        if out <= 0:
+            raise ConfigError("full_matrix_projection needs size= (own or mixed)")
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}")
+        spec = ParamSpec(name=pa.name, shape=(_flat_in_size(input), out), attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            v = a.value
+            if not a.is_seq and v.ndim > 2:
+                v = v.reshape(v.shape[0], -1)
+            return O.linear(v, params[spec.name])
+
+        return out, [spec], fwd
+
+    return Projection("full_matrix", [input], finalize, size)
+
+
+@_recorded
+def trans_full_matrix_projection(input: LayerOutput, size: int = 0,
+                                 param_attr: AttrLike = None) -> Projection:
+    """out += x @ W^T, W: [size, in_size] (reference
+    TransposedFullMatrixProjection, layers.py:384-416)."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        out = size or mixed_size
+        if out <= 0:
+            raise ConfigError("trans_full_matrix_projection needs size=")
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}")
+        spec = ParamSpec(name=pa.name, shape=(out, _flat_in_size(input)), attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            v = a.value
+            if not a.is_seq and v.ndim > 2:
+                v = v.reshape(v.shape[0], -1)
+            return O.matmul(v, params[spec.name], transpose_b=True)
+
+        return out, [spec], fwd
+
+    return Projection("trans_full_matrix", [input], finalize, size)
+
+
+@_recorded
+def table_projection(input: LayerOutput, size: int = 0,
+                     param_attr: AttrLike = None) -> Projection:
+    """out += table.row[ids[i]] — embedding as a projection (reference
+    TableProjection, layers.py:419-462; hl_table_apply).  ``input`` must be
+    an integer id layer; its ``size`` is the vocabulary."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        out = size or mixed_size
+        if out <= 0:
+            raise ConfigError("table_projection needs size=")
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}", initial_std=0.01, init="normal")
+        spec = ParamSpec(name=pa.name, shape=(input.size, out), attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            ids = a.value
+            if not a.is_seq and ids.ndim == 2 and ids.shape[1] == 1:
+                ids = ids[:, 0]
+            return O.embedding_lookup(params[spec.name], ids)
+
+        return out, [spec], fwd
+
+    return Projection("table", [input], finalize, size)
+
+
+@_recorded
+def identity_projection(input: LayerOutput, offset: Optional[int] = None,
+                        size: int = 0) -> Projection:
+    """out += x (or x[:, offset:offset+size] when offset given) — reference
+    IdentityProjection / IdentityOffsetProjection (layers.py:465-508)."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        if offset is None:
+            out = input.size
+
+            def fwd(ctx, params, a: Act):
+                return a.value
+
+        else:
+            out = size or mixed_size
+            if out <= 0:
+                raise ConfigError("identity_projection with offset needs size=")
+            if offset + out > input.size:
+                raise ConfigError(
+                    f"identity_projection slice [{offset}, {offset + out}) "
+                    f"exceeds input size {input.size}")
+
+            def fwd(ctx, params, a: Act):
+                return a.value[..., offset : offset + out]
+
+        return out, [], fwd
+
+    hw = input.meta.get("hw") if offset is None else None
+    return Projection("identity", [input], finalize,
+                      size if offset is not None else input.size, hw=hw)
+
+
+@_recorded
+def dotmul_projection(input: LayerOutput, param_attr: AttrLike = None) -> Projection:
+    """out += x .* w, elementwise weight w: [size] (reference DotMulProjection,
+    layers.py:511-537)."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}", init="ones")
+        spec = ParamSpec(name=pa.name, shape=(input.size,), attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            return a.value * params[spec.name].astype(a.value.dtype)
+
+        return input.size, [spec], fwd
+
+    return Projection("dotmul", [input], finalize, input.size)
+
+
+@_recorded
+def scaling_projection(input: LayerOutput, param_attr: AttrLike = None) -> Projection:
+    """out += w * x with a single scalar weight (reference ScalingProjection,
+    layers.py:541-562)."""
+
+    def finalize(mixed_name, idx, mixed_size):
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}", init="ones")
+        spec = ParamSpec(name=pa.name, shape=(1,), attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            return a.value * params[spec.name][0].astype(a.value.dtype)
+
+        return input.size, [spec], fwd
+
+    return Projection("scaling", [input], finalize, input.size)
+
+
+@_recorded
+def context_projection_input(input: LayerOutput, context_len: int,
+                             context_start: Optional[int] = None,
+                             padding_attr: AttrLike = False) -> Projection:
+    """Sliding-window context as a mixed-layer input (reference
+    context_projection, layers.py:608-652; ContextProjection.cpp).  With
+    ``padding_attr`` a ParamAttr, boundary padding rows are trainable.
+
+    (Named ``*_input`` because the repo also exposes the standalone
+    ``context_projection`` *layer*; paddle_tpu.v2 aliases this one to
+    ``paddle.layer.context_projection`` inside mixed.)"""
+    start = -(context_len - 1) // 2 if context_start is None else context_start
+    trainable = isinstance(padding_attr, ParamAttr)
+
+    def finalize(mixed_name, idx, mixed_size):
+        out = input.size * context_len
+        if not input.size:
+            raise ConfigError("context projection needs a sized sequence input")
+        specs = []
+        if trainable:
+            begin_pad = max(0, -start)
+            end_pad = max(0, start + context_len - 1)
+            pa = _pa(padding_attr, f"_{mixed_name}.w{idx}", init="zeros")
+            spec = ParamSpec(name=pa.name, shape=(begin_pad + end_pad, input.size),
+                             attr=pa)
+            specs.append(spec)
+
+            def fwd(ctx, params, a: Act):
+                if not a.is_seq:
+                    raise ConfigError("context projection input must be a sequence")
+                return O.context_projection_trainable(
+                    a.value, a.lengths, a.mask, context_len, start,
+                    params[spec.name])
+
+        else:
+
+            def fwd(ctx, params, a: Act):
+                if not a.is_seq:
+                    raise ConfigError("context projection input must be a sequence")
+                return O.context_projection(a.value, a.mask, context_len, start)
+
+        return out, specs, fwd
+
+    return Projection("context", [input], finalize, input.size * context_len)
+
+
+@_recorded
+def conv_projection(input: LayerOutput, filter_size: int, num_filters: int,
+                    num_channels: Optional[int] = None, stride: int = 1,
+                    padding: int = 0, groups: int = 1,
+                    param_attr: AttrLike = None, trans: bool = False) -> Projection:
+    """Convolution as a mixed/concat input with its own HWIO weight
+    (reference conv_projection, layers.py:3950-4050; ConvProjection.cpp).
+    NHWC on the MXU; contribution shape [B, oh, ow, num_filters] so several
+    conv projections sum like inception branches."""
+    if "hw" not in input.meta:
+        raise ConfigError("conv_projection input needs spatial meta (hw)")
+    if trans and groups != 1:
+        raise ConfigError("conv_projection: groups>1 with trans=True is not "
+                          "supported; use groups=1")
+    h, w = input.meta["hw"]
+    cin = num_channels or input.size
+    if trans:
+        oh = (h - 1) * stride + filter_size - 2 * padding
+        ow = (w - 1) * stride + filter_size - 2 * padding
+    else:
+        oh = (h + 2 * padding - filter_size) // stride + 1
+        ow = (w + 2 * padding - filter_size) // stride + 1
+    if oh <= 0 or ow <= 0:
+        raise ConfigError(f"conv_projection output dims ({oh}, {ow}) not positive")
+
+    def finalize(mixed_name, idx, mixed_size):
+        pa = _pa(param_attr, f"_{mixed_name}.w{idx}")
+        shape = ((filter_size, filter_size, cin, num_filters) if trans
+                 else (filter_size, filter_size, cin // groups, num_filters))
+        spec = ParamSpec(name=pa.name, shape=shape, attr=pa)
+
+        def fwd(ctx, params, a: Act):
+            wgt = params[spec.name]
+            if trans:
+                # transposed conv == conv with lhs dilation and flipped pad
+                return O.conv2d(
+                    a.value if stride == 1 else _dilate(a.value, stride),
+                    jnp.flip(wgt, (0, 1)).swapaxes(2, 3),
+                    stride=(1, 1),
+                    padding=[(filter_size - 1 - padding,) * 2] * 2,
+                )
+            return O.conv2d(a.value, wgt, stride=(stride, stride),
+                            padding=[(padding, padding)] * 2, groups=groups)
+
+        return num_filters, [spec], fwd
+
+    return Projection("conv_trans" if trans else "conv", [input], finalize,
+                      num_filters, hw=(oh, ow))
+
+
+def _dilate(x, stride):
+    """Insert stride-1 zeros between spatial elements (lhs dilation for
+    transposed conv), done via lax pad so XLA folds it into the conv."""
+    return jax.lax.pad(
+        x, jnp.zeros((), x.dtype),
+        [(0, 0, 0), (0, 0, stride - 1), (0, 0, stride - 1), (0, 0, 0)])
+
+
+# ---------------------------------------------------------------------------
+# operators
+# ---------------------------------------------------------------------------
+
+
+@_recorded
+def dotmul_operator(a: LayerOutput = None, b: LayerOutput = None,
+                    scale: float = 1.0, **kwargs) -> Operator:
+    """out += scale * (a .* b) (reference DotMulOperator, layers.py:568-605)."""
+    a = kwargs.get("x", a)
+    b = kwargs.get("y", b)
+    if a.size and b.size and a.size != b.size:
+        raise ConfigError(f"dotmul_operator sizes differ: {a.size} vs {b.size}")
+
+    def finalize(mixed_name, idx, mixed_size):
+        def fwd(ctx, params, aa: Act, bb: Act):
+            return scale * aa.value * bb.value
+
+        return a.size, [], fwd
+
+    return Operator("dotmul_op", [a, b], finalize, a.size)
+
+
+@_recorded
+def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
+                  num_filters: int, num_channels: Optional[int] = None,
+                  stride: int = 1, padding: int = 0,
+                  trans: bool = False) -> Operator:
+    """Per-sample convolution: row i of ``filter`` provides sample i's kernel
+    (reference ConvOperator.cpp:58-87 — one cuDNN conv per batch row).
+    TPU-native: one vmapped conv — XLA lowers it to a single grouped
+    convolution on the MXU instead of a per-sample loop.
+
+    ``filter`` rows are [kh*kw*Cin*F] reshaped to HWIO."""
+    if "hw" not in img.meta:
+        raise ConfigError("conv_operator img needs spatial meta (hw)")
+    h, w = img.meta["hw"]
+    cin = num_channels or img.size
+    if trans:
+        oh = (h - 1) * stride + filter_size - 2 * padding
+        ow = (w - 1) * stride + filter_size - 2 * padding
+    else:
+        oh = (h + 2 * padding - filter_size) // stride + 1
+        ow = (w + 2 * padding - filter_size) // stride + 1
+    if oh <= 0 or ow <= 0:
+        raise ConfigError(f"conv_operator output dims ({oh}, {ow}) not positive")
+    expect = filter_size * filter_size * cin * num_filters
+    if filter.size and filter.size != expect:
+        raise ConfigError(
+            f"conv_operator filter layer size {filter.size} != "
+            f"kh*kw*Cin*F = {expect}")
+
+    def finalize(mixed_name, idx, mixed_size):
+        def one(xi, wi):
+            wgt = wi.reshape(filter_size, filter_size, cin, num_filters)
+            if trans:
+                return O.conv2d(
+                    xi[None] if stride == 1 else _dilate(xi[None], stride),
+                    jnp.flip(wgt, (0, 1)).swapaxes(2, 3),
+                    stride=(1, 1),
+                    padding=[(filter_size - 1 - padding,) * 2] * 2,
+                )[0]
+            return O.conv2d(xi[None], wgt, stride=(stride, stride),
+                            padding=[(padding, padding)] * 2)[0]
+
+        def fwd(ctx, params, ia: Act, fa: Act):
+            return jax.vmap(one)(ia.value, fa.value)
+
+        return num_filters, [], fwd
+
+    return Operator("conv_trans_op" if trans else "conv_op", [img, filter],
+                    finalize, num_filters, hw=(oh, ow))
+
+
+# ---------------------------------------------------------------------------
+# the mixed layer
+# ---------------------------------------------------------------------------
+
+
+class MixedLayer(LayerOutput):
+    """Mixed layer under construction — usable as a context manager
+    (``with mixed(size=...) as m: m += proj``) exactly like the reference's
+    MixedLayerType (layers.py:658-720).  After finalization it is an ordinary
+    LayerOutput node."""
+
+    def __init__(self, name, size, act, bias_attr):
+        super().__init__(name=name, layer_type="mixed", size=size,
+                         parents=[], forward=None, param_specs=[])
+        self._act = act
+        self._bias_attr = bias_attr
+        self._inputs: List[Projection] = []
+        self._finalized = False
+
+    def __iadd__(self, other: Projection):
+        if self._finalized:
+            raise ConfigError(f"mixed layer {self.name!r} is sealed")
+        if not isinstance(other, Projection):
+            # A bare layer here would silently become SOME projection; the
+            # reference asserts Projection/Operator (layers.py:700-706) and
+            # so do we — wrap explicitly with full_matrix_projection(...)
+            raise ConfigError(
+                f"mixed layer inputs must be projections/operators, got "
+                f"{type(other).__name__}; wrap layers explicitly, e.g. "
+                f"full_matrix_projection(input=layer)")
+        self._inputs.append(other)
+        return self
+
+    def __enter__(self):
+        if self._inputs:
+            raise ConfigError("mixed context manager must start empty")
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        if exc_value is not None:
+            return False
+        self._seal()
+        return False
+
+    def _seal(self):
+        if self._finalized:
+            return
+        if not self._inputs:
+            raise ConfigError(f"mixed layer {self.name!r} has no inputs")
+        self._finalized = True
+        specs: List[ParamSpec] = []
+        fwds = []
+        arities = []
+        sizes = []
+        hw = None
+        image_like = []
+        for idx, proj in enumerate(self._inputs):
+            out, pspecs, fwd = proj.finalize(self.name, idx, self.size)
+            specs.extend(pspecs)
+            fwds.append(fwd)
+            arities.append(len(proj.origins))
+            sizes.append(out)
+            image_like.append(proj.hw is not None)
+            if proj.hw is not None:
+                if hw is not None and hw != proj.hw:
+                    raise ConfigError(
+                        f"mixed layer {self.name!r}: image inputs disagree on "
+                        f"spatial dims {hw} vs {proj.hw}")
+                hw = proj.hw
+        if hw is not None and not all(image_like):
+            raise ConfigError(
+                f"mixed layer {self.name!r} mixes image-shaped and flat "
+                f"inputs; split them into separate layers")
+        want = self.size or sizes[0]
+        bad = [s for s in sizes if s != want]
+        if bad:
+            raise ConfigError(
+                f"mixed layer {self.name!r}: input sizes {sizes} do not all "
+                f"match layer size {want}")
+        self.size = want
+        ba = _bias_attr(self._bias_attr, f"_{self.name}.wbias")
+        if ba:
+            specs.append(ParamSpec(name=ba.name, shape=(want,), attr=ba))
+        act_fn = O.get_activation(self._act)
+        parents: List[LayerOutput] = []
+        for proj in self._inputs:
+            parents.extend(proj.origins)
+        offsets = []
+        pos = 0
+        for n in arities:
+            offsets.append((pos, pos + n))
+            pos += n
+
+        def forward(ctx, params, *acts: Act) -> Act:
+            out = None
+            for fwd, (lo, hi) in zip(fwds, offsets):
+                y = fwd(ctx, params, *acts[lo:hi])
+                out = y if out is None else out + y
+            if ba:
+                out = out + params[ba.name].astype(out.dtype)
+            out = act_fn(out)
+            ref = next((a for a in acts if a.is_seq), None)
+            # mask iff out has a time axis matching the seq input (id inputs
+            # are [B,T] while their projection output is [B,T,D])
+            if ref is not None and out.ndim == ref.mask.ndim + 1:
+                out = out * ref.mask[..., None].astype(out.dtype)
+                return _seq_like(ref, out)
+            return Act(value=out)
+
+        self.parents = parents
+        self.param_specs = specs
+        self.forward = forward
+        if hw is not None:
+            self.meta["hw"] = hw
+        # record the (sealed) constructor call for config serialization —
+        # covers both the eager and the context-manager build styles
+        from paddle_tpu.config.capture import _call_counter
+
+        self.meta["config"] = {
+            "fn": "mixed",
+            "kwargs": {"input": list(self._inputs), "size": self.size,
+                       "act": self._act, "bias_attr": self._bias_attr,
+                       "name": self.name},
+            "call_id": next(_call_counter),
+            "out": -1,
+        }
+
+
+ProjLike = Union[Projection, LayerOutput]
+
+
+def mixed(size: int = 0,
+          input: Optional[Union[Projection, Sequence[Projection]]] = None,
+          *, act: str = "linear", name: Optional[str] = None,
+          bias_attr: AttrLike = False) -> MixedLayer:
+    """Mixed layer — sum of projections/operators, then bias + activation
+    (reference mixed_layer, trainer_config_helpers/layers.py:736-806;
+    MixedLayer.cpp; same parameter order: size first).  Defaults match the
+    reference: linear activation, no bias.  Inputs must be
+    Projection/Operator objects — wrap bare layers explicitly with
+    full_matrix_projection(...).
+
+    With ``input=None`` returns a context-manager builder; otherwise the
+    layer is finalized immediately."""
+    name = name or next_name("mixed")
+    m = MixedLayer(name, size, act, bias_attr)
+    if input is None:
+        return m
+    items = [input] if isinstance(input, (Projection, LayerOutput)) \
+        else list(input)
+    for it in items:
+        m += it  # __iadd__ rejects non-Projection items with a ConfigError
+    m._seal()
+    return m

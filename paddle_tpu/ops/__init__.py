@@ -34,6 +34,7 @@ from paddle_tpu.ops.sequence import (
     seq_reverse,
     seq_concat,
     context_projection,
+    context_projection_trainable,
 )
 from paddle_tpu.ops.conv import (
     conv2d,

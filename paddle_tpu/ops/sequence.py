@@ -31,6 +31,7 @@ __all__ = [
     "seq_reverse",
     "seq_concat",
     "context_projection",
+    "context_projection_trainable",
     "seq_slice_window",
 ]
 
@@ -143,6 +144,42 @@ def context_projection(value, mask, context_len, context_start):
         else:
             shifted = v
         cols.append(shifted)
+    out = jnp.concatenate(cols, axis=-1)
+    return _masked(out, mask)
+
+
+def context_projection_trainable(value, lengths, mask, context_len, context_start,
+                                 pad_weights):
+    """Context projection with TRAINABLE boundary padding.
+
+    Analog of ContextProjection with ``trainable_padding`` (reference:
+    gserver/layers/ContextProjection.cpp:36-63 — ``beginPad_ = max(0,
+    -context_start)``, end pad rows fill positions past the sequence end).
+    ``pad_weights`` is [begin_pad + end_pad, D]: row ``p`` of the begin block
+    substitutes source position ``p - begin_pad`` (< 0); row ``begin_pad + q``
+    substitutes source position ``length + q`` (>= length).  [B,T,D] ->
+    [B,T,D*context_len]; gradients flow into the used padding rows.
+    """
+    B, T, D = value.shape
+    begin_pad = max(0, -context_start)
+    v = _masked(value, mask)
+    L = lengths[:, None].astype(jnp.int32)
+    cols = []
+    for k in range(context_len):
+        off = context_start + k
+        pos = jnp.arange(T, dtype=jnp.int32)[None, :] + off  # [1, T]
+        src = jnp.clip(pos, 0, T - 1)
+        shifted = jnp.take_along_axis(v, jnp.broadcast_to(src[..., None], (B, T, 1)), axis=1)
+        before = pos < 0                       # [1, T] -> broadcasts
+        after = pos >= L                       # [B, T]
+        pad_row = jnp.where(
+            pos < 0, pos + begin_pad, begin_pad + (pos - L)
+        )
+        pad_row = jnp.clip(pad_row, 0, pad_weights.shape[0] - 1)
+        pad_vals = pad_weights[pad_row].astype(shifted.dtype)  # [B, T, D]
+        use_pad = jnp.broadcast_to(before | after, (B, T))
+        col = jnp.where(use_pad[..., None], pad_vals, shifted)
+        cols.append(col)
     out = jnp.concatenate(cols, axis=-1)
     return _masked(out, mask)
 

@@ -38,6 +38,15 @@ seq_reshape = _nn.seq_reshape
 addto = _nn.addto
 dropout = _nn.dropout
 mixed = _nn.mixed
+full_matrix_projection = _nn.full_matrix_projection
+trans_full_matrix_projection = _nn.trans_full_matrix_projection
+table_projection = _nn.table_projection
+identity_projection = _nn.identity_projection
+dotmul_projection = _nn.dotmul_projection
+scaling_projection = _nn.scaling_projection
+conv_projection = _nn.conv_projection
+dotmul_operator = _nn.dotmul_operator
+conv_operator = _nn.conv_operator
 cos_sim = _nn.cos_sim
 interpolation = _nn.interpolation
 power = _nn.power
@@ -70,7 +79,10 @@ rotate = _nn.rotate
 block_expand = _nn.block_expand
 sub_seq = _nn.sub_seq
 sampling_id = _nn.sampling_id
-context_projection = _nn.context_projection
+# In the reference, context_projection is a *projection* (usable only inside
+# mixed, trainer_config_helpers/layers.py:608); the standalone-layer variant
+# stays available as paddle_tpu.nn.context_projection.
+context_projection = _nn.context_projection_input
 prelu = _nn.prelu
 trans = _nn.trans
 resize = _nn.resize

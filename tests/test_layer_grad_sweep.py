@@ -100,10 +100,65 @@ def case_error_clip(rng):
 
 
 def case_mixed(rng):
+    # full_matrix + identity + bias + nonlinearity in one mixed layer
     x, feed = _dense(rng)
-    return nn.mixed([nn.full_matrix_projection(x, size=4),
-                     nn.identity_projection(x)][0:1] if hasattr(nn, "full_matrix_projection")
-                    else [x], 4), feed
+    return nn.mixed(size=D, act="tanh", bias_attr=True, input=[
+        nn.full_matrix_projection(x),
+        nn.identity_projection(x),
+    ]), feed
+
+
+def case_mixed_trans_table(rng):
+    ids_flat = nn.data("id1", size=V, dtype="int32")
+    x, fx = _dense(rng)
+    feed = {**fx, "id1": rng.randint(0, V, (B, 1)).astype(np.int32)}
+    return nn.mixed(size=4, input=[
+        nn.trans_full_matrix_projection(x, size=4),
+        nn.table_projection(ids_flat),
+    ]), feed
+
+
+def case_mixed_identity_offset(rng):
+    x, feed = _dense(rng)
+    h = _pre_fc(x)
+    return nn.mixed(size=3, input=[nn.identity_projection(h, offset=2, size=3)]), feed
+
+
+def case_mixed_dotmul_scaling(rng):
+    x, feed = _dense(rng)
+    h = _pre_fc(x)
+    return nn.mixed(size=D, input=[
+        nn.dotmul_projection(h),
+        nn.scaling_projection(h),
+        nn.dotmul_operator(a=h, b=h, scale=0.5),
+    ]), feed
+
+
+def case_mixed_context(rng):
+    xs, feed = _seq(rng)
+    proj = nn.context_projection_input(
+        _pre_fc(xs), context_len=3,
+        padding_attr=nn.ParamAttr(init="normal", initial_std=0.1))
+    return nn.pooling(nn.mixed(input=[proj]), pooling_type="sum"), feed
+
+
+def case_mixed_conv(rng):
+    img, feed = _img(rng)
+    return nn.mixed(input=[
+        nn.conv_projection(img, filter_size=3, num_filters=2, padding=1),
+        nn.conv_projection(img, filter_size=5, num_filters=2, padding=2),
+    ]), feed
+
+
+def case_mixed_conv_operator(rng):
+    img, fi = _img(rng)
+    fsz = 3 * 3 * IMG_C * 2
+    flt = nn.data("flt", size=fsz)
+    feed = {**fi, "flt": rng.randn(B, fsz).astype(np.float32)}
+    return nn.mixed(input=[
+        nn.conv_operator(img=img, filter=_pre_fc(flt, fsz, "pf"),
+                         filter_size=3, num_filters=2, padding=1),
+    ]), feed
 
 
 def case_tensor(rng):
@@ -541,7 +596,6 @@ EXCLUDED = {
     "data",            # input declaration, no compute
     "reset_naming",    # naming utility
     "device_pin",      # sharding annotation wrapper (test_sparse_hooks)
-    "mixed",           # projection container (test_graph covers projections)
     "classification_cost",  # included below via CASES
     "beam_search",     # emits int token ids — no gradient path by design
 }

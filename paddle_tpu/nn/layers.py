@@ -381,17 +381,36 @@ def img_conv(input: LayerOutput, *, filter_size: int, num_filters: int,
 
 def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None,
              pool_type: str = "max", padding: Union[str, int] = "VALID",
-             name: Optional[str] = None) -> LayerOutput:
+             ceil_mode: bool = True, name: Optional[str] = None) -> LayerOutput:
     """Spatial pooling — analog of img_pool_layer (PoolLayer.cpp,
     hl_maxpool/avgpool kernels).  ``padding`` may be 'SAME'/'VALID' or an
-    int (explicit symmetric pixel padding, as in the reference)."""
+    int (explicit symmetric pixel padding, as in the reference).
+
+    ``ceil_mode`` (int-padding path only) matches the reference default
+    (MathUtils outputSize caffeMode=false: output dims use CEIL division, with
+    implicit extra bottom/right padding); set False for floor semantics.
+    'SAME'/'VALID' string paddings keep their XLA meanings regardless."""
     name = name or next_name("pool")
     stride = stride or pool_size
     h, w = _spatial(input)
     if isinstance(padding, int):
-        oh = (h + 2 * padding - pool_size) // stride + 1
-        ow = (w + 2 * padding - pool_size) // stride + 1
-        pad_arg = ((0, 0), (padding, padding), (padding, padding), (0, 0))
+        if ceil_mode:
+            oh = -(-(h + 2 * padding - pool_size) // stride) + 1
+            ow = -(-(w + 2 * padding - pool_size) // stride) + 1
+            # legacy clip: drop a window that would start entirely in the
+            # bottom/right padding (it would pool zero real pixels ->
+            # -inf/NaN)
+            if (oh - 1) * stride >= h + padding:
+                oh -= 1
+            if (ow - 1) * stride >= w + padding:
+                ow -= 1
+        else:
+            oh = (h + 2 * padding - pool_size) // stride + 1
+            ow = (w + 2 * padding - pool_size) // stride + 1
+        extra_h = max(0, (oh - 1) * stride + pool_size - (h + 2 * padding))
+        extra_w = max(0, (ow - 1) * stride + pool_size - (w + 2 * padding))
+        pad_arg = ((0, 0), (padding, padding + extra_h),
+                   (padding, padding + extra_w), (0, 0))
     elif padding == "SAME":
         oh, ow = -(-h // stride), -(-w // stride)
         pad_arg = padding

@@ -18,8 +18,9 @@ forward on v5e).  The custom VJP instead:
   contraction each: ``d_enc = einsum('tbs,tbh->bsh', probs, d_ctx)``,
   ``d_Wx = einsum('tbi,tbo->io', x, d_xp)``, ``d_y = d_xp @ Wx^T``,
 - keeps only genuinely sequential accumulators (``d_enc_proj``, ``d_Wh``,
-  attention weight grads) in the reverse scan, with the ``d_enc_proj``
-  accumulator in the compute dtype.
+  attention weight grads) in the reverse scan.  All scan accumulators are
+  f32: summing T bfloat16 terms drifts for long targets (the cotangent is
+  cast to the primal dtype once, after the scan).
 
 Forward saves (probs [T,B,S], ctx [T,B,2H], states) — O(B·T·(S+2H+D))
 residuals, ~100 MB at bench shapes vs the ~1.3 GB/step-loop accumulator
@@ -116,7 +117,6 @@ def _agd_bwd(res, d_states):
     S = enc.shape[1]
     E = y_emb.shape[-1]
     f32 = jnp.float32
-    cd = enc_proj.dtype  # compute dtype of the cached encoder tensors
 
     y_tb = jnp.moveaxis(y_emb, 1, 0)                       # [T,B,E]
     m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
@@ -191,7 +191,10 @@ def _agd_bwd(res, d_states):
         d_scores = jnp.where(maskb, d_z, 0.0)
         pre_f = pre.astype(f32)
         d_pre = (1.0 - pre_f * pre_f) * (d_scores[..., None] * att_v_f)
-        d_encP = d_encP + d_pre.astype(cd)
+        # accumulate in f32: summing T bf16 terms loses precision for long
+        # target sequences when the compute dtype is bfloat16 (cast once
+        # after the scan)
+        d_encP = d_encP + d_pre
         sum_dpre = jnp.sum(d_pre, axis=1)                  # [B,A]
         d_h = d_h + sum_dpre @ att_w_f.T
         d_attw = d_attw + sp.T @ sum_dpre
@@ -202,7 +205,7 @@ def _agd_bwd(res, d_states):
 
     A = enc_proj.shape[-1]
     acc0 = (jnp.zeros((B, D), f32),
-            jnp.zeros((B, S, A), cd),
+            jnp.zeros((B, S, A), f32),
             jnp.zeros(att_w.shape, f32),
             jnp.zeros(att_v.shape, f32),
             jnp.zeros(wh.shape, f32),
@@ -221,7 +224,8 @@ def _agd_bwd(res, d_states):
     d_y = (d_xp_tb @ wx_f[:E].T).astype(y_emb.dtype)       # [T,B,E]
     d_y_emb = jnp.moveaxis(d_y, 0, 1)
 
-    return (d_y_emb, d_s0.astype(s0.dtype), d_enc, d_encP,
+    return (d_y_emb, d_s0.astype(s0.dtype), d_enc,
+            d_encP.astype(enc_proj.dtype),
             None, None,
             d_attw.astype(att_w.dtype), d_v.astype(att_v.dtype),
             d_wx.astype(wx.dtype), d_b.astype(b.dtype),

@@ -172,9 +172,11 @@ class Optimizer:
           its slots are gathered, updated, and scattered back in place
           (donated buffers make this a true O(K·D) row update instead of
           O(V·D) — the SparseRowCpuMatrix locality argument, on HBM
-          bandwidth instead of CPU cache).  ``K`` MUST upper-bound the
-          number of rows a batch can touch (e.g. batch·seq_len per lookup
-          of the table); excess touched rows beyond K would be dropped.
+          bandwidth instead of CPU cache).  ``K`` is a fast-path capacity:
+          size it to the typical touched-row count (e.g. batch·seq_len per
+          lookup of the table).  A batch touching MORE than K rows is
+          still correct — a cond falls back to the full masked update for
+          that step (paying the O(V·D) cost only when it happens).
         """
         step = opt_state["step"] + 1
         lr = self.lr_at(step)
@@ -196,26 +198,65 @@ class Optimizer:
                 # ---- row fast path: touch only K candidate rows ----
                 K = int(kind)
                 touched = jnp.any(g != 0, axis=tuple(range(1, p.ndim)))
-                live_score, rows = jax.lax.top_k(touched.astype(jnp.float32), K)
-                live = (live_score > 0).reshape((-1,) + (1,) * (p.ndim - 1))
-                p_r, g_r = p[rows], g[rows]
-                if decay:
-                    g_r = g_r + decay * p_r
-                if self.l1_rate:
-                    g_r = g_r + self.l1_rate * jnp.sign(p_r)
-                s_r = jax.tree_util.tree_map(
-                    lambda s: s[rows]
-                    if getattr(s, "shape", None) == p.shape else s, old_slots)
-                p2_r, s2_r = self.update_leaf(p_r, g_r, s_r, lr * scale, step)
-                p2_r = jnp.where(live, p2_r, p_r)
-                # top_k indices are distinct -> unique scatter
-                new_params[k] = p.at[rows].set(
-                    p2_r.astype(p.dtype), unique_indices=True)
-                new_slots[k] = jax.tree_util.tree_map(
-                    lambda o, n2: o.at[rows].set(
-                        jnp.where(live, n2, o[rows]), unique_indices=True)
-                    if getattr(o, "shape", None) == p.shape else n2,
-                    old_slots, s2_r)
+
+                def _fast(_, p=p, g=g, touched=touched, K=K, decay=decay,
+                          scale=scale, old_slots=old_slots):
+                    live_score, rows = jax.lax.top_k(
+                        touched.astype(jnp.float32), K)
+                    live = (live_score > 0).reshape(
+                        (-1,) + (1,) * (p.ndim - 1))
+                    p_r, g_r = p[rows], g[rows]
+                    if decay:
+                        g_r = g_r + decay * p_r
+                    if self.l1_rate:
+                        g_r = g_r + self.l1_rate * jnp.sign(p_r)
+                    s_r = jax.tree_util.tree_map(
+                        lambda s: s[rows]
+                        if getattr(s, "shape", None) == p.shape else s,
+                        old_slots)
+                    p2_r, s2_r = self.update_leaf(p_r, g_r, s_r, lr * scale,
+                                                  step)
+                    p2_r = jnp.where(live, p2_r, p_r)
+                    # top_k indices are distinct -> unique scatter
+                    np_ = p.at[rows].set(p2_r.astype(p.dtype),
+                                         unique_indices=True)
+                    ns_ = jax.tree_util.tree_map(
+                        lambda o, n2: o.at[rows].set(
+                            jnp.where(live, n2, o[rows]), unique_indices=True)
+                        if getattr(o, "shape", None) == p.shape else n2,
+                        old_slots, s2_r)
+                    return np_, ns_
+
+                def _masked(_, p=p, g=g, touched=touched, decay=decay,
+                            scale=scale, old_slots=old_slots):
+                    # overflow fallback: full-table update masked per row —
+                    # correct for any touched count (same as the `True` path)
+                    if decay:
+                        g = g + decay * p
+                    if self.l1_rate:
+                        g = g + self.l1_rate * jnp.sign(p)
+                    p2, s2 = self.update_leaf(p, g, old_slots, lr * scale,
+                                              step)
+                    row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
+
+                    def sel(new, old):
+                        r = row.astype(jnp.bool_)
+                        r = r.reshape(r.shape + (1,) * (new.ndim - r.ndim))
+                        return jnp.where(r, new, old)
+
+                    p2 = sel(p2, p)
+                    s2 = jax.tree_util.tree_map(
+                        lambda n, o: sel(n, o)
+                        if getattr(n, "shape", None) == p.shape else n,
+                        s2, old_slots)
+                    return p2.astype(p.dtype), s2
+
+                # a batch touching more than K rows would silently drop
+                # gradient rows in the fast path; guard with a cond so only
+                # the chosen branch executes at runtime
+                n_touched = jnp.sum(touched.astype(jnp.int32))
+                new_params[k], new_slots[k] = jax.lax.cond(
+                    n_touched <= K, _fast, _masked, None)
                 continue
             if decay:
                 g = g + decay * p

@@ -111,3 +111,27 @@ def test_jit_and_grad_under_jit():
     gy, gwx = g(*vals)
     assert np.isfinite(np.asarray(gy)).all()
     assert np.isfinite(np.asarray(gwx)).all()
+
+
+def test_bf16_cached_encoder_grads_stay_close():
+    """bf16 enc/enc_proj caches (the production operand policy): the fused
+    backward must accumulate the T-step d_enc_proj cotangent in f32 — summing
+    bf16 terms drifts for long targets.  Pins grads within bf16 tolerance of
+    the all-f32 run and checks the cotangent dtype matches the primal."""
+    args = make_args(T=24, trg_lens=(24, 20, 24, 16))
+    vals = [args[k] for k in ORDER]
+    bf16_idx = ORDER.index("enc"), ORDER.index("enc_proj")
+
+    def loss(enc, enc_proj, cast):
+        full = list(vals)
+        full[bf16_idx[0]] = enc.astype(jnp.bfloat16) if cast else enc
+        full[bf16_idx[1]] = enc_proj.astype(jnp.bfloat16) if cast else enc_proj
+        return jnp.sum(attention_gru_decoder(*full) ** 2)
+
+    g32 = jax.grad(loss, argnums=(0, 1))(args["enc"], args["enc_proj"], False)
+    g16 = jax.grad(loss, argnums=(0, 1))(args["enc"], args["enc_proj"], True)
+    for a, b_, nm in zip(g32, g16, ("enc", "enc_proj")):
+        scale = np.abs(np.asarray(a, np.float64)).max() + 1e-6
+        np.testing.assert_allclose(np.asarray(a, np.float64) / scale,
+                                   np.asarray(b_, np.float64) / scale,
+                                   atol=3e-2, err_msg=nm)

@@ -274,3 +274,47 @@ def test_error_clip_identity_forward_clipped_backward():
 
     g = jax.grad(loss)(xv)
     np.testing.assert_allclose(np.asarray(g), 0.1 * np.ones((2, 3)), rtol=1e-6)
+
+
+def test_img_pool_int_padding_ceil_mode():
+    """pool3s2p1 on 28px: reference ceil semantics give 15 (floor gives 14);
+    extra bottom/right padding keeps the last window in place (ADVICE r2)."""
+    import jax
+
+    nn.reset_naming()
+    img = nn.data("img", size=2, height=28, width=28)
+    pc = nn.img_pool(img, pool_size=3, stride=2, padding=1, name="ceil")
+    pf = nn.img_pool(img, pool_size=3, stride=2, padding=1, ceil_mode=False,
+                     name="floor")
+    assert pc.meta["hw"] == (15, 15)
+    assert pf.meta["hw"] == (14, 14)
+    topo = nn.Topology([pc, pf])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(2, 28, 28, 2).astype(np.float32)
+    out, _ = topo.apply(params, state, {"img": x})
+    assert out["ceil"].value.shape == (2, 15, 15, 2)
+    assert out["floor"].value.shape == (2, 14, 14, 2)
+    # interior windows agree between the two modes
+    np.testing.assert_allclose(np.asarray(out["ceil"].value)[:, :14, :14],
+                               np.asarray(out["floor"].value), rtol=1e-6)
+
+
+def test_img_pool_ceil_clips_all_padding_window():
+    """pool2s2p1 on 5px: naive ceil gives 4 but the 4th window starts wholly
+    in padding -> -inf/NaN; the legacy clip drops it (output 3)."""
+    import jax
+
+    nn.reset_naming()
+    img = nn.data("img", size=1, height=5, width=5)
+    pm = nn.img_pool(img, pool_size=2, stride=2, padding=1, name="mx")
+    pa = nn.img_pool(img, pool_size=2, stride=2, padding=1, pool_type="avg",
+                     name="av")
+    assert pm.meta["hw"] == (3, 3)
+    topo = nn.Topology([pm, pa])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(2, 5, 5, 1).astype(np.float32)
+    out, _ = topo.apply(params, state, {"img": x})
+    for nm in ("mx", "av"):
+        v = np.asarray(out[nm].value)
+        assert v.shape == (2, 3, 3, 1)
+        assert np.isfinite(v).all(), nm

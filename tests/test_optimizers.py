@@ -169,3 +169,34 @@ def test_sparse_rows_fast_path_with_decay_only_advances_touched():
     p1, _ = opt.update({"emb": p0}, {"emb": g}, st, sparse_rows={"emb": 4})
     moved = np.where(np.any(np.asarray(p1["emb"]) != 1.0, axis=1))[0]
     np.testing.assert_array_equal(moved, [5])
+
+
+def test_sparse_rows_overflow_falls_back_to_mask_path():
+    """A batch touching MORE than K rows must not drop gradient rows: the K
+    fast path guards with a cond that falls back to the full masked update."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.param.optimizers import Adam, SGD
+
+    rs = np.random.RandomState(7)
+    V, D, K, TOUCH = 40, 6, 4, 11  # TOUCH > K
+    params = {"emb": jnp.asarray(rs.randn(V, D).astype(np.float32))}
+    ge = np.zeros((V, D), np.float32)
+    rows = rs.choice(V, TOUCH, replace=False)
+    for r in rows:
+        ge[r] = rs.randn(D)
+    grads = {"emb": jnp.asarray(ge)}
+
+    for opt_cls in (SGD, Adam):
+        a, b = opt_cls(learning_rate=0.1), opt_cls(learning_rate=0.1)
+        sa, sb = a.init_state(params), b.init_state(params)
+        pa, _ = a.update(dict(params), grads, sa, sparse_rows={"emb": True})
+        pb, _ = b.update(dict(params), grads, sb, sparse_rows={"emb": K})
+        np.testing.assert_allclose(np.asarray(pa["emb"]),
+                                   np.asarray(pb["emb"]),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=opt_cls.__name__)
+        # every touched row must actually have moved
+        moved = np.any(np.asarray(pb["emb"]) != np.asarray(params["emb"]),
+                       axis=1)
+        assert moved[rows].all()

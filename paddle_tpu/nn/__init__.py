@@ -21,4 +21,5 @@ from paddle_tpu.nn.layers_extra2 import *  # noqa: F401,F403
 from paddle_tpu.nn.projections import *  # noqa: F401,F403
 from paddle_tpu.nn.recurrent import (Memory, StaticInput, GeneratedInput,
                                      recurrent_group, beam_search, SequenceGenerator)
+from paddle_tpu.nn.steps import lstm_step, gru_step
 from paddle_tpu.nn import layers as layer

@@ -381,7 +381,8 @@ def img_conv(input: LayerOutput, *, filter_size: int, num_filters: int,
 
 def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None,
              pool_type: str = "max", padding: Union[str, int] = "VALID",
-             ceil_mode: bool = True, name: Optional[str] = None) -> LayerOutput:
+             ceil_mode: bool = True, act: str = "linear",
+             name: Optional[str] = None) -> LayerOutput:
     """Spatial pooling — analog of img_pool_layer (PoolLayer.cpp,
     hl_maxpool/avgpool kernels).  ``padding`` may be 'SAME'/'VALID' or an
     int (explicit symmetric pixel padding, as in the reference).
@@ -389,7 +390,13 @@ def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None
     ``ceil_mode`` (int-padding path only) matches the reference default
     (MathUtils outputSize caffeMode=false: output dims use CEIL division, with
     implicit extra bottom/right padding); set False for floor semantics.
-    'SAME'/'VALID' string paddings keep their XLA meanings regardless."""
+    'SAME'/'VALID' string paddings keep their XLA meanings regardless.
+
+    ``act`` (an extension over the reference, which has no pool activation)
+    lets models apply a monotonic activation AFTER max pooling instead of
+    before: relu(max_pool(x)) == max_pool(relu(x)) but runs the elementwise
+    pass on the stride^2-smaller map — the stem-bandwidth trick the image
+    benchmarks use."""
     name = name or next_name("pool")
     stride = stride or pool_size
     h, w = _spatial(input)
@@ -424,9 +431,11 @@ def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None
             f"positive — window {pool_size}/stride {stride}/padding "
             f"{padding!r} does not fit the {h}x{w} input")
     op = O.max_pool2d if pool_type == "max" else O.avg_pool2d
+    act_fn = O.get_activation(act)
 
     def forward(ctx, params, a: Act) -> Act:
-        return Act(value=op(a.value, (pool_size, pool_size), (stride, stride), pad_arg))
+        y = op(a.value, (pool_size, pool_size), (stride, stride), pad_arg)
+        return Act(value=act_fn(y))
 
     out = LayerOutput(name, "pool", input.size, [input], forward, [])
     out.meta["hw"] = (oh, ow)
@@ -510,23 +519,38 @@ def bilinear_interp(input: LayerOutput, *, out_h: int, out_w: int,
 def lstmemory(input: LayerOutput, size: Optional[int] = None, *,
               reverse: bool = False, act: str = "tanh", gate_act: str = "sigmoid",
               state_act: str = "tanh", use_peepholes: bool = True,
+              projected_input: bool = False,
               name: Optional[str] = None, param_attr: AttrLike = None,
               bias_attr: AttrLike = True) -> LayerOutput:
     """LSTM over a sequence — analog of lstmemory (layers.py:1121,
     LstmLayer.cpp + hl_lstm kernels).
 
     Unlike the reference (which requires a preceding mixed/fc computing the
-    4H input projection), this layer owns both input and recurrent weights:
-    the projection is still one fused MXU matmul over all timesteps.
+    4H input projection), this layer owns both input and recurrent weights by
+    default: the projection is still one fused MXU matmul over all timesteps.
+    ``projected_input=True`` restores the reference convention exactly — the
+    input must already be the [B,T,4*size] gate pre-projection (size defaults
+    to input.size//4, the reference's implicit rule) and no wx is created.
     Peephole ("check") weights match the reference's hl_lstm_ops.cuh.
     """
     name = name or next_name("lstmemory")
-    H = size or input.size
+    if projected_input:
+        H = size or input.size // 4
+        if input.size != 4 * H:
+            raise ConfigError(
+                f"lstmemory {name!r}: projected_input needs input.size == "
+                f"4*size ({4 * H}), got {input.size}")
+    else:
+        H = size or input.size
     D = input.size
     pa = _pa(param_attr, f"_{name}.w0")
-    wx = ParamSpec(name=f"_{name}.wx", shape=(D, 4 * H), attr=replace(pa, name=f"_{name}.wx"))
     wh = ParamSpec(name=pa.name, shape=(H, 4 * H), attr=pa)
-    specs = [wx, wh]
+    specs = [wh]
+    wx = None
+    if not projected_input:
+        wx = ParamSpec(name=f"_{name}.wx", shape=(D, 4 * H),
+                       attr=replace(pa, name=f"_{name}.wx"))
+        specs.insert(0, wx)
     ba = _bias_attr(bias_attr, f"_{name}.wbias")
     if ba:
         specs.append(ParamSpec(name=ba.name, shape=(4 * H,), attr=ba))
@@ -545,8 +569,9 @@ def lstmemory(input: LayerOutput, size: Optional[int] = None, *,
             pk = dict(peep_i=params[peeps[0].name], peep_f=params[peeps[1].name],
                       peep_o=params[peeps[2].name])
         h_seq, (h_f, c_f) = O.lstm_layer(
-            a.value, a.mask, params[wx.name], params[wh.name], b,
-            reverse=reverse, act=act, gate_act=gate_act, state_act=state_act, **pk,
+            a.value, a.mask, params[wx.name] if wx else None, params[wh.name],
+            b, reverse=reverse, act=act, gate_act=gate_act,
+            state_act=state_act, **pk,
         )
         return Act(value=h_seq, lengths=a.lengths, mask=a.mask,
                    state={"final_h": h_f, "final_c": c_f})
@@ -556,17 +581,31 @@ def lstmemory(input: LayerOutput, size: Optional[int] = None, *,
 
 def grumemory(input: LayerOutput, size: Optional[int] = None, *,
               reverse: bool = False, act: str = "tanh", gate_act: str = "sigmoid",
+              projected_input: bool = False,
               name: Optional[str] = None, param_attr: AttrLike = None,
               bias_attr: AttrLike = True) -> LayerOutput:
     """GRU over a sequence — analog of grumemory (layers.py:1228,
-    GatedRecurrentLayer.cpp + hl_gru kernels). Owns input + recurrent weights."""
+    GatedRecurrentLayer.cpp + hl_gru kernels). Owns input + recurrent weights
+    by default; ``projected_input=True`` restores the reference convention
+    (input IS the [B,T,3*size] pre-projection, no wx — see lstmemory)."""
     name = name or next_name("grumemory")
-    H = size or input.size
+    if projected_input:
+        H = size or input.size // 3
+        if input.size != 3 * H:
+            raise ConfigError(
+                f"grumemory {name!r}: projected_input needs input.size == "
+                f"3*size ({3 * H}), got {input.size}")
+    else:
+        H = size or input.size
     D = input.size
     pa = _pa(param_attr, f"_{name}.w0")
-    wx = ParamSpec(name=f"_{name}.wx", shape=(D, 3 * H), attr=replace(pa, name=f"_{name}.wx"))
     wh = ParamSpec(name=pa.name, shape=(H, 3 * H), attr=pa)
-    specs = [wx, wh]
+    specs = [wh]
+    wx = None
+    if not projected_input:
+        wx = ParamSpec(name=f"_{name}.wx", shape=(D, 3 * H),
+                       attr=replace(pa, name=f"_{name}.wx"))
+        specs.insert(0, wx)
     ba = _bias_attr(bias_attr, f"_{name}.wbias")
     if ba:
         specs.append(ParamSpec(name=ba.name, shape=(3 * H,), attr=ba))
@@ -574,8 +613,8 @@ def grumemory(input: LayerOutput, size: Optional[int] = None, *,
     def forward(ctx, params, a: Act) -> Act:
         b = params[ba.name] if ba else jnp.zeros((3 * H,), a.value.dtype)
         h_seq, h_f = O.gru_layer(
-            a.value, a.mask, params[wx.name], params[wh.name], b,
-            reverse=reverse, act=act, gate_act=gate_act,
+            a.value, a.mask, params[wx.name] if wx else None, params[wh.name],
+            b, reverse=reverse, act=act, gate_act=gate_act,
         )
         return Act(value=h_seq, lengths=a.lengths, mask=a.mask, state={"final_h": h_f})
 

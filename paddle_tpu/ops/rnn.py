@@ -143,11 +143,13 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
 
     Equivalent capability to the reference's lstmemory layer
     (trainer_config_helpers/layers.py:1121 + LstmLayer.cpp); the input
-    projection is one big MXU matmul over all timesteps.
+    projection is one big MXU matmul over all timesteps.  ``w_x=None``
+    means x IS the [B,T,4H] pre-projection (the reference's convention,
+    where a preceding mixed layer owns the input matrix).
     """
     B, T, _ = x.shape
     H = w_h.shape[0]
-    xp = linear(x, w_x, b)  # [B, T, 4H]
+    xp = (x + b.astype(x.dtype)) if w_x is None else linear(x, w_x, b)
     if (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh") and not any(
             p is not None for p in (peep_i, peep_f, peep_o)):
         # default cell: fused-backward sequence op (hand-written VJP batches
@@ -186,11 +188,12 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
     """Full GRU over a padded batch. x: [B,T,D] -> h_seq [B,T,H], h final.
 
     Capability analog of grumemory (trainer_config_helpers/layers.py:1228 +
-    GatedRecurrentLayer.cpp).
+    GatedRecurrentLayer.cpp).  ``w_x=None``: x is the [B,T,3H]
+    pre-projection (see lstm_layer).
     """
     B, T, _ = x.shape
     H = w_h.shape[0]
-    xp = linear(x, w_x, b)  # [B, T, 3H]
+    xp = (x + b.astype(x.dtype)) if w_x is None else linear(x, w_x, b)
     if (act, gate_act) == ("tanh", "sigmoid"):
         # default cell: fused-backward sequence op (see lstm_layer above)
         from paddle_tpu.ops.rnn_fused import gru_sequence_fused

@@ -22,9 +22,17 @@ from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_nam
 
 __all__ = [
     "simple_img_conv_pool",
+    "img_conv_bn_pool",
     "img_conv_group",
+    "small_vgg",
+    "vgg_16_network",
     "simple_lstm",
     "simple_gru",
+    "simple_gru2",
+    "lstmemory_unit",
+    "lstmemory_group",
+    "gru_unit",
+    "gru_group",
     "bidirectional_lstm",
     "bidirectional_gru",
     "sequence_conv_pool",
@@ -48,14 +56,34 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size, *,
                         name=name and f"{name}_pool")
 
 
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, *,
+                     conv_stride=1, conv_padding=0, pool_stride=1,
+                     pool_padding=0, act="relu", pool_type="max", name=None):
+    """conv -> batch_norm -> pool block (networks.py:187-258): the conv is
+    linear, the activation lives on the BN as in the reference."""
+    conv = _nn.img_conv(input, filter_size=filter_size,
+                        num_filters=num_filters, stride=conv_stride,
+                        padding=conv_padding, act="linear",
+                        name=name and f"{name}_conv")
+    bn = _nn.batch_norm(conv, act=act, name=name and f"{name}_bn")
+    return _nn.img_pool(bn, pool_size=pool_size, stride=pool_stride,
+                        padding=pool_padding, pool_type=pool_type,
+                        name=name and f"{name}_pool")
+
+
 def img_conv_group(input, conv_num_filter: Sequence[int], *,
                    conv_filter_size=3, conv_act="relu", conv_padding=1,
                    pool_size=2, pool_stride=1, pool_type="max",
-                   conv_batchnorm=False, name=None):
+                   conv_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   name=None):
     """N stacked convs then one pool (networks.py:330) — the VGG block.
     Defaults mirror the reference: 3x3 convs with padding 1, stride-1
-    pooling."""
+    pooling.  ``conv_batchnorm_drop_rate`` (scalar or per-conv list) adds
+    dropout after each BN, as small_vgg uses it (networks.py:395-404)."""
     h = input
+    drops = conv_batchnorm_drop_rate
+    if not hasattr(drops, "__len__"):
+        drops = [drops] * len(conv_num_filter)
     for i, nf in enumerate(conv_num_filter):
         h = _nn.img_conv(h, filter_size=conv_filter_size, num_filters=nf,
                          padding=conv_padding,
@@ -64,8 +92,49 @@ def img_conv_group(input, conv_num_filter: Sequence[int], *,
         if conv_batchnorm:
             h = _nn.batch_norm(h, act=conv_act,
                                name=name and f"{name}_bn{i}")
+            if drops[i]:
+                h = _nn.dropout(h, drops[i])
     return _nn.img_pool(h, pool_size=pool_size, stride=pool_stride,
                         pool_type=pool_type, name=name and f"{name}_pool")
+
+
+def small_vgg(input_image, num_classes=10, *, name=None):
+    """The CIFAR VGG the reference's image demos use (networks.py:391-417):
+    four BN'd conv groups (64x2, 128x2, 256x3, 512x3) with the reference's
+    dropout schedule, then pool/dropout/fc512/BN/fc-softmax."""
+
+    def block(ipt, nf, times, dropouts):
+        return img_conv_group(ipt, [nf] * times, conv_filter_size=3,
+                              conv_padding=1, conv_act="relu",
+                              conv_batchnorm=True,
+                              conv_batchnorm_drop_rate=dropouts,
+                              pool_size=2, pool_stride=2)
+
+    h = block(input_image, 64, 2, [0.3, 0])
+    h = block(h, 128, 2, [0.4, 0])
+    h = block(h, 256, 3, [0.4, 0.4, 0])
+    h = block(h, 512, 3, [0.4, 0.4, 0])
+    h = _nn.img_pool(h, pool_size=2, stride=2)
+    h = _nn.dropout(h, 0.5)
+    h = _nn.fc(h, 512, act="linear")
+    h = _nn.dropout(h, 0.5)
+    h = _nn.batch_norm(h, act="relu")
+    return _nn.fc(h, num_classes, act="softmax", name=name)
+
+
+def vgg_16_network(input_image, num_classes=1000, *, name=None):
+    """VGG-16 (networks.py:420-476): conv groups 64x2/128x2/256x3/512x3/512x3
+    with 2x2 stride-2 pools, then fc4096 x2 (dropout 0.5) + softmax."""
+    h = input_image
+    for nf, times in [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]:
+        h = img_conv_group(h, [nf] * times, conv_filter_size=3,
+                           conv_padding=1, conv_act="relu",
+                           pool_size=2, pool_stride=2)
+    h = _nn.fc(h, 4096, act="relu")
+    h = _nn.dropout(h, 0.5)
+    h = _nn.fc(h, 4096, act="relu")
+    h = _nn.dropout(h, 0.5)
+    return _nn.fc(h, num_classes, act="softmax", name=name)
 
 
 def simple_lstm(input, size, *, act="tanh", gate_act="sigmoid", name=None):
@@ -80,6 +149,112 @@ def simple_lstm(input, size, *, act="tanh", gate_act="sigmoid", name=None):
 def simple_gru(input, size, *, act="tanh", gate_act="sigmoid", name=None):
     """D->3H mixing + recurrent GRU (networks.py:560); see simple_lstm."""
     return _nn.grumemory(input, size, act=act, gate_act=gate_act, name=name)
+
+
+def simple_gru2(input, size, *, act="tanh", gate_act="sigmoid",
+                mixed_param_attr=None, gru_param_attr=None, reverse=False,
+                name=None):
+    """mixed D->3H transform + grumemory over the pre-projection
+    (networks.py:1015-1087) — the reference's exact parameter layout: the
+    transform owns [D,3H], the cell owns only the recurrent [H,3H]."""
+    name = name or _nn.layer.next_name("simple_gru2")
+    m = _nn.mixed(size * 3,
+                  input=[_nn.full_matrix_projection(
+                      input, param_attr=mixed_param_attr)],
+                  bias_attr=True, name=f"{name}_transform")
+    return _nn.grumemory(m, size, projected_input=True, act=act,
+                         gate_act=gate_act, reverse=reverse,
+                         param_attr=gru_param_attr, name=name)
+
+
+def lstmemory_unit(input, out_mem, state_mem, *, size=None, act="tanh",
+                   gate_act="sigmoid", state_act="tanh", param_attr=None,
+                   mixed_bias_attr=False, lstm_bias_attr=True, name=None):
+    """One LSTM time step for use INSIDE a recurrent_group step function
+    (networks.py:616-723).  ``input`` is the [B, 4*size] pre-projected frame
+    (project once outside the group: ``fc(x, 4*size, act='linear')`` — the
+    reference's "additional mixed_layer ... before lstmemory_unit" note);
+    ``out_mem``/``state_mem`` are the group's h/c memory layers.
+
+    Returns h_t; fetch c_t with ``get_output(h, 'state')``.  Composition
+    matches the reference exactly: a mixed layer sums identity(input) +
+    full_matrix(out_mem), then the parameter-free lstm_step applies gates.
+    """
+    name = name or _nn.layer.next_name("lstm_unit")
+    if size is None:
+        size = input.size // 4
+    m = _nn.mixed(size * 4,
+                  input=[_nn.identity_projection(input),
+                         _nn.full_matrix_projection(out_mem,
+                                                    param_attr=param_attr)],
+                  bias_attr=mixed_bias_attr,
+                  name=f"{name}_input_recurrent")
+    return _nn.lstm_step(m, state_mem, size, act=act, gate_act=gate_act,
+                         state_act=state_act, bias_attr=lstm_bias_attr,
+                         name=name)
+
+
+def lstmemory_group(input, size=None, *, reverse=False, act="tanh",
+                    gate_act="sigmoid", state_act="tanh", param_attr=None,
+                    mixed_bias_attr=False, lstm_bias_attr=True, name=None):
+    """Recurrent-group LSTM (networks.py:725-790): same math as a
+    peephole-free lstmemory, but every step's h and c are ordinary layers a
+    user step can tap.  ``input`` must be the [B, T, 4*size] pre-projection
+    (reference convention).  Note lstm_step carries no peephole ("check")
+    weights — equivalence with lstmemory (whose use_peepholes defaults True)
+    holds only while those stay zero; build the flat layer with
+    use_peepholes=False when round-tripping trained weights."""
+    name = name or _nn.layer.next_name("lstm_group")
+    if size is None:
+        size = input.size // 4
+
+    def _step(ipt, om, sm):
+        h = lstmemory_unit(ipt, om, sm, size=size, act=act,
+                           gate_act=gate_act, state_act=state_act,
+                           param_attr=param_attr,
+                           mixed_bias_attr=mixed_bias_attr,
+                           lstm_bias_attr=lstm_bias_attr, name=name)
+        c = _nn.get_output(h, "state", size=size)
+        return [h, h, c]
+
+    return _nn.recurrent_group(
+        step=_step, input=[input],
+        memories=[_nn.Memory(f"{name}_out", size),
+                  _nn.Memory(f"{name}_state", size)],
+        reverse=reverse, name=f"{name}_recurrent_group")
+
+
+def gru_unit(input, out_mem, *, size=None, act="tanh", gate_act="sigmoid",
+             gru_param_attr=None, gru_bias_attr=True, name=None):
+    """One GRU time step for use INSIDE a recurrent_group step
+    (networks.py:792-858): ``input`` is the [B, 3*size] x-projection,
+    ``out_mem`` the group's h memory.  The step layer owns the recurrent
+    [size, 3*size] weight (reset-gate coupling prevents hoisting it)."""
+    if size is None:
+        size = input.size // 3
+    return _nn.gru_step(input, out_mem, size, act=act, gate_act=gate_act,
+                        param_attr=gru_param_attr, bias_attr=gru_bias_attr,
+                        name=name)
+
+
+def gru_group(input, size=None, *, reverse=False, act="tanh",
+              gate_act="sigmoid", gru_param_attr=None, gru_bias_attr=True,
+              name=None):
+    """Recurrent-group GRU (networks.py:860-925); ``input`` is the
+    [B, T, 3*size] pre-projection."""
+    name = name or _nn.layer.next_name("gru_group")
+    if size is None:
+        size = input.size // 3
+
+    def _step(ipt, om):
+        h = gru_unit(ipt, om, size=size, act=act, gate_act=gate_act,
+                     gru_param_attr=gru_param_attr,
+                     gru_bias_attr=gru_bias_attr, name=name)
+        return [h, h]
+
+    return _nn.recurrent_group(
+        step=_step, input=[input], memories=[_nn.Memory(f"{name}_out", size)],
+        reverse=reverse, name=f"{name}_recurrent_group")
 
 
 def bidirectional_lstm(input, size, *, return_unmerged=False, name=None):

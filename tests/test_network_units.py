@@ -1,0 +1,210 @@
+"""Step-level RNN building blocks + VGG network helpers — analog of the
+reference's networks.py composition tests (test_NetworkCompare on
+lstmemory_group vs lstmemory; SURVEY.md §4 equivalence-test pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.v2.networks as networks
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+def _mask_out(act):
+    v = np.asarray(act.value)
+    m = np.asarray(act.mask)[..., None]
+    return v * m
+
+
+def test_lstmemory_group_equals_lstmemory(rng):
+    """lstmemory_group (mixed + lstm_step inside a recurrent_group) must equal
+    the fused lstmemory given identical weights — the reference's
+    test_NetworkCompare claim that both impls do 'exactly the same
+    calculation' (networks.py:725)."""
+    D, H, B, T = 5, 4, 3, 6
+    x = nn.data("x", size=D, is_seq=True)
+    flat = nn.lstmemory(x, H, name="flat")
+    proj = nn.fc(x, 4 * H, act="linear", bias_attr=False, name="proj")
+    grp = networks.lstmemory_group(proj, H, name="lg")
+    topo = nn.Topology([flat, grp])
+    params, state = topo.init(jax.random.PRNGKey(0))
+
+    # one set of weights drives both paths
+    params = dict(params)
+    params["_proj.w0"] = params["_flat.wx"]
+    params["_lg_input_recurrent.w1"] = params["_flat.w0"]
+    params["_lg.wbias"] = params["_flat.wbias"]
+
+    xs = rng.randn(B, T, D).astype(np.float32)
+    lengths = np.array([T, 4, 2], np.int32)
+    outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
+    np.testing.assert_allclose(_mask_out(outs["flat"]),
+                               _mask_out(outs["lg_recurrent_group"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_group_equals_grumemory(rng):
+    D, H, B, T = 6, 5, 2, 5
+    x = nn.data("x", size=D, is_seq=True)
+    flat = nn.grumemory(x, H, name="flat")
+    proj = nn.fc(x, 3 * H, act="linear", bias_attr=False, name="proj")
+    grp = networks.gru_group(proj, H, name="gg")
+    topo = nn.Topology([flat, grp])
+    params, state = topo.init(jax.random.PRNGKey(0))
+
+    params = dict(params)
+    params["_proj.w0"] = params["_flat.wx"]
+    params["_gg.w0"] = params["_flat.w0"]
+    params["_gg.wbias"] = params["_flat.wbias"]
+
+    xs = rng.randn(B, T, D).astype(np.float32)
+    lengths = np.array([T, 3], np.int32)
+    outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
+    np.testing.assert_allclose(_mask_out(outs["flat"]),
+                               _mask_out(outs["gg_recurrent_group"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_simple_gru2_equals_grumemory(rng):
+    """simple_gru2's split layout (transform [D,3H] + cell [H,3H]) computes
+    the same function as the fused grumemory with folded weights
+    (reference networks.py:1015: 'same with simple_gru, but using
+    grumemory')."""
+    D, H, B, T = 5, 4, 2, 6
+    x = nn.data("x", size=D, is_seq=True)
+    flat = nn.grumemory(x, H, name="flat")
+    g2 = networks.simple_gru2(x, H, name="g2")
+    topo = nn.Topology([flat, g2])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    params = dict(params)
+    params["_g2_transform.w0"] = params["_flat.wx"]
+    params["_g2_transform.wbias"] = np.zeros(3 * H, np.float32)
+    params["_g2.w0"] = params["_flat.w0"]
+    params["_g2.wbias"] = params["_flat.wbias"]
+    xs = rng.randn(B, T, D).astype(np.float32)
+    outs, _ = topo.apply(params, state, {"x": (xs, np.array([T, 3], np.int32))})
+    np.testing.assert_allclose(_mask_out(outs["flat"]), _mask_out(outs["g2"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstmemory_projected_input_equals_owned(rng):
+    """lstmemory(projected_input=True) over an explicit 4H projection equals
+    the wx-owning lstmemory — pins the reference input convention."""
+    D, H, B, T = 4, 3, 2, 5
+    x = nn.data("x", size=D, is_seq=True)
+    flat = nn.lstmemory(x, H, name="flat")
+    proj = nn.fc(x, 4 * H, act="linear", bias_attr=False, name="proj")
+    pi = nn.lstmemory(proj, H, projected_input=True, name="pi")
+    topo = nn.Topology([flat, pi])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    params = dict(params)
+    params["_proj.w0"] = params["_flat.wx"]
+    params["_pi.w0"] = params["_flat.w0"]
+    params["_pi.wbias"] = params["_flat.wbias"]
+    xs = rng.randn(B, T, D).astype(np.float32)
+    outs, _ = topo.apply(params, state, {"x": (xs, np.array([T, 2], np.int32))})
+    np.testing.assert_allclose(_mask_out(outs["flat"]), _mask_out(outs["pi"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_group_reverse_matches_flat(rng):
+    D, H, B, T = 4, 3, 2, 5
+    x = nn.data("x", size=D, is_seq=True)
+    flat = nn.grumemory(x, H, reverse=True, name="flat")
+    proj = nn.fc(x, 3 * H, act="linear", bias_attr=False, name="proj")
+    grp = networks.gru_group(proj, H, reverse=True, name="gg")
+    topo = nn.Topology([flat, grp])
+    params, state = topo.init(jax.random.PRNGKey(0))
+    params = dict(params)
+    params["_proj.w0"] = params["_flat.wx"]
+    params["_gg.w0"] = params["_flat.w0"]
+    params["_gg.wbias"] = params["_flat.wbias"]
+    xs = rng.randn(B, T, D).astype(np.float32)
+    lengths = np.array([T, 3], np.int32)
+    outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
+    np.testing.assert_allclose(_mask_out(outs["flat"]),
+                               _mask_out(outs["gg_recurrent_group"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstmemory_unit_in_custom_step(rng):
+    """lstmemory_unit composes inside a user-written recurrent_group step —
+    the attention-decoder pattern the reference documents it for — and the
+    cell state round-trips through get_output."""
+    D, H = 4, 3
+    x = nn.data("x", size=D, is_seq=True)
+    proj = nn.fc(x, 4 * H, act="linear", bias_attr=False, name="proj")
+
+    def step(ipt, om, sm):
+        h = networks.lstmemory_unit(ipt, om, sm, size=H, name="u")
+        c = nn.get_output(h, "state", size=H)
+        return [h, h, c]
+
+    grp = nn.recurrent_group(step, input=[proj],
+                             memories=[nn.Memory("h", H), nn.Memory("c", H)],
+                             name="g")
+    cost = nn.mse_cost(nn.pooling(grp, pooling_type="avg"),
+                       nn.data("y", size=H), name="cost")
+    topo = nn.Topology(cost)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    xs = rng.randn(2, 5, D).astype(np.float32)
+    feeds = {"x": (xs, np.array([5, 3], np.int32)),
+             "y": rng.randn(2, H).astype(np.float32)}
+
+    def loss(p):
+        outs, _ = topo.apply(p, state, feeds)
+        return outs["cost"].value
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    g = grads["_u_input_recurrent.w1"]
+    assert np.abs(np.asarray(g)).sum() > 0  # recurrent weight got gradient
+
+
+def test_gru_unit_sizes_validated():
+    x = nn.data("x", size=7)
+    h = nn.data("h", size=2)
+    with pytest.raises(Exception):
+        nn.gru_step(x, h)  # 7 not divisible by 3
+
+
+def test_img_conv_bn_pool_shape(rng):
+    img = nn.data("pixel", size=3, height=16, width=16)
+    out = networks.img_conv_bn_pool(img, filter_size=3, num_filters=8,
+                                    pool_size=2, conv_padding=1,
+                                    pool_stride=2, name="blk")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    outs, _ = topo.apply(params, state,
+                         {"pixel": rng.rand(2, 16, 16, 3).astype(np.float32)})
+    assert outs[out.name].value.shape == (2, 8, 8, 8)
+
+
+def test_small_vgg_forward(rng):
+    img = nn.data("pixel", size=3, height=32, width=32)
+    out = networks.small_vgg(img, num_classes=10, name="vgg_out")
+    topo = nn.Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    outs, _ = topo.apply(params, state,
+                         {"pixel": rng.rand(2, 32, 32, 3).astype(np.float32)})
+    p = np.asarray(outs["vgg_out"].value)
+    assert p.shape == (2, 10)
+    np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-4)  # softmax head
+
+
+def test_vgg16_param_shapes():
+    img = nn.data("pixel", size=3, height=32, width=32)
+    out = networks.vgg_16_network(img, num_classes=4, name="v16")
+    topo = nn.Topology(out)
+    # 13 convs + 3 fcs as in the canonical VGG-16
+    conv_ws = [s for s in topo.param_specs.values() if len(s.shape) == 4]
+    assert len(conv_ws) == 13
+    assert conv_ws[0].shape == (3, 3, 3, 64)
+    assert conv_ws[-1].shape == (3, 3, 512, 512)

@@ -65,11 +65,18 @@ def googlenet(*, num_classes: int = 1000, height: int = 224, width: int = 224):
     img = nn.data("pixel", size=3, height=height, width=width)
     label = nn.data("label", size=1, dtype="int32")
 
-    net = nn.img_conv(img, filter_size=7, num_filters=64, stride=2, padding=3)
-    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 56
+    # stem relus ride AFTER their stride-2 max pools (identical function —
+    # relu commutes with max — but the elementwise pass runs on the 4x
+    # smaller map; see img_pool act=)
+    net = nn.img_conv(img, filter_size=7, num_filters=64, stride=2, padding=3,
+                      act="linear")
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME",
+                      act="relu")  # ceil: 56
     net = nn.img_conv(net, filter_size=1, num_filters=64, padding=0)
-    net = nn.img_conv(net, filter_size=3, num_filters=192, padding=1)
-    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME")  # ceil: 28
+    net = nn.img_conv(net, filter_size=3, num_filters=192, padding=1,
+                      act="linear")
+    net = nn.img_pool(net, pool_size=3, stride=2, padding="SAME",
+                      act="relu")  # ceil: 28
 
     net = _inception(net, 64, 96, 128, 16, 32, 32)     # 3a -> 256
     net = _inception(net, 128, 128, 192, 32, 96, 64)   # 3b -> 480

@@ -59,6 +59,10 @@ from paddle_tpu.ops.sparse import (
     sparse_gather_matmul,
     sparse_to_dense,
     selective_columns_matmul,
+    CsrMatrix,
+    CscMatrix,
+    csr_matmul,
+    matmul_dense_csc,
 )
 from paddle_tpu.ops.crf import crf_log_likelihood, crf_nll, crf_decode
 from paddle_tpu.ops.ctc import ctc_loss

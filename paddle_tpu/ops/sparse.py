@@ -24,9 +24,11 @@ update path (``ParamAttr(sparse_grad=True)``).
 
 from __future__ import annotations
 
-from typing import Optional
+from dataclasses import dataclass
+from typing import Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.ops.matmul import linear
 from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
@@ -35,6 +37,10 @@ __all__ = [
     "sparse_gather_matmul",
     "sparse_to_dense",
     "selective_columns_matmul",
+    "CsrMatrix",
+    "CscMatrix",
+    "csr_matmul",
+    "matmul_dense_csc",
 ]
 
 
@@ -81,4 +87,169 @@ def selective_columns_matmul(x, sel_ids, w, b=None, sel_mask: Optional[jnp.ndarr
         out = out + jnp.take(b, sel_ids, axis=0).astype(out.dtype)
     if sel_mask is not None:
         out = out * sel_mask.astype(out.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CSR / CSC matrices
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CsrMatrix:
+    """Compressed-sparse-row matrix — the CpuSparseMatrix/GpuSparseMatrix
+    analog (reference: paddle/math/CpuSparseMatrix.h:36, SparseMatrix.h;
+    hl_sparse.h CSR family).
+
+    Host-side representation: numpy ``indptr`` [R+1], ``indices`` [nnz],
+    ``data`` [nnz] (``data=None`` = binary/NO_VALUE format, all ones — the
+    reference's SPARSE_CSR vs SPARSE_CSR_VALUE distinction).  Compute happens
+    on device through ``to_padded()``: CSR's ragged rows are re-laid-out as
+    fixed-width padded rows (ELL) so XLA keeps static shapes — the TPU-native
+    answer to the reference's hand-written ragged CUDA kernels.
+    """
+
+    shape: tuple
+    indptr: np.ndarray
+    indices: np.ndarray
+    data: Optional[np.ndarray] = None
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @classmethod
+    def from_rows(cls, rows: Sequence, ncols: int, *, binary: bool = False):
+        """Build from per-row entries: id lists (binary) or (id, value)
+        pairs — the PyDataProvider2 sparse_binary/float_vector slot formats
+        (reference: python/paddle/trainer/PyDataProvider2.py:83-120)."""
+        indptr = np.zeros(len(rows) + 1, np.int64)
+        ids, vals = [], []
+        for i, row in enumerate(rows):
+            row = list(row)
+            indptr[i + 1] = indptr[i] + len(row)
+            if binary:
+                ids.extend(int(j) for j in row)
+            else:
+                for j, v in row:
+                    ids.append(int(j))
+                    vals.append(float(v))
+        indices = np.asarray(ids, np.int32)
+        data = None if binary else np.asarray(vals, np.float32)
+        return cls((len(rows), ncols), indptr, indices, data)
+
+    @classmethod
+    def from_dense(cls, a) -> "CsrMatrix":
+        a = np.asarray(a)
+        mask = a != 0
+        indptr = np.zeros(a.shape[0] + 1, np.int64)
+        np.cumsum(mask.sum(1), out=indptr[1:])
+        indices = np.nonzero(mask)[1].astype(np.int32)
+        return cls(a.shape, indptr, indices, a[mask].astype(np.float32))
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.shape, np.float32)
+        vals = self.data if self.data is not None else np.ones(self.nnz, np.float32)
+        for i in range(self.shape[0]):
+            lo, hi = self.indptr[i], self.indptr[i + 1]
+            np.add.at(out[i], self.indices[lo:hi], vals[lo:hi])
+        return out
+
+    def to_padded(self, width: Optional[int] = None):
+        """Re-lay out as padded rows: (ids [R, N], weights [R, N],
+        mask [R, N]) numpy arrays ready to feed ``sparse_gather_matmul``.
+        N defaults to the max row nnz (>=1); an explicit ``width`` smaller
+        than a row's nnz is an error (silent truncation would corrupt the
+        product)."""
+        counts = np.diff(self.indptr)
+        max_nnz = int(counts.max(initial=0))
+        if width is not None and width < max_nnz:
+            raise ValueError(
+                f"to_padded(width={width}) would drop entries: a row has "
+                f"{max_nnz} nonzeros")
+        N = int(width or max(max_nnz, 1))
+        R = self.shape[0]
+        ids = np.zeros((R, N), np.int32)
+        weights = np.zeros((R, N), np.float32)
+        mask = np.zeros((R, N), np.float32)
+        vals = self.data if self.data is not None else np.ones(self.nnz, np.float32)
+        for i in range(R):
+            lo, hi = int(self.indptr[i]), int(self.indptr[i + 1])
+            n = min(hi - lo, N)
+            ids[i, :n] = self.indices[lo : lo + n]
+            weights[i, :n] = vals[lo : lo + n]
+            mask[i, :n] = 1.0
+        return ids, weights, mask
+
+    def transpose(self) -> "CscMatrix":
+        """O(1) view change: CSR of M is CSC of M^T (hl_sparse's
+        CSR<->CSC duality)."""
+        return CscMatrix((self.shape[1], self.shape[0]), self.indptr,
+                         self.indices, self.data)
+
+    @property
+    def T(self) -> "CscMatrix":
+        return self.transpose()
+
+
+@dataclass(frozen=True)
+class CscMatrix:
+    """Compressed-sparse-column matrix: ``indptr`` [C+1] over columns,
+    ``indices`` row ids.  Stored exactly as the CSR of its transpose."""
+
+    shape: tuple
+    indptr: np.ndarray
+    indices: np.ndarray
+    data: Optional[np.ndarray] = None
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @classmethod
+    def from_dense(cls, a) -> "CscMatrix":
+        return CsrMatrix.from_dense(np.asarray(a).T).transpose()
+
+    def to_dense(self) -> np.ndarray:
+        return self.to_csr_of_transpose().to_dense().T
+
+    def to_csr_of_transpose(self) -> CsrMatrix:
+        return CsrMatrix((self.shape[1], self.shape[0]), self.indptr,
+                         self.indices, self.data)
+
+    def transpose(self) -> CsrMatrix:
+        return self.to_csr_of_transpose()
+
+    @property
+    def T(self) -> CsrMatrix:
+        return self.transpose()
+
+
+def csr_matmul(m: CsrMatrix, dense, b=None):
+    """General sparse x dense: CSR [R, C] x dense [C, D] -> [R, D] — the
+    hl_matrix_csr_mul_dense analog (reference: paddle/cuda/include/hl_sparse.h;
+    CpuSparseMatrix used as fc input, Matrix::mul dispatch).
+
+    The padded re-layout happens host-side once; the device computation is
+    gather + weighted reduction on the MXU, whose autodiff transpose is the
+    row-sparse scatter the reference hand-writes for the backward."""
+    ids, weights, mask = m.to_padded()
+    return sparse_gather_matmul(jnp.asarray(ids), jnp.asarray(weights),
+                                jnp.asarray(mask), dense, b)
+
+
+def matmul_dense_csc(x, m: CscMatrix, b=None):
+    """dense x sparse: x [B, R] x CSC [R, C] -> [B, C] — the
+    hl_matrix_dense_mul_csc analog (sparse weight matrices, e.g. a pruned
+    output projection).
+
+    out[:, j] = sum_n w[j, n] * x[:, row_ids[j, n]]: gather x columns by the
+    per-output-column row lists, weight, reduce."""
+    ids, weights, mask = m.to_csr_of_transpose().to_padded()  # [C, N] over rows of x
+    cols = jnp.take(x, jnp.asarray(ids), axis=1)             # [B, C, N]
+    coef = jnp.asarray(weights * mask)
+    cols, coef = mxu_cast(cols, coef)
+    out = jnp.einsum("bcn,cn->bc", cols, coef).astype(acc_dtype())
+    if b is not None:
+        out = out + b.astype(out.dtype)
     return out

@@ -1,0 +1,117 @@
+"""CSR/CSC sparse matrix tier — analog of the reference's
+test_SparseMatrix / test_sparseMatrixCompare (SURVEY.md §4): format
+round-trips, sparse x dense products vs dense reference, gradient flow
+through the sparse fc path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+import paddle_tpu.ops as O
+
+
+def _rand_sparse(rng, R, C, density=0.3):
+    a = rng.randn(R, C).astype(np.float32)
+    a[rng.rand(R, C) >= density] = 0.0
+    return a
+
+
+def test_csr_round_trip(rng):
+    a = _rand_sparse(rng, 7, 11)
+    m = O.CsrMatrix.from_dense(a)
+    assert m.shape == (7, 11)
+    assert m.nnz == int((a != 0).sum())
+    np.testing.assert_array_equal(m.to_dense(), a)
+
+
+def test_csr_from_rows_binary_and_float():
+    mb = O.CsrMatrix.from_rows([[0, 2], [1], []], 4, binary=True)
+    np.testing.assert_array_equal(
+        mb.to_dense(),
+        [[1, 0, 1, 0], [0, 1, 0, 0], [0, 0, 0, 0]])
+    mf = O.CsrMatrix.from_rows([[(0, 0.5), (3, 2.0)], [(1, -1.0)]], 4)
+    np.testing.assert_allclose(
+        mf.to_dense(),
+        [[0.5, 0, 0, 2.0], [0, -1.0, 0, 0]])
+    # duplicate ids accumulate (COO semantics, matching sparse_to_dense)
+    md = O.CsrMatrix.from_rows([[(2, 1.0), (2, 3.0)]], 4)
+    np.testing.assert_allclose(md.to_dense(), [[0, 0, 4.0, 0]])
+
+
+def test_csc_round_trip_and_transpose(rng):
+    a = _rand_sparse(rng, 5, 8)
+    c = O.CscMatrix.from_dense(a)
+    np.testing.assert_array_equal(c.to_dense(), a)
+    np.testing.assert_array_equal(c.T.to_dense(), a.T)
+    m = O.CsrMatrix.from_dense(a)
+    np.testing.assert_array_equal(m.T.to_dense(), a.T)
+
+
+def test_csr_matmul_equals_dense(rng):
+    a = _rand_sparse(rng, 6, 9)
+    w = rng.randn(9, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    got = np.asarray(O.csr_matmul(O.CsrMatrix.from_dense(a), jnp.asarray(w),
+                                  jnp.asarray(b)))
+    np.testing.assert_allclose(got, a @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_csr_matmul_empty_rows(rng):
+    a = np.zeros((3, 5), np.float32)
+    a[1, 2] = 2.0
+    w = rng.randn(5, 3).astype(np.float32)
+    got = np.asarray(O.csr_matmul(O.CsrMatrix.from_dense(a), jnp.asarray(w)))
+    np.testing.assert_allclose(got, a @ w, rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_dense_csc_equals_dense(rng):
+    x = rng.randn(4, 6).astype(np.float32)
+    wd = _rand_sparse(rng, 6, 5)
+    got = np.asarray(O.matmul_dense_csc(jnp.asarray(x),
+                                        O.CscMatrix.from_dense(wd)))
+    np.testing.assert_allclose(got, x @ wd, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_fc_grad_touches_only_gathered_rows(rng):
+    """The autodiff transpose of the gather-matmul is the row-sparse
+    scatter: untouched vocabulary rows get exactly zero weight gradient
+    (SparseRowCpuMatrix::addTo semantics)."""
+    V, D = 10, 3
+    m = O.CsrMatrix.from_rows([[1, 4], [4, 7]], V, binary=True)
+    ids, weights, mask = (jnp.asarray(v) for v in m.to_padded())
+    w = jnp.asarray(rng.randn(V, D).astype(np.float32))
+
+    def loss(w):
+        return O.sparse_gather_matmul(ids, weights, mask, w).sum()
+
+    g = np.asarray(jax.grad(loss)(w))
+    touched = sorted({1, 4, 7})
+    for r in range(V):
+        if r in touched:
+            assert np.abs(g[r]).sum() > 0
+        else:
+            np.testing.assert_array_equal(g[r], 0.0)
+
+
+def test_feeder_sparse_csr_equivalence(rng):
+    """DataFeeder's padded sparse slots and the CSR path compute the same
+    fc output — the CSR-vs-dense pass the verdict asked to pin."""
+    from paddle_tpu.data.feeder import DataFeeder
+
+    V = 12
+    rows = [([0, 3, 7], 1), ([5], 0), ([2, 3], 1)]
+    feeder = DataFeeder({"words": "sparse_ids", "label": "int"})
+    feed = feeder(rows)
+    ids, nnz = feed["words"]
+    w = jnp.asarray(rng.randn(V, 4).astype(np.float32))
+    mask = np.asarray(np.arange(ids.shape[1])[None, :] < nnz[:, None],
+                      np.float32)
+    got = np.asarray(O.sparse_gather_matmul(
+        jnp.asarray(ids), jnp.asarray(np.ones_like(mask)), jnp.asarray(mask), w))
+    csr = O.CsrMatrix.from_rows([r[0] for r in rows], V, binary=True)
+    want = np.asarray(O.csr_matmul(csr, w))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    dense = csr.to_dense() @ np.asarray(w)
+    np.testing.assert_allclose(got, dense, rtol=1e-4, atol=1e-4)

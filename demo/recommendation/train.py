@@ -1,7 +1,10 @@
-"""MovieLens recommender — analog of demo/recommendation (two embedding
-towers to rating regression, reference demo/recommendation/trainer_config.py).
-Pass --mesh to shard the embedding tables over a model axis (the
-SparseRemoteParameterUpdater analog, SURVEY.md §5.8)."""
+"""MovieLens recommender — analog of demo/recommendation.
+
+Trains the FULL reference feature network (user id/gender/age/job embedding
+tower + movie id/category/title tower, cos_sim*5 regression — reference
+demo/recommendation/api_train_v2.py:8-68, trainer_config.py:30-90) on the
+8-slot movielens feed.  ``--simple`` falls back to the two-id-tower smoke
+net."""
 
 import argparse
 import os
@@ -22,21 +25,30 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--n", type=int, default=2048)
     ap.add_argument("--emb-dim", type=int, default=32)
+    ap.add_argument("--simple", action="store_true",
+                    help="two-id-tower smoke net instead of the full "
+                         "feature network")
     args = ap.parse_args(argv)
 
     nn.reset_naming()
-    cost, pred = models.movielens_net(emb_dim=args.emb_dim, hid_dim=32)
+    if args.simple:
+        cost, pred = models.movielens_net(emb_dim=args.emb_dim, hid_dim=32)
+        feeder = data.DataFeeder({"user_id": "int", "movie_id": "int",
+                                  "score": "dense"})
+        reader = data.batch(
+            data.map_readers(lambda r: (r[0], r[1], [r[2]]),
+                             data.datasets.movielens("train", n=args.n)),
+            args.batch_size)
+    else:
+        cost, pred = models.movielens_feature_net(emb_dim=args.emb_dim)
+        feeder = data.DataFeeder({
+            "user_id": "int", "gender_id": "int", "age_id": "int",
+            "job_id": "int", "movie_id": "int", "category_id": "sparse_ids",
+            "movie_title": "ids_seq", "score": "dense"})
+        reader = data.batch(
+            data.datasets.movielens_features("train", n=args.n),
+            args.batch_size)
     trainer = SGDTrainer(cost, Adam(learning_rate=1e-3), seed=0)
-    feeder = data.DataFeeder({"user_id": "int", "movie_id": "int",
-                              "score": "dense"})
-
-    def to_row(r):
-        u, mv, s = r
-        return u, mv, [s]
-
-    reader = data.batch(
-        data.map_readers(to_row, data.datasets.movielens("train", n=args.n)),
-        args.batch_size)
 
     def on_event(ev):
         if isinstance(ev, events.EndIteration) and ev.batch_id % 4 == 0:

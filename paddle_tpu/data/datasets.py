@@ -18,8 +18,9 @@ from typing import Callable, Iterator, List, Tuple
 
 import numpy as np
 
-__all__ = ["mnist", "cifar10", "imdb", "wmt14", "movielens", "uci_housing",
-           "imikolov", "conll05", "sentiment"]
+__all__ = ["mnist", "cifar10", "imdb", "wmt14", "movielens",
+           "movielens_features", "uci_housing", "imikolov", "conll05",
+           "conll05_features", "sentiment"]
 
 DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
 
@@ -136,6 +137,50 @@ def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3706
     return synth_reader
 
 
+# ml-1m schema constants (reference: python/paddle/v2/dataset/movielens.py:
+# max_user_id 6040, max_movie_id 3952, age_table 7 buckets, max_job_id 20,
+# movie_categories 18, title dict ~5175)
+ML_SCHEMA = dict(n_users=6040, n_movies=3952, n_genders=2, n_ages=7,
+                 n_jobs=21, n_categories=18, title_dict=5175)
+
+
+def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
+    """Yields the 8-slot full-feature rows of the reference MovieLens demo
+    (reference: python/paddle/v2/dataset/movielens.py train()/test() yield
+    user.value() + movie.value() + [rating]): (user_id, gender_id, age_id,
+    job_id, movie_id, category_ids list, title_ids list, [score]).
+
+    Synthetic with ml-1m cardinalities; rating correlates with latent
+    user/movie vectors plus a genre affinity so every feature is
+    informative."""
+    S = ML_SCHEMA
+
+    def synth_reader():
+        rng = _synth_rng("movielens_features", split)
+        nu, nm = S["n_users"], S["n_movies"]
+        u_vec = rng.randn(nu, 8)
+        m_vec = rng.randn(nm, 8)
+        u_meta = np.stack([rng.randint(0, S["n_genders"], nu),
+                           rng.randint(0, S["n_ages"], nu),
+                           rng.randint(0, S["n_jobs"], nu)], 1)
+        genre_aff = rng.randn(S["n_genders"], S["n_categories"]) * 0.3
+        for _ in range(n):
+            u = rng.randint(0, nu)
+            m = rng.randint(0, nm)
+            cats = sorted(rng.choice(S["n_categories"],
+                                     size=rng.randint(1, 4), replace=False))
+            title = rng.randint(3, S["title_dict"],
+                                rng.randint(2, 9)).tolist()
+            g = u_meta[u, 0]
+            r = (3.0 + 0.4 * float(u_vec[u] @ m_vec[m])
+                 + float(np.mean(genre_aff[g, cats])))
+            score = float(np.clip(r + rng.randn() * 0.2, 1.0, 5.0))
+            yield (int(u), int(g), int(u_meta[u, 1]), int(u_meta[u, 2]),
+                   int(m), [int(c) for c in cats], title, [score])
+
+    return synth_reader
+
+
 def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
              n: int = 4096) -> Callable:
     """Yields n-gram tuples (w0..w{n-2}, next_word) — the word2vec /
@@ -175,6 +220,34 @@ def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
             # tagger has learnable structure
             labels = [min(n_labels - 1, abs(i - pred_pos) % n_labels) for i in range(L)]
             yield words, words[pred_pos], labels
+
+    return synth_reader
+
+
+def conll05_features(split: str = "train", *, vocab_size: int = 5000,
+                     n_labels: int = 67, n: int = 1024) -> Callable:
+    """Yields the reference's full 9-slot SRL rows (reference:
+    python/paddle/v2/dataset/conll05.py reader_creator — word_slot,
+    ctx_n2/ctx_n1/ctx_0/ctx_p1/ctx_p2 slots (predicate-window words repeated
+    per token), predicate slot (repeated), mark slot (1 inside the predicate
+    span), label_slot)."""
+
+    def synth_reader():
+        rng = _synth_rng("conll05_features", split)
+        for _ in range(n):
+            L = rng.randint(5, 40)
+            words = rng.randint(2, vocab_size, L).tolist()
+            p = rng.randint(0, L)
+
+            def at(i):
+                return words[min(max(i, 0), L - 1)]
+
+            ctx = {d: [at(p + d)] * L for d in (-2, -1, 0, 1, 2)}
+            verb = [words[p]] * L
+            mark = [1 if i == p else 0 for i in range(L)]
+            labels = [min(n_labels - 1, abs(i - p) % n_labels) for i in range(L)]
+            yield (words, ctx[-2], ctx[-1], ctx[0], ctx[1], ctx[2], verb,
+                   mark, labels)
 
     return synth_reader
 

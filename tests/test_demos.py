@@ -17,16 +17,28 @@ CASES = [
      ["--passes", "1", "--n", "32", "--batch-size", "8", "--model", "alexnet"]),
     ("quick_start", ["--passes", "1", "--n", "64", "--config", "lr"]),
     ("quick_start", ["--passes", "1", "--n", "64", "--config", "cnn"]),
+    ("quick_start", ["--passes", "1", "--n", "32", "--config", "bidi-lstm",
+                     "--hid-dim", "16", "--batch-size", "8"]),
+    ("quick_start", ["--passes", "1", "--n", "32", "--config", "db-lstm",
+                     "--hid-dim", "16", "--batch-size", "8"]),
+    ("quick_start", ["--passes", "1", "--n", "32", "--config", "resnet-lstm",
+                     "--hid-dim", "16", "--batch-size", "8"]),
     ("sentiment", ["--passes", "1", "--n", "64", "--vocab", "200",
                    "--emb-dim", "16", "--hid-dim", "16", "--stacked-num", "1"]),
     ("seqToseq", ["--passes", "1", "--n", "32", "--batch-size", "8",
                   "--dict-size", "100", "--emb-dim", "16", "--hid-dim", "16",
                   "--generate"]),
     ("recommendation", ["--passes", "1", "--n", "256", "--batch-size", "64"]),
+    ("recommendation", ["--passes", "1", "--n", "128", "--batch-size", "32",
+                        "--simple"]),
     ("word2vec", ["--passes", "1", "--n", "256", "--vocab", "100",
                   "--output", "hsigmoid"]),
     ("semantic_role_labeling", ["--passes", "1", "--n", "32",
-                                "--vocab", "100", "--batch-size", "8"]),
+                                "--vocab", "100", "--batch-size", "8",
+                                "--hidden-dim", "32", "--depth", "3"]),
+    ("semantic_role_labeling", ["--passes", "1", "--n", "32",
+                                "--vocab", "100", "--batch-size", "8",
+                                "--simple"]),
     ("sequence_tagging", ["--passes", "1", "--n", "32", "--vocab", "100",
                           "--batch-size", "8"]),
     ("gan", ["--steps", "20", "--batch-size", "32"]),

@@ -156,8 +156,11 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
         )
         kwargs = dict(cfg["kwargs"])
         # force the recorded name so replay regenerates identical
-        # node/parameter names even if it was auto-generated
-        if cfg["out"] == -1:
+        # node/parameter names even if it was auto-generated.  Composite
+        # helpers (lstmemory_group, ...) derive their node name FROM the
+        # passed name, so an explicitly recorded name must stay untouched —
+        # overwriting it with node.name would double the derived suffix.
+        if cfg["out"] == -1 and kwargs.get("name") is None:
             kwargs["name"] = node.name
         lc.config_json = _canonical_json(
             {k: _encode(v, node.name) for k, v in kwargs.items()}
@@ -188,12 +191,18 @@ def dump_model_config(topology: Topology, name: str = "model") -> pb.ModelConfig
     return mc
 
 
-#: cost-layer constructors covered by the CostConf typed contract
+#: cost-layer constructors covered by the generic CostConf typed contract
+#: (crf/ctc/nce/hsigmoid get their own richer typed confs)
 _COST_TYPES = frozenset({
     "classification_cost", "cross_entropy_cost", "soft_cross_entropy_cost",
     "cross_entropy_with_selfnorm", "mse_cost", "huber_cost", "smooth_l1_cost",
     "multi_binary_label_cross_entropy", "sum_cost", "rank_cost", "lambda_cost",
-    "crf_cost", "ctc_cost", "nce_cost", "hsigmoid_cost",
+})
+
+#: sequence-structure ops covered by SeqOpConf
+_SEQ_OPS = frozenset({
+    "pooling", "last_seq", "first_seq", "expand", "seq_reverse",
+    "seq_concat", "context_projection",
 })
 
 
@@ -239,6 +248,78 @@ def _fill_typed(lc, node, kwargs: Dict[str, Any]) -> None:
     elif t == "embedding":
         lc.embedding.emb_dim = int(node.size)
         lc.embedding.vocab_size = int(kwargs.get("vocab_size") or 0)
+    elif t in _SEQ_OPS:
+        lc.seq.op = t
+        if t == "pooling":
+            lc.seq.pooling_type = str(kwargs.get("pooling_type", "max"))
+        if t == "context_projection":
+            clen = int(kwargs.get("context_len", 3))
+            lc.seq.context_len = clen
+            cs = kwargs.get("context_start")
+            lc.seq.context_start = int(cs if cs is not None else -(clen // 2))
+    elif t == "dropout":
+        lc.dropout.rate = float(kwargs.get("rate", kwargs.get("dropout_rate", 0.5)))
+    elif t in ("addto", "concat"):
+        lc.elem.op = t
+        lc.elem.act = str(kwargs.get("act", "linear"))
+    elif t == "img_cmrnorm":
+        lc.norm.size = int(kwargs.get("size", 5))
+        lc.norm.scale = float(kwargs.get("scale", 1e-4))
+        lc.norm.power = float(kwargs.get("power", 0.75))
+    elif t == "crf_cost":
+        lc.crf.num_classes = int(kwargs.get("size") or node.parents[0].size)
+    elif t == "ctc_cost":
+        lc.ctc.num_classes = int(node.parents[0].size)
+        lc.ctc.blank = int(kwargs.get("blank", 0))
+    elif t in ("nce_cost", "hsigmoid_cost"):
+        lc.sampled_cost.cost_type = t
+        lc.sampled_cost.num_classes = int(
+            kwargs.get("num_classes") or kwargs.get("size") or 0)
+        if t == "nce_cost":
+            lc.sampled_cost.num_neg_samples = int(
+                kwargs.get("num_neg_samples", 10))
+    elif t == "mixed":
+        lc.mixed.size = int(node.size)
+        lc.mixed.act = str(kwargs.get("act", "linear"))
+        lc.mixed.has_bias = _has_bias({"bias_attr": kwargs.get("bias_attr",
+                                                               False)})
+        projs = kwargs.get("input") or []
+        if not isinstance(projs, (list, tuple)):
+            projs = [projs]
+        for p in projs:
+            cfg = getattr(p, "config", None) or {}
+            pk = cfg.get("kwargs", {})
+            pc = lc.mixed.projections.add(
+                kind=cfg.get("fn", p.kind).replace("_projection", "")
+                .replace("context_input", "context"))
+            if pk.get("size"):
+                pc.size = int(pk["size"])
+            off = pk.get("offset")
+            pc.offset = int(off) if off is not None else -1
+            if "context_len" in pk:
+                pc.context_len = int(pk["context_len"])
+                cs = pk.get("context_start")
+                pc.context_start = int(
+                    cs if cs is not None else -(pc.context_len // 2))
+            for fld in ("filter_size", "num_filters", "stride"):
+                if pk.get(fld) is not None:
+                    setattr(pc, fld, int(pk[fld]))
+            if pk.get("padding") is not None:
+                pc.padding = str(pk["padding"])
+    elif t in ("lstmemory_group", "gru_group"):
+        lc.group_rnn.cell = "lstm" if t == "lstmemory_group" else "gru"
+        lc.group_rnn.size = int(node.size)
+        lc.group_rnn.act = str(kwargs.get("act", "tanh"))
+        lc.group_rnn.gate_act = str(kwargs.get("gate_act", "sigmoid"))
+        if t == "lstmemory_group":
+            lc.group_rnn.state_act = str(kwargs.get("state_act", "tanh"))
+        lc.group_rnn.reverse = bool(kwargs.get("reverse", False))
+    elif t in ("lstm_step", "gru_step"):
+        lc.step.size = int(node.size)
+        lc.step.act = str(kwargs.get("act", "tanh"))
+        lc.step.gate_act = str(kwargs.get("gate_act", "sigmoid"))
+        if t == "lstm_step":
+            lc.step.state_act = str(kwargs.get("state_act", "tanh"))
     elif t in _COST_TYPES:
         lc.cost.cost_type = t
 
@@ -271,6 +352,28 @@ def _check_typed(lc, node) -> None:
         raise ConfigError(
             f"layer {lc.name!r}: typed cost_type={lc.cost.cost_type!r} != "
             f"type={lc.type!r}")
+    if which == "mixed" and lc.mixed.size != node.size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed mixed.size={lc.mixed.size} != "
+            f"rebuilt size={node.size}")
+    if which in ("group_rnn", "step"):
+        conf = lc.group_rnn if which == "group_rnn" else lc.step
+        if conf.size != node.size:
+            raise ConfigError(
+                f"layer {lc.name!r}: typed {which}.size={conf.size} != "
+                f"rebuilt size={node.size}")
+    if which == "crf" and lc.crf.num_classes != node.parents[0].size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed crf.num_classes={lc.crf.num_classes} "
+            f"!= emission size={node.parents[0].size}")
+    if which == "ctc" and lc.ctc.num_classes != node.parents[0].size:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed ctc.num_classes={lc.ctc.num_classes} "
+            f"!= logits size={node.parents[0].size}")
+    if which == "sampled_cost" and lc.sampled_cost.cost_type != lc.type:
+        raise ConfigError(
+            f"layer {lc.name!r}: typed sampled cost_type="
+            f"{lc.sampled_cost.cost_type!r} != type={lc.type!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -280,8 +383,11 @@ def _check_typed(lc, node) -> None:
 
 def _constructor(fn_name: str) -> Callable:
     import paddle_tpu.nn as nn
+    import paddle_tpu.v2.networks as networks
 
     fn = getattr(nn, fn_name, None)
+    if fn is None:  # composite helpers (lstmemory_group, simple_gru2, ...)
+        fn = getattr(networks, fn_name, None)
     if fn is None or not callable(fn):
         raise ConfigError(f"unknown layer constructor {fn_name!r} in config")
     return fn

@@ -96,6 +96,17 @@ def _seq_like(parent: Act, value) -> Act:
                sub_lengths=parent.sub_lengths)
 
 
+def _inherit_meta(node: LayerOutput, src: LayerOutput) -> LayerOutput:
+    """Propagate shape/semantic metadata (spatial dims, sparse kind) through a
+    pass-through layer WITHOUT copying serialization bookkeeping: blanket
+    ``meta.update`` used to copy the parent's recorded ``config`` too, making
+    dropout/cmrnorm/maxout/... serialize as their parent layer."""
+    for key in ("hw", "sparse"):
+        if key in src.meta:
+            node.meta[key] = src.meta[key]
+    return node
+
+
 # ---------------------------------------------------------------------------
 # data
 # ---------------------------------------------------------------------------
@@ -249,7 +260,7 @@ def addto(input: Sequence[LayerOutput], *, act: str = "linear",
         return _seq_like(ref, out) if ref.is_seq else Act(value=out)
 
     node = LayerOutput(name, "addto", size, inputs, forward, specs)
-    node.meta.update(inputs[0].meta)
+    _inherit_meta(node, inputs[0])
     return node
 
 
@@ -282,7 +293,7 @@ def dropout(input: LayerOutput, rate: float, *, name: Optional[str] = None) -> L
         return _seq_like(a, out) if a.is_seq else Act(value=out)
 
     node = LayerOutput(name, "dropout", input.size, [input], forward, [])
-    node.meta.update(input.meta)
+    _inherit_meta(node, input)
     return node
 
 
@@ -314,7 +325,7 @@ def error_clip(input: LayerOutput, threshold: float,
         return _seq_like(a, out) if a.is_seq else Act(value=out)
 
     node = LayerOutput(name, "error_clip", input.size, [input], forward, [])
-    node.meta.update(input.meta)
+    _inherit_meta(node, input)
     return node
 
 
@@ -471,7 +482,7 @@ def batch_norm(input: LayerOutput, *, act: str = "relu", momentum: float = 0.9,
 
     out = LayerOutput(name, "batch_norm", C, [input], forward,
                       [sspec, bspec, mspec, vspec])
-    out.meta.update(input.meta)
+    _inherit_meta(out, input)
     return out
 
 
@@ -484,7 +495,7 @@ def img_cmrnorm(input: LayerOutput, *, size: int = 5, scale: float = 1e-4,
         return Act(value=O.cmr_norm(a.value, size=size, scale=scale, power=power))
 
     out = LayerOutput(name, "cmrnorm", input.size, [input], forward, [])
-    out.meta.update(input.meta)
+    _inherit_meta(out, input)
     return out
 
 
@@ -495,7 +506,7 @@ def maxout(input: LayerOutput, *, groups: int, name: Optional[str] = None) -> La
         return Act(value=O.maxout(a.value, groups))
 
     out = LayerOutput(name, "maxout", input.size // groups, [input], forward, [])
-    out.meta.update(input.meta)
+    _inherit_meta(out, input)
     return out
 
 

@@ -13,6 +13,7 @@ import paddle_tpu.ops as O
 from paddle_tpu.ops.crf import crf_decode, crf_nll
 from paddle_tpu.ops.ctc import ctc_loss
 from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_name
+from paddle_tpu.nn.layers import _inherit_meta
 
 __all__ = [
     "crf_cost",
@@ -253,7 +254,7 @@ def featmap_expand(input: LayerOutput, *, num_filters: int,
 
     node = LayerOutput(name, "featmap_expand", input.size * num_filters,
                        [input], forward, [])
-    node.meta.update(input.meta)
+    _inherit_meta(node, input)
     return node
 
 

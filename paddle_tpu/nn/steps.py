@@ -97,3 +97,8 @@ def gru_step(input: LayerOutput, output_mem: LayerOutput,
         return Act(value=h_new)
 
     return LayerOutput(name, "gru_step", H, [input, output_mem], forward, specs)
+
+
+from paddle_tpu.config.capture import wrap_module as _wrap_module  # noqa: E402
+
+_wrap_module(globals(), __all__)

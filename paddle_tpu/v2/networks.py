@@ -217,11 +217,14 @@ def lstmemory_group(input, size=None, *, reverse=False, act="tanh",
         c = _nn.get_output(h, "state", size=size)
         return [h, h, c]
 
+    # the group node carries the helper's base name directly (the reference
+    # appends "_recurrent_group"): node name == recorded name= makes the
+    # dumped config replayable even when the helper was auto-named
     return _nn.recurrent_group(
         step=_step, input=[input],
         memories=[_nn.Memory(f"{name}_out", size),
                   _nn.Memory(f"{name}_state", size)],
-        reverse=reverse, name=f"{name}_recurrent_group")
+        reverse=reverse, name=name)
 
 
 def gru_unit(input, out_mem, *, size=None, act="tanh", gate_act="sigmoid",
@@ -254,7 +257,7 @@ def gru_group(input, size=None, *, reverse=False, act="tanh",
 
     return _nn.recurrent_group(
         step=_step, input=[input], memories=[_nn.Memory(f"{name}_out", size)],
-        reverse=reverse, name=f"{name}_recurrent_group")
+        reverse=reverse, name=name)  # node name == base name; see lstmemory_group
 
 
 def bidirectional_lstm(input, size, *, return_unmerged=False, name=None):
@@ -319,3 +322,12 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state, *,
     return LayerOutput(name, "simple_attention", encoded_sequence.size,
                        [encoded_sequence, encoded_proj, decoder_state],
                        forward, [w_spec, v_spec])
+
+
+# record composite-helper calls for config serialization: helpers expanding
+# into primitives keep the primitives' records (innermost wins); group
+# helpers whose node is a recurrent_group (not directly serializable) get
+# the helper call itself recorded, so configs replay through the helper
+from paddle_tpu.config.capture import wrap_module as _wrap_module  # noqa: E402
+
+_wrap_module(globals(), __all__)

@@ -9,14 +9,27 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from test_config import _simple_net  # noqa: E402
-
+import paddle_tpu.nn as nn  # noqa: E402
 from paddle_tpu.config import dump_model_config, protostr  # noqa: E402
 
-mc = dump_model_config(_simple_net(), "simple_net")
-mc.framework_version = ""
-mc.dtype_policy = ""
-out = os.path.join(os.path.dirname(__file__), "simple_net.protostr")
-with open(out, "w") as f:
-    f.write(protostr(mc))
-print("wrote", out)
+from golden_nets import GOLDEN_NETS  # noqa: E402
+from test_config import _simple_net  # noqa: E402
+
+HERE = os.path.dirname(__file__)
+
+
+def write(name, topo):
+    mc = dump_model_config(topo, name)
+    mc.framework_version = ""
+    mc.dtype_policy = ""
+    out = os.path.join(HERE, f"{name}.protostr")
+    with open(out, "w") as f:
+        f.write(protostr(mc))
+    print("wrote", out)
+
+
+write("simple_net", _simple_net())
+for name, builder in sorted(GOLDEN_NETS.items()):
+    nn.reset_naming()
+    topo, _ = builder()
+    write(name, topo)

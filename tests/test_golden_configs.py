@@ -1,0 +1,88 @@
+"""Golden-config regression suite — the analog of the reference's
+trainer_config_helpers/tests protostr checks (~40 configs diffed against
+checked-in goldens; SURVEY.md §4).
+
+For every canonical topology in golden_nets.GOLDEN_NETS:
+- the serialized ModelConfig text must equal the checked-in golden
+  (tests/golden/<name>.protostr; regenerate deliberately with regen.py),
+- the config must rebuild into a topology computing identical outputs with
+  the same parameters,
+- and the typed-oneof coverage across all goldens must stay high (the
+  schema-depth contract replacing the reference's 574-line typed proto).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.config import build_topology, dump_model_config, protostr
+
+from golden_nets import GOLDEN_NETS
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _dump(name):
+    nn.reset_naming()
+    topo, feed_fn = GOLDEN_NETS[name]()
+    mc = dump_model_config(topo, name)
+    mc.framework_version = ""
+    mc.dtype_policy = ""
+    return topo, feed_fn, mc
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_NETS))
+def test_golden_protostr(name):
+    _, _, mc = _dump(name)
+    path = os.path.join(GOLDEN_DIR, f"{name}.protostr")
+    assert os.path.exists(path), (
+        f"golden file {name}.protostr missing — regenerate deliberately "
+        "with tests/golden/regen.py and review the diff")
+    with open(path) as f:
+        golden = f.read()
+    assert protostr(mc) == golden, (
+        f"ModelConfig text for {name!r} changed vs golden — if intended, "
+        "regenerate with tests/golden/regen.py and review the diff")
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_NETS))
+def test_golden_rebuild_equivalence(name, rng):
+    topo, feed_fn, mc = _dump(name)
+    topo2 = build_topology(mc)
+    assert [l.name for l in topo2.layers] == [l.name for l in topo.layers]
+    assert {n: s.shape for n, s in topo2.param_specs.items()} == {
+        n: s.shape for n, s in topo.param_specs.items()}
+    params, state = topo.init(jax.random.PRNGKey(0))
+    feed = feed_fn(rng)
+    kw = {}
+    if any(l.layer_type in ("dropout",) for l in topo.layers) or name in (
+            "vgg_block",):
+        kw["rng"] = jax.random.PRNGKey(1)  # same dropout draw on both sides
+    o1, _ = topo.apply(params, state, feed, **kw)
+    o2, _ = topo2.apply(params, state, feed, **kw)
+    np.testing.assert_allclose(np.asarray(o1["cost"].value),
+                               np.asarray(o2["cost"].value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_typed_coverage_across_goldens():
+    """>= 80% of non-data layers across the golden suite must carry a typed
+    oneof — the schema-level contract the reference provides via its fully
+    typed ModelConfig.proto."""
+    covered = total = 0
+    untyped = {}
+    for name in GOLDEN_NETS:
+        _, _, mc = _dump(name)
+        for lc in mc.layers:
+            if lc.type == "data":
+                continue
+            total += 1
+            if lc.WhichOneof("typed"):
+                covered += 1
+            else:
+                untyped[lc.type] = untyped.get(lc.type, 0) + 1
+    frac = covered / total
+    assert frac >= 0.8, (covered, total, untyped)

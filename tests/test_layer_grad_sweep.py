@@ -548,6 +548,21 @@ def case_hsigmoid_cost(rng):
     return nn.hsigmoid_cost(x, lab, num_classes=8), {**feed, **fl}
 
 
+def case_lstm_step(rng):
+    # single-frame cell: pre-summed [B,4H] gates + explicit c state
+    x, fx = _dense(rng, "x", 8)  # 4H, H=2
+    c = nn.data("c", size=2)
+    fx["c"] = rng.randn(B, 2).astype(np.float32) * 0.5
+    return nn.lstm_step(x, c, 2), fx
+
+
+def case_gru_step(rng):
+    x, fx = _dense(rng, "x", 6)  # 3H, H=2
+    h = nn.data("h", size=2)
+    fx["h"] = rng.randn(B, 2).astype(np.float32) * 0.5
+    return nn.gru_step(x, h, 2), fx
+
+
 def case_selective_fc(rng):
     x, fx = _dense(rng)
     sel = nn.data("sel", size=4)

@@ -46,7 +46,7 @@ def test_lstmemory_group_equals_lstmemory(rng):
     lengths = np.array([T, 4, 2], np.int32)
     outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
     np.testing.assert_allclose(_mask_out(outs["flat"]),
-                               _mask_out(outs["lg_recurrent_group"]),
+                               _mask_out(outs["lg"]),
                                rtol=1e-4, atol=1e-5)
 
 
@@ -68,7 +68,7 @@ def test_gru_group_equals_grumemory(rng):
     lengths = np.array([T, 3], np.int32)
     outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
     np.testing.assert_allclose(_mask_out(outs["flat"]),
-                               _mask_out(outs["gg_recurrent_group"]),
+                               _mask_out(outs["gg"]),
                                rtol=1e-4, atol=1e-5)
 
 
@@ -130,7 +130,7 @@ def test_gru_group_reverse_matches_flat(rng):
     lengths = np.array([T, 3], np.int32)
     outs, _ = topo.apply(params, state, {"x": (xs, lengths)})
     np.testing.assert_allclose(_mask_out(outs["flat"]),
-                               _mask_out(outs["gg_recurrent_group"]),
+                               _mask_out(outs["gg"]),
                                rtol=1e-4, atol=1e-5)
 
 

@@ -1,0 +1,57 @@
+"""Linear regression introduction — analog of demo/introduction
+(reference: demo/introduction/trainer_config.py — one fc with named w/b
+regressing y = 2x + 0.3; dataprovider.py emits the synthetic pairs)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import paddle_tpu.data as data
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Momentum
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=12)
+    ap.add_argument("--n", type=int, default=120)
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    x = nn.data("x", size=1)
+    y = nn.data("y", size=1)
+    y_predict = nn.fc(x, 1, act="linear",
+                      param_attr=nn.ParamAttr(name="w"),
+                      bias_attr=nn.ParamAttr(name="b"), name="y_predict")
+    cost = nn.mse_cost(y_predict, y, name="cost")
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(args.n):
+            xv = rng.uniform(-1, 1)
+            yield [xv], [2.0 * xv + 0.3]
+
+    trainer = SGDTrainer(cost, Momentum(learning_rate=0.2), seed=0)
+    feeder = data.DataFeeder({"x": "dense", "y": "dense"})
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id == 0 \
+                and ev.pass_id % 10 == 0:
+            print(f"pass {ev.pass_id} cost {ev.cost:.5f}")
+
+    trainer.train(data.batch(reader, args.batch_size),
+                  num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+    w = float(np.asarray(trainer.params["w"]).ravel()[0])
+    b = float(np.asarray(trainer.params["b"]).ravel()[0])
+    print(f"learned w={w:.3f} b={b:.3f} (target w=2.0 b=0.3)")
+
+
+if __name__ == "__main__":
+    main()

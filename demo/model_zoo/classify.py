@@ -1,0 +1,53 @@
+"""Model-zoo consumer — analog of demo/model_zoo/resnet/classify.py
+(reference: ImageClassifier with --job=classify | --job=extract reading a
+published train_conf + model_dir; classify.py:22).
+
+Loads the published bundle with NO model code (load_inference_model — the
+py_paddle swig inference analog) and either classifies images or extracts
+the pre-logits feature layer named in the bundle manifest."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+from paddle_tpu.config import load_inference_model
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="/tmp/paddle_tpu_zoo_resnet.bundle")
+    ap.add_argument("--job", choices=["classify", "extract"],
+                    default="classify")
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    model = load_inference_model(args.model)
+    print("loaded", model.manifest.get("name"),
+          "inputs", model.input_names, "meta task",
+          model.manifest.get("task"))
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(args.batch_size, 32, 32, 3).astype(np.float32)
+    feed = {"pixel": imgs, "label": np.zeros((args.batch_size, 1), np.int32)}
+
+    if args.job == "classify":
+        out = model.infer(feed, outputs=["logits"])["logits"]
+        probs = np.exp(out - out.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        pred = probs.argmax(-1)
+        for i in range(args.batch_size):
+            print(f"image {i}: class {pred[i]} prob {probs[i, pred[i]]:.3f}")
+    else:
+        layer = model.manifest.get("feature_layer", "gap")
+        feats = model.infer(feed, outputs=[layer])[layer]
+        feats = feats.reshape(args.batch_size, -1)
+        print(f"extracted features from {layer!r}: shape {feats.shape}, "
+              f"norm {np.linalg.norm(feats, axis=1).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

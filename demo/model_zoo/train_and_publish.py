@@ -1,0 +1,55 @@
+"""Model-zoo publish flow — analog of demo/model_zoo/resnet
+(reference: classify.py builds an ImageClassifier from a published
+train_conf + model_dir and runs --job=classify / --job=extract).
+
+Here the zoo artifact is a deploy BUNDLE (config proto + trained params in
+one file, config/deploy.py merge_model — the MergeModel analog): this
+script trains a small CIFAR ResNet and publishes the bundle; ``classify.py``
+consumes it with NO model code."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import paddle_tpu.data as data
+import paddle_tpu.nn as nn
+from paddle_tpu.config import merge_model
+from paddle_tpu.models import resnet_cifar
+from paddle_tpu.param.optimizers import Momentum
+from paddle_tpu.trainer import SGDTrainer, events
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--out", default="/tmp/paddle_tpu_zoo_resnet.bundle")
+    args = ap.parse_args(argv)
+
+    nn.reset_naming()
+    cost, logits = resnet_cifar(depth=args.depth)
+    trainer = SGDTrainer(cost, Momentum(learning_rate=0.05), seed=0)
+    feeder = data.DataFeeder({"pixel": "dense", "label": "int"})
+
+    reader = data.batch(data.datasets.cifar10("train", n=args.n),
+                        args.batch_size)
+
+    def on_event(ev):
+        if isinstance(ev, events.EndIteration) and ev.batch_id % 4 == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} cost {ev.cost:.4f}")
+
+    trainer.train(reader, num_passes=args.passes, event_handler=on_event,
+                  feeder=feeder)
+    merge_model(args.out, trainer.topology, trainer.params, trainer.state,
+                name="zoo_resnet_cifar",
+                meta={"task": "cifar10", "depth": args.depth,
+                      "feature_layer": "gap"})  # pre-logits global avg pool
+    print("published", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -268,9 +268,12 @@ def _fill_typed(lc, node, kwargs: Dict[str, Any]) -> None:
         lc.norm.power = float(kwargs.get("power", 0.75))
     elif t == "crf_cost":
         lc.crf.num_classes = int(kwargs.get("size") or node.parents[0].size)
-    elif t == "ctc_cost":
+    elif t in ("ctc_cost", "warp_ctc"):
         lc.ctc.num_classes = int(node.parents[0].size)
-        lc.ctc.blank = int(kwargs.get("blank", 0))
+        b = kwargs.get("blank")
+        if b is None:  # ctc_layer convention: blank is the last index
+            b = node.parents[0].size - 1 if t == "ctc_cost" else 0
+        lc.ctc.blank = int(b)
     elif t in ("nce_cost", "hsigmoid_cost"):
         lc.sampled_cost.cost_type = t
         lc.sampled_cost.num_classes = int(

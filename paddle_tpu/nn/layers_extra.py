@@ -19,6 +19,7 @@ __all__ = [
     "crf_cost",
     "crf_decoding",
     "ctc_cost",
+    "warp_ctc",
     "nce_cost",
     "hsigmoid_cost",
     "sampling_id",
@@ -88,21 +89,49 @@ def crf_decoding(input: LayerOutput, *, size: Optional[int] = None,
 # ---------------------------------------------------------------------------
 
 
-def ctc_cost(input: LayerOutput, label: LayerOutput, *, blank: int = 0,
-             norm_by_times: bool = False, name: Optional[str] = None) -> LayerOutput:
-    """CTC NLL. ``input``: per-step class logits [B,T,C] (sequence, linear
-    act); ``label``: int label sequence [B,L] with its own lengths."""
+def ctc_cost(input: LayerOutput, label: LayerOutput, *,
+             blank: Optional[int] = None, norm_by_times: bool = False,
+             name: Optional[str] = None) -> LayerOutput:
+    """CTC NLL — analog of ctc_layer (CTCLayer.cpp;
+    trainer_config_helpers/layers.py:4651).  ``input``: per-step class
+    logits [B,T,C] (sequence); ``label``: int label sequence [B,L] with its
+    own lengths.
+
+    Blank convention follows the reference's ctc_layer: input size is
+    num_classes + 1 and the blank is the LAST index (size - 1); labels use
+    [0, num_classes).  For the warp-ctc convention (blank=0 by default,
+    anywhere in range) use ``warp_ctc``."""
     name = name or next_name("ctc_cost")
+    blank_ix = input.size - 1 if blank is None else blank
 
     def forward(ctx, params, logits: Act, lab: Act) -> Act:
         lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)
         in_len = logits.lengths
         lab_len = lab.lengths
-        losses = ctc_loss(lp, lab.value, in_len, lab_len, blank=blank,
+        losses = ctc_loss(lp, lab.value, in_len, lab_len, blank=blank_ix,
                           norm_by_times=norm_by_times)
         return Act(value=jnp.mean(losses))
 
     return LayerOutput(name, "ctc_cost", 1, [input, label], forward, [])
+
+
+def warp_ctc(input: LayerOutput, label: LayerOutput, *, blank: int = 0,
+             norm_by_times: bool = False,
+             name: Optional[str] = None) -> LayerOutput:
+    """CTC NLL with the warp-ctc conventions — analog of warp_ctc_layer
+    (WarpCTCLayer.cpp; trainer_config_helpers/layers.py:4717): ``blank``
+    may be any index in [0, num_classes] (default 0, vs ctc_layer's
+    fixed last-index), and the softmax is integrated (feed LINEAR logits).
+    Same math here — the native log-space CTC covers both conventions."""
+    name = name or next_name("warp_ctc")
+
+    def forward(ctx, params, logits: Act, lab: Act) -> Act:
+        lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)
+        losses = ctc_loss(lp, lab.value, logits.lengths, lab.lengths,
+                          blank=blank, norm_by_times=norm_by_times)
+        return Act(value=jnp.mean(losses))
+
+    return LayerOutput(name, "warp_ctc", 1, [input, label], forward, [])
 
 
 # ---------------------------------------------------------------------------

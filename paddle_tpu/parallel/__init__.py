@@ -23,4 +23,5 @@ from paddle_tpu.parallel.distributed import (
     is_multi_host,
     resume_pass,
 )
+from paddle_tpu.parallel.launcher import ClusterLauncher, launch_local
 from paddle_tpu.utils.devices import make_mesh

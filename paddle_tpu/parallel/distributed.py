@@ -37,6 +37,14 @@ def initialize_distributed(
         return
     import jax
 
+    # wiring injected by parallel/launcher.py (the cluster-launcher analog of
+    # the reference's --pservers/--trainer_id flags)
+    coordinator_address = (coordinator_address
+                           or os.environ.get("PADDLE_TPU_COORDINATOR"))
+    if num_processes is None and os.environ.get("PADDLE_TPU_NUM_PROCESSES"):
+        num_processes = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PADDLE_TPU_PROCESS_ID"):
+        process_id = int(os.environ["PADDLE_TPU_PROCESS_ID"])
     if coordinator_address is None and not os.environ.get("JAX_COORDINATOR_ADDRESS"):
         # single-host: nothing to do
         _initialized = True

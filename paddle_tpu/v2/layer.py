@@ -70,7 +70,7 @@ sum_cost = _nn.sum_cost
 crf = _nn.crf_cost
 crf_decoding = _nn.crf_decoding
 ctc = _nn.ctc_cost
-warp_ctc = _nn.ctc_cost
+warp_ctc = _nn.warp_ctc
 nce = _nn.nce_cost
 hsigmoid = _nn.hsigmoid_cost
 multiplex = _nn.multiplex

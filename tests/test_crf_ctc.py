@@ -130,7 +130,7 @@ class TestCTC:
         cost = nn.ctc_cost(logits, labels, name="ctc")
         trainer = SGDTrainer(cost, Adam(learning_rate=0.02), seed=0)
         x = rng.randn(B, T, 8).astype(np.float32)
-        y = rng.randint(1, C, (B, L)).astype(np.int32)
+        y = rng.randint(0, C - 1, (B, L)).astype(np.int32)  # blank = C-1
         feed = {"feats": (x, np.full(B, T, np.int32)),
                 "labels": (y, np.full(B, L, np.int32))}
         l0 = float(trainer.train_batch(feed))

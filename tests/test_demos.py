@@ -42,6 +42,9 @@ CASES = [
     ("sequence_tagging", ["--passes", "1", "--n", "32", "--vocab", "100",
                           "--batch-size", "8"]),
     ("gan", ["--steps", "20", "--batch-size", "32"]),
+    ("introduction", ["--passes", "15", "--n", "60", "--batch-size", "12"]),
+    ("traffic_prediction", ["--passes", "1", "--n", "128",
+                            "--batch-size", "32", "--horizons", "4"]),
 ]
 
 
@@ -53,3 +56,22 @@ def test_demo_runs(name, args, monkeypatch, capsys):
     runpy.run_path(script, run_name="__main__")
     out = capsys.readouterr().out
     assert "cost" in out or "loss" in out or "mse" in out
+
+
+def test_model_zoo_publish_and_consume(monkeypatch, capsys, tmp_path):
+    """The model-zoo flow: train+publish a bundle, then classify AND extract
+    features from it with no model code (reference
+    demo/model_zoo/resnet/classify.py --job=classify|extract)."""
+    bundle = str(tmp_path / "zoo.bundle")
+    pub = os.path.join(ROOT, "demo", "model_zoo", "train_and_publish.py")
+    monkeypatch.setattr(sys, "argv", [pub, "--passes", "1", "--n", "64",
+                                      "--batch-size", "16", "--out", bundle])
+    runpy.run_path(pub, run_name="__main__")
+    assert os.path.exists(bundle)
+    cls = os.path.join(ROOT, "demo", "model_zoo", "classify.py")
+    for job in ("classify", "extract"):
+        monkeypatch.setattr(sys, "argv", [cls, "--model", bundle,
+                                          "--job", job])
+        runpy.run_path(cls, run_name="__main__")
+    out = capsys.readouterr().out
+    assert "class " in out and "extracted features" in out

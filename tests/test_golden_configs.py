@@ -11,6 +11,7 @@ For every canonical topology in golden_nets.GOLDEN_NETS:
   schema-depth contract replacing the reference's 574-line typed proto).
 """
 
+import functools
 import os
 
 import jax
@@ -25,6 +26,7 @@ from golden_nets import GOLDEN_NETS
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 
 
+@functools.lru_cache(maxsize=None)
 def _dump(name):
     nn.reset_naming()
     topo, feed_fn = GOLDEN_NETS[name]()

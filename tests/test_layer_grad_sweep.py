@@ -531,7 +531,17 @@ def case_ctc_cost(rng):
     feed["lab"] = (rng.randint(1, 4, (B, 3)).astype(np.int32),
                    np.full((B,), 2, np.int32))
     feed["xs"] = (feed["xs"][0], np.full((B,), 8, np.int32))
-    return nn.ctc_cost(nn.fc(xs, 5, act="softmax", name="emit"), lab), feed
+    return nn.ctc_cost(nn.fc(xs, 5, act="linear", name="emit"), lab), feed
+
+
+def case_warp_ctc(rng):
+    # warp-ctc conventions: blank=0, labels in [1, C)
+    xs, feed = _seq(rng, t=8)
+    lab = nn.data("wlab", size=4, is_seq=True, dtype="int32")
+    feed["wlab"] = (rng.randint(1, 4, (B, 3)).astype(np.int32),
+                    np.full((B,), 2, np.int32))
+    feed["xs"] = (feed["xs"][0], np.full((B,), 8, np.int32))
+    return nn.warp_ctc(nn.fc(xs, 5, act="linear", name="wemit"), lab), feed
 
 
 def case_nce_cost(rng):

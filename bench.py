@@ -133,7 +133,11 @@ def _mfu(sec, flops, peak):
 
 
 def _topology_step(cost, opt, feeds, *, extra_state=True):
-    """(carry -> (carry, loss)) train step over a nn.Topology graph."""
+    """(carry -> (carry, loss)) train step over a nn.Topology graph.
+
+    ``feeds`` ride in the carry (unchanged) rather than the closure: a
+    closed-over batch becomes an HLO *constant*, and a b512 image batch
+    (403 MB) overflows the axon tunnel's remote-compile request limit."""
     import jax
 
     import paddle_tpu.nn as nn
@@ -143,7 +147,7 @@ def _topology_step(cost, opt, feeds, *, extra_state=True):
     opt_state = opt.init_state(params)
 
     def one_step(carry):
-        params, state, opt_state = carry
+        params, state, opt_state, feeds = carry
 
         def loss_fn(p):
             outs, new_state = topo.apply(p, state, feeds, train=True,
@@ -152,9 +156,9 @@ def _topology_step(cost, opt, feeds, *, extra_state=True):
 
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         new_params, new_opt = opt.update(params, grads, opt_state)
-        return (new_params, new_state, new_opt), loss
+        return (new_params, new_state, new_opt, feeds), loss
 
-    return one_step, (params, state, opt_state)
+    return one_step, (params, state, opt_state, feeds)
 
 
 def bench_seq2seq(rtt, peak):
@@ -182,12 +186,13 @@ def bench_seq2seq(rtt, peak):
     opt_state = opt.init_state(params)
 
     def one_step(carry):
-        params, opt_state = carry
+        params, opt_state, batch = carry  # batch as arg, not HLO constant
         loss, grads = jax.value_and_grad(m.loss)(params, batch)
         new_params, new_opt = opt.update(params, grads, opt_state)
-        return (new_params, new_opt), loss
+        return (new_params, new_opt, batch), loss
 
-    sec, flops = _time_chain(one_step, (params, opt_state), iters=20, rtt=rtt)
+    sec, flops = _time_chain(one_step, (params, opt_state, batch), iters=20,
+                             rtt=rtt)
     words = B * T / sec  # target words (the decoded side) per second
     # MFU from ANALYTIC model FLOPs (3x forward, the standard convention —
     # jax-ml.github.io/scaling-book): XLA's cost_analysis undercounts

@@ -182,6 +182,34 @@ class Optimizer:
         lr = self.lr_at(step)
         if self.gradient_clipping_threshold > 0:
             grads, _ = clip_by_global_norm(grads, self.gradient_clipping_threshold)
+        def _regularized(p, g):
+            """decay/l1 applied to the gradient (closes over per-leaf decay)."""
+            if decay:
+                g = g + decay * p
+            if self.l1_rate:
+                g = g + self.l1_rate * jnp.sign(p)
+            return g
+
+        def _masked_update(p, g, old_slots, touched, lr_eff):
+            """Full-tensor update with untouched rows held — the ONE masked
+            path shared by sparse_rows=True and the K fast path's overflow
+            fallback (they must stay identical)."""
+            p2, s2 = self.update_leaf(p, _regularized(p, g), old_slots,
+                                      lr_eff, step)
+            row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
+
+            def sel(new, old):
+                r = row.astype(jnp.bool_)
+                r = r.reshape(r.shape + (1,) * (new.ndim - r.ndim))
+                return jnp.where(r, new, old)
+
+            p2 = sel(p2, p)
+            s2 = jax.tree_util.tree_map(
+                lambda n, o: sel(n, o)
+                if getattr(n, "shape", None) == p.shape else n,
+                s2, old_slots)
+            return p2.astype(p.dtype), s2
+
         new_params, new_slots = {}, {}
         for k, p in params.items():
             g = grads[k]
@@ -199,17 +227,14 @@ class Optimizer:
                 K = int(kind)
                 touched = jnp.any(g != 0, axis=tuple(range(1, p.ndim)))
 
-                def _fast(_, p=p, g=g, touched=touched, K=K, decay=decay,
-                          scale=scale, old_slots=old_slots):
+                def _fast(_, p=p, g=g, touched=touched, K=K,
+                          old_slots=old_slots, scale=scale):
                     live_score, rows = jax.lax.top_k(
                         touched.astype(jnp.float32), K)
                     live = (live_score > 0).reshape(
                         (-1,) + (1,) * (p.ndim - 1))
-                    p_r, g_r = p[rows], g[rows]
-                    if decay:
-                        g_r = g_r + decay * p_r
-                    if self.l1_rate:
-                        g_r = g_r + self.l1_rate * jnp.sign(p_r)
+                    p_r = p[rows]
+                    g_r = _regularized(p_r, g[rows])
                     s_r = jax.tree_util.tree_map(
                         lambda s: s[rows]
                         if getattr(s, "shape", None) == p.shape else s,
@@ -227,57 +252,24 @@ class Optimizer:
                         old_slots, s2_r)
                     return np_, ns_
 
-                def _masked(_, p=p, g=g, touched=touched, decay=decay,
-                            scale=scale, old_slots=old_slots):
-                    # overflow fallback: full-table update masked per row —
-                    # correct for any touched count (same as the `True` path)
-                    if decay:
-                        g = g + decay * p
-                    if self.l1_rate:
-                        g = g + self.l1_rate * jnp.sign(p)
-                    p2, s2 = self.update_leaf(p, g, old_slots, lr * scale,
-                                              step)
-                    row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
-
-                    def sel(new, old):
-                        r = row.astype(jnp.bool_)
-                        r = r.reshape(r.shape + (1,) * (new.ndim - r.ndim))
-                        return jnp.where(r, new, old)
-
-                    p2 = sel(p2, p)
-                    s2 = jax.tree_util.tree_map(
-                        lambda n, o: sel(n, o)
-                        if getattr(n, "shape", None) == p.shape else n,
-                        s2, old_slots)
-                    return p2.astype(p.dtype), s2
+                def _overflow(_, p=p, g=g, touched=touched,
+                              old_slots=old_slots, scale=scale):
+                    return _masked_update(p, g, old_slots, touched, lr * scale)
 
                 # a batch touching more than K rows would silently drop
                 # gradient rows in the fast path; guard with a cond so only
                 # the chosen branch executes at runtime
                 n_touched = jnp.sum(touched.astype(jnp.int32))
                 new_params[k], new_slots[k] = jax.lax.cond(
-                    n_touched <= K, _fast, _masked, None)
+                    n_touched <= K, _fast, _overflow, None)
                 continue
-            if decay:
-                g = g + decay * p
-            if self.l1_rate:
-                g = g + self.l1_rate * jnp.sign(p)
-            p2, s2 = self.update_leaf(p, g, old_slots, lr * scale, step)
-            if kind and p.ndim >= 2:
-                touched = jnp.any(grads[k] != 0, axis=tuple(range(1, p.ndim)))
-                row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
-
-                def sel(new, old, row=row):
-                    r = row.astype(jnp.bool_)
-                    r = r.reshape(r.shape + (1,) * (new.ndim - r.ndim))
-                    return jnp.where(r, new, old)
-
-                p2 = sel(p2, p)
-                s2 = jax.tree_util.tree_map(
-                    lambda n, o: sel(n, o)
-                    if getattr(n, "shape", None) == p.shape else n,
-                    s2, old_slots,
-                )
+            if kind and p.ndim >= 2:  # sparse_rows=True: masked path
+                touched = jnp.any(g != 0, axis=tuple(range(1, p.ndim)))
+                new_params[k], new_slots[k] = _masked_update(
+                    p, g, old_slots, touched, lr * scale)
+                continue
+            p2, s2 = self.update_leaf(p, _regularized(p, g), old_slots,
+                                      lr * scale, step)
             new_params[k] = p2.astype(p.dtype)
             new_slots[k] = s2
         return new_params, {"step": step, "slots": new_slots}

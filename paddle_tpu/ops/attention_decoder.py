@@ -41,9 +41,16 @@ from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
 __all__ = ["attention_gru_decoder"]
 
 
-def _fwd_step(s, y_t, enc, enc_proj, src_mask, att_w, att_v, wx, b, wh):
+def _fwd_step(s, xp_y_t, enc, enc_proj, src_mask, att_w, att_v, wx_c, wh):
     """One decoder step; mirrors additive_attention_scores/attend/gru_step
-    numerics exactly (bf16 matmul operands, f32 accumulation)."""
+    numerics (bf16 matmul operands, f32 accumulation).  ``xp_y_t`` is the
+    teacher-forced half of the input projection, HOISTED out of the scan as
+    one [B,T,E]x[E,3D] MXU matmul (+bias) — only the context half
+    (``ctx @ wx_c``) depends on the recurrent state, so only it stays in the
+    loop.  Measured step-time NEUTRAL on v5e at B384 WMT14 shapes (24.5 vs
+    24.6 ms — the scan is latency-bound, not FLOP-bound); kept because it
+    shrinks the sequential per-step work and matches the DSL's
+    separate-projection composition."""
     D = s.shape[-1]
     # --- additive_attention_scores ---
     q = linear(s, att_w)[:, None, :]
@@ -62,8 +69,7 @@ def _fwd_step(s, y_t, enc, enc_proj, src_mask, att_w, att_v, wx, b, wh):
     ctx = jnp.einsum("bs,bsd->bd", wc, vc,
                      preferred_element_type=acc_dtype()).astype(acc_dtype())
     # --- input projection + gru_step ---
-    x = jnp.concatenate([y_t, ctx.astype(y_t.dtype)], axis=-1)
-    xp = linear(x, wx, b)
+    xp = xp_y_t + linear(ctx, wx_c)
     zr = xp[..., : 2 * D] + linear(s, wh[:, : 2 * D])
     r, u = jnp.split(jax.nn.sigmoid(zr), 2, axis=-1)
     cand = jnp.tanh(xp[..., 2 * D:] + linear(r * s, wh[:, 2 * D:]))
@@ -84,19 +90,24 @@ def attention_gru_decoder(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
 
 def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
                       att_w, att_v, wx, b, wh):
-    y_tb = jnp.moveaxis(y_emb, 1, 0)                       # [T,B,E]
+    E = y_emb.shape[-1]
+    # hoisted teacher-forced half of the input projection (+ bias), one
+    # batched MXU matmul over all steps
+    xp_y = linear(y_emb, wx[:E], b)                        # [B,T,3D] f32
+    xp_y_tb = jnp.moveaxis(xp_y, 1, 0)                     # [T,B,3D]
     m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
+    wx_c = wx[E:]
 
     def step(s, inp):
-        y_t, m_t = inp
-        s_new, (w, ctx, _pre) = _fwd_step(s, y_t, enc, enc_proj, src_mask,
-                                          att_w, att_v, wx, b, wh)
+        xp_y_t, m_t = inp
+        s_new, (w, ctx, _pre) = _fwd_step(s, xp_y_t, enc, enc_proj, src_mask,
+                                          att_w, att_v, wx_c, wh)
         keep = (m_t > 0)[:, None]
         s_out = jnp.where(keep, s_new, s)
         out = s_out * m_t[:, None].astype(s_out.dtype)
         return s_out, (out, w, ctx)
 
-    _, (outs, probs, ctxs) = lax.scan(step, s0, (y_tb, m_tb))
+    _, (outs, probs, ctxs) = lax.scan(step, s0, (xp_y_tb, m_tb))
     states = jnp.moveaxis(outs, 0, 1)                      # [B,T,D]
     return states, (probs, ctxs)
 
@@ -120,6 +131,10 @@ def _agd_bwd(res, d_states):
 
     y_tb = jnp.moveaxis(y_emb, 1, 0)                       # [T,B,E]
     m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
+    # recompute the hoisted y-projection (single deterministic matmul ->
+    # bitwise-identical to the forward's values; cheaper than carrying a
+    # [T,B,3D] f32 residual)
+    xp_y_tb = jnp.moveaxis(linear(y_emb, wx[:E], b), 1, 0)
     d_out_tb = jnp.moveaxis(d_states, 1, 0).astype(f32)    # [T,B,D]
     # s_prev[t]: carry entering step t.  The saved states are the zeroed
     # outputs (out = carry*m), so at masked steps the HELD carry must be
@@ -139,13 +154,13 @@ def _agd_bwd(res, d_states):
 
     def rev_step(carry, inp):
         d_s, d_encP, d_attw, d_v, d_wh, d_b = carry
-        d_out_t, m_t, y_t, w_t, ctx_t, sp_t = inp
+        d_out_t, m_t, xp_y_t, w_t, ctx_t, sp_t = inp
         mcol = (m_t > 0)[:, None].astype(f32)
         d_snew = mcol * (d_out_t + d_s)
 
-        # ---- recompute GRU internals ----
-        x = jnp.concatenate([y_t, ctx_t.astype(y_t.dtype)], axis=-1)
-        xp = linear(x, wx, b).astype(f32)
+        # ---- recompute GRU internals (hoisted y-half recomputed outside
+        # the scan, ctx half recomputed here) ----
+        xp = (xp_y_t + linear(ctx_t, wx[E:])).astype(f32)
         sp = sp_t.astype(f32)
         zr = xp[..., : 2 * D] + linear(sp_t, wh[:, : 2 * D]).astype(f32)
         ru = jax.nn.sigmoid(zr)
@@ -212,15 +227,17 @@ def _agd_bwd(res, d_states):
             jnp.zeros(b.shape, f32))
     (d_s0, d_encP, d_attw, d_v, d_wh, d_b), (d_xp_tb, d_ctx_tb) = lax.scan(
         rev_step, acc0,
-        (d_out_tb, m_tb, y_tb, probs, ctxs, s_prev),
+        (d_out_tb, m_tb, xp_y_tb, probs, ctxs, s_prev),
         reverse=True)
 
     # ---- batched post-scan contractions ----
     # d_enc: the only use of enc is ctx_t = w_t @ enc
     d_enc = jnp.einsum("tbs,tbh->bsh", probs, d_ctx_tb).astype(enc.dtype)
-    # d_wx over all steps at once: x = [y, ctx]
-    x_all = jnp.concatenate([y_tb.astype(f32), ctxs], axis=-1)  # [T,B,E+2H]
-    d_wx = jnp.einsum("tbi,tbo->io", x_all, d_xp_tb)
+    # d_wx in two blocks (x = [y, ctx]); identical to the old einsum over
+    # the concatenated x
+    d_wx_y = jnp.einsum("tbi,tbo->io", y_tb.astype(f32), d_xp_tb)
+    d_wx_c = jnp.einsum("tbi,tbo->io", ctxs, d_xp_tb)
+    d_wx = jnp.concatenate([d_wx_y, d_wx_c], axis=0)
     d_y = (d_xp_tb @ wx_f[:E].T).astype(y_emb.dtype)       # [T,B,E]
     d_y_emb = jnp.moveaxis(d_y, 0, 1)
 

@@ -60,10 +60,13 @@ def make_args(seed=0, B=4, S=5, T=6, E=8, H2=10, D=8, A=7,
 
 
 def test_forward_matches_scan():
+    # widened tolerances on hardware: the fused path's split in-projection
+    # (xp_y + ctx@wx_c) reassociates the reference's single concat matmul,
+    # and TPU f32 dots run at bf16-pass precision
     vals = [make_args()[k] for k in ORDER]
     np.testing.assert_allclose(np.asarray(reference(*vals)),
                                np.asarray(attention_gru_decoder(*vals)),
-                               rtol=1e-5, atol=1e-5)
+                               **_tols())
 
 
 @pytest.mark.parametrize("seed", [0, 1])
@@ -98,7 +101,7 @@ def test_full_masks_equal_no_masks():
     vals = [args[k] for k in ORDER]
     np.testing.assert_allclose(np.asarray(reference(*vals)),
                                np.asarray(attention_gru_decoder(*vals)),
-                               rtol=1e-5, atol=1e-5)
+                               **_tols())  # see test_forward_matches_scan
 
 
 def test_jit_and_grad_under_jit():

@@ -24,7 +24,8 @@ from paddle_tpu.nn.graph import (
     ParamSpec,
     next_name,
 )
-from paddle_tpu.nn.layers import AttrLike, _bias_attr, _pa, _seq_like, _spatial
+from paddle_tpu.nn.layers import (AttrLike, _bias_attr, _inherit_meta, _pa,
+                                  _seq_like, _spatial)
 from paddle_tpu.utils.error import ConfigError
 
 __all__ = [
@@ -44,6 +45,8 @@ __all__ = [
     "eos_id",
     "img_conv_transpose",
     "mdlstmemory",
+    "cross_channel_norm",
+    "print_value",
 ]
 
 
@@ -584,6 +587,48 @@ def mdlstmemory(input: LayerOutput, size: int, *, act: str = "tanh",
 
     out = LayerOutput(name, "mdlstm", H, [input], forward, specs)
     out.meta["hw"] = (h, w)
+    return out
+
+
+def cross_channel_norm(input: LayerOutput, *, name: Optional[str] = None,
+                       param_attr: AttrLike = None) -> LayerOutput:
+    """Per-pixel L2 normalization across channels with a trainable per-channel
+    scale — analog of cross_channel_norm_layer (CrossChannelNormLayer.cpp;
+    the SSD normalization block, layers.py cross_channel_norm_layer)."""
+    name = name or next_name("cross_channel_norm")
+    C = input.size
+    pa = _pa(param_attr, f"_{name}.w0", init="ones")
+    sspec = ParamSpec(name=pa.name, shape=(C,), attr=pa)
+
+    def forward(ctx, params, a: Act) -> Act:
+        x = a.value  # [B,H,W,C]
+        norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=-1,
+                                keepdims=True) + 1e-12)
+        y = (x / norm.astype(x.dtype)) * params[sspec.name].astype(x.dtype)
+        return Act(value=y)
+
+    out = LayerOutput(name, "cross_channel_norm", C, [input], forward, [sspec])
+    _inherit_meta(out, input)
+    return out
+
+
+def print_value(input: LayerOutput, *, message: Optional[str] = None,
+                name: Optional[str] = None) -> LayerOutput:
+    """Debug layer printing its input's values at forward time — analog of
+    print_layer (PrintLayer.cpp).  Identity in the dataflow (unlike the
+    reference's sink, it passes through so it can sit mid-graph); the print
+    happens on-device via jax.debug.print, so it works under jit."""
+    name = name or next_name("print")
+    # the label is literal text, not a format spec: escape braces so a
+    # message like "step {t}" can't crash the jax.debug.print formatter
+    msg = (message or name).replace("{", "{{").replace("}", "}}")
+
+    def forward(ctx, params, a: Act) -> Act:
+        jax.debug.print(msg + ": {}", a.value)
+        return a
+
+    out = LayerOutput(name, "print", input.size, [input], forward, [])
+    _inherit_meta(out, input)
     return out
 
 

@@ -228,11 +228,14 @@ def lstmemory_group(input, size=None, *, reverse=False, act="tanh",
 
 
 def gru_unit(input, out_mem, *, size=None, act="tanh", gate_act="sigmoid",
-             gru_param_attr=None, gru_bias_attr=True, name=None):
+             gru_param_attr=None, gru_bias_attr=True, naive=False, name=None):
     """One GRU time step for use INSIDE a recurrent_group step
     (networks.py:792-858): ``input`` is the [B, 3*size] x-projection,
     ``out_mem`` the group's h memory.  The step layer owns the recurrent
-    [size, 3*size] weight (reset-gate coupling prevents hoisting it)."""
+    [size, 3*size] weight (reset-gate coupling prevents hoisting it).
+    ``naive`` is accepted for reference-signature parity (gru_step_naive_layer
+    computes the same function as gru_step_layer; here there is one impl)."""
+    del naive
     if size is None:
         size = input.size // 3
     return _nn.gru_step(input, out_mem, size, act=act, gate_act=gate_act,
@@ -242,9 +245,11 @@ def gru_unit(input, out_mem, *, size=None, act="tanh", gate_act="sigmoid",
 
 def gru_group(input, size=None, *, reverse=False, act="tanh",
               gate_act="sigmoid", gru_param_attr=None, gru_bias_attr=True,
-              name=None):
+              naive=False, name=None):
     """Recurrent-group GRU (networks.py:860-925); ``input`` is the
-    [B, T, 3*size] pre-projection."""
+    [B, T, 3*size] pre-projection.  ``naive`` accepted for parity (see
+    gru_unit)."""
+    del naive
     name = name or _nn.layer.next_name("gru_group")
     if size is None:
         size = input.size // 3

@@ -612,6 +612,19 @@ def case_crf_decoding(rng):
     return nn.crf_decoding(emit, share_with="crf_w"), feed
 
 
+
+
+def case_cross_channel_norm(rng):
+    img, feed = _img(rng)
+    return nn.cross_channel_norm(_pre_conv(img)), feed
+
+
+def case_print_value(rng):
+    # identity dataflow; FD-checks the upstream fc's params THROUGH it
+    x, feed = _dense(rng)
+    return nn.print_value(_pre_fc(x)), feed
+
+
 FORWARD_ONLY = {"maxid", "sampling_id", "eos_id", "eos_trim", "crf_decoding",
                 "priorbox"}
 

@@ -624,10 +624,12 @@ def print_value(input: LayerOutput, *, message: Optional[str] = None,
     msg = (message or name).replace("{", "{{").replace("}", "}}")
 
     def forward(ctx, params, a: Act) -> Act:
-        # tunneled backends (axon) lack host send/recv callbacks: debug.print
-        # would abort the jitted step at run time — degrade to a trace-time
-        # shape log there instead of killing training
-        if jax.default_backend() == "axon":
+        # tunneled backends lack host send/recv callbacks: debug.print would
+        # abort the jitted step at run time — degrade to a trace-time shape
+        # log there instead of killing training
+        from paddle_tpu.utils.devices import on_tunnel_backend
+
+        if on_tunnel_backend():
             from paddle_tpu.utils import logger
 
             logger.info("print_value %s: %s %s (values unavailable on the "

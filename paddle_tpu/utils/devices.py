@@ -19,6 +19,7 @@ __all__ = [
     "devices",
     "device_count",
     "default_backend",
+    "on_tunnel_backend",
     "make_mesh",
     "force_virtual_devices",
 ]
@@ -96,6 +97,22 @@ def default_backend() -> str:
     import jax
 
     return jax.default_backend()
+
+
+def on_tunnel_backend() -> bool:
+    """True when the chip is reached through the axon tunnel plugin.
+
+    The plugin registers under the 'axon' key but reports platform 'tpu',
+    so ``jax.default_backend()`` cannot tell them apart; the backend
+    registry can.  The tunnel lacks host send/recv callbacks
+    (jax.debug.print / io_callback abort at run time), so callback-using
+    features must degrade there."""
+    try:
+        from jax._src import xla_bridge
+
+        return "axon" in xla_bridge.backends()
+    except Exception:
+        return False
 
 
 def _parse_mesh_shape(spec: str, ndev: int) -> Tuple[int, ...]:

@@ -533,3 +533,55 @@ class DeviceAccumulator:
 
     def reset(self) -> None:
         self._acc = None
+
+@EVALUATORS.register("seqtext_printer")
+class SeqTextPrinter(_Printer):
+    """Prints decoded id sequences, optionally mapped through a vocabulary —
+    the NMT-generation inspection evaluator (reference:
+    trainer_config_helpers/evaluators.py seqtext_printer_evaluator:573,
+    gserver/evaluators/Evaluator.cpp sequence text printer)."""
+
+    name = "seqtext_printer"
+
+    def __init__(self, vocab=None, delimiter=" "):
+        self.vocab = vocab
+        self.delimiter = delimiter
+
+    def batch_stats(self, *, ids):
+        return {"ids": ids}
+
+    def _rows(self, ids):
+        """Normalize [.., L] arrays, ragged python lists, and scalars to a
+        list of flat id rows (generation output is naturally ragged)."""
+        if isinstance(ids, (list, tuple)) and ids and isinstance(
+                ids[0], (list, tuple, np.ndarray)):
+            return [np.asarray(r).ravel() for r in ids]
+        arr = np.asarray(ids)
+        if arr.ndim == 0:
+            return [arr.reshape(1)]
+        if arr.ndim == 1:
+            return [arr]
+        return list(arr.reshape(-1, arr.shape[-1]))
+
+    def update(self, s):
+        for row in self._rows(s["ids"]):
+            toks = [str(int(t)) if self.vocab is None
+                    else str(self.vocab[int(t)]) for t in row]
+            self.lines.append(self.delimiter.join(toks))
+
+
+@EVALUATORS.register("classification_error_printer")
+class ClassificationErrorPrinter(_Printer):
+    """Prints the per-sample classification error of each batch (reference:
+    evaluators.py classification_error_printer_evaluator:663)."""
+
+    name = "classification_error_printer"
+
+    def batch_stats(self, *, logits, labels):
+        pred = jnp.argmax(logits, -1)
+        lab = labels.reshape(pred.shape)
+        return {"err": (pred != lab).astype(jnp.float32)}
+
+    def update(self, s):
+        self.lines.append(" ".join(f"{v:g}" for v in np.asarray(s["err"]).ravel()))
+

@@ -100,19 +100,37 @@ def default_backend() -> str:
 
 
 def on_tunnel_backend() -> bool:
-    """True when the chip is reached through the axon tunnel plugin.
+    """True when the DEFAULT backend is the axon tunnel plugin.
 
     The plugin registers under the 'axon' key but reports platform 'tpu',
     so ``jax.default_backend()`` cannot tell them apart; the backend
-    registry can.  The tunnel lacks host send/recv callbacks
+    registry can (identity-compare the default client against the axon
+    client, so a CPU run on a machine that merely has the plugin installed
+    is NOT treated as tunneled).  The tunnel lacks host send/recv callbacks
     (jax.debug.print / io_callback abort at run time), so callback-using
-    features must degrade there."""
-    try:
-        from jax._src import xla_bridge
+    features must degrade there.  If the (private) registry API moves in a
+    JAX upgrade, fail TOWARD degrading: assume tunnel whenever an axon
+    module is loaded and the platform is tpu — a skipped debug print is
+    recoverable, an aborted train step is not."""
+    global _tunnel_cached
+    if _tunnel_cached is None:
+        import sys
 
-        return "axon" in xla_bridge.backends()
-    except Exception:
-        return False
+        import jax
+
+        try:
+            from jax._src import xla_bridge
+
+            axon = xla_bridge.backends().get("axon")
+            _tunnel_cached = (axon is not None
+                              and xla_bridge.get_backend() is axon)
+        except Exception:
+            _tunnel_cached = (jax.default_backend() == "tpu"
+                              and any("axon" in m for m in sys.modules))
+    return _tunnel_cached
+
+
+_tunnel_cached: bool = None
 
 
 def _parse_mesh_shape(spec: str, ndev: int) -> Tuple[int, ...]:

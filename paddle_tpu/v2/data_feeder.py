@@ -1,0 +1,7 @@
+"""``paddle.v2.data_feeder`` facade (reference:
+python/paddle/v2/data_feeder.py — DataFeeder built from input types +
+feeding order)."""
+
+from paddle_tpu.data.feeder import DataFeeder  # noqa: F401
+
+__all__ = ["DataFeeder"]

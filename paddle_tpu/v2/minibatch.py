@@ -1,0 +1,6 @@
+"""``paddle.v2.minibatch`` facade (reference: python/paddle/v2/minibatch.py
+— a single ``batch`` function)."""
+
+from paddle_tpu.data.reader import batch  # noqa: F401
+
+__all__ = ["batch"]

@@ -1,0 +1,63 @@
+"""``paddle.v2.plot`` facade — training-curve plotting helper (reference:
+python/paddle/v2/plot/plot.py Ploter/PlotData).
+
+Data collection always works; actual drawing needs matplotlib and is
+skipped (with the data still accumulated) when it is unavailable or
+``DISABLE_PLOT=True`` — the reference honors the same env var."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Ploter", "PlotData"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """Collects (step, value) series per title and redraws on ``plot()``."""
+
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+        self._plt = None
+        if os.environ.get("DISABLE_PLOT") != "True":
+            try:
+                import matplotlib
+                matplotlib.use("Agg")  # headless-safe
+                import matplotlib.pyplot as plt
+
+                self._plt = plt
+            except Exception:
+                self._plt = None
+
+    def append(self, title, step, value):
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self._plt is None:
+            return
+        self._plt.figure()
+        for title in self.__args__:
+            d = self.__plot_data__[title]
+            self._plt.plot(d.step, d.value, label=title)
+        self._plt.legend()
+        if path:
+            self._plt.savefig(path)
+        self._plt.close()
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()

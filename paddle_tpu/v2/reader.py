@@ -1,0 +1,96 @@
+"""``paddle.v2.reader`` facade — the reader-decorator surface the reference
+exposes (reference: python/paddle/v2/reader/decorator.py __all__ and
+creator.py np_array/text_file).
+
+Decorators re-export the framework's reader combinators (data/reader.py);
+``creator`` carries the two simple reader creators."""
+
+from __future__ import annotations
+
+from paddle_tpu.data.reader import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    firstn,
+    map_readers,
+    shuffle,
+)
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle",
+    "ComposeNotAligned", "firstn", "creator",
+]
+
+_END = object()
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose when component readers disagree on length
+    (reference: v2/reader/decorator.py:44)."""
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers; each sample is the tuple of component samples (tuple
+    components flattened — the v2 compose semantics).  With
+    ``check_alignment`` (the reference default) a length mismatch raises
+    ComposeNotAligned instead of silently truncating to the shortest."""
+
+    def fuse(items):
+        out = []
+        for it in items:
+            if isinstance(it, tuple):
+                out.extend(it)
+            else:
+                out.append(it)
+        return tuple(out)
+
+    def reader():
+        import itertools
+
+        its = [r() for r in readers]
+        if not check_alignment:
+            for items in zip(*its):
+                yield fuse(items)
+            return
+        # zip_longest stops once ALL iterators are exhausted, so a sentinel
+        # in any row means the lengths genuinely disagree
+        for items in itertools.zip_longest(*its, fillvalue=_END):
+            if any(it is _END for it in items):
+                raise ComposeNotAligned(
+                    "compose: component readers have different lengths")
+            yield fuse(items)
+
+    return reader
+
+
+class _Creator:
+    """``paddle.v2.reader.creator`` namespace (creator.py)."""
+
+    @staticmethod
+    def np_array(x):
+        """Yield elements along the first axis of a numpy array (or the
+        scalar itself for 0-d)."""
+
+        def reader():
+            if getattr(x, "ndim", 1) < 1:
+                yield x
+                return
+            for e in x:
+                yield e
+
+        return reader
+
+    @staticmethod
+    def text_file(path):
+        """Yield the file's lines with the trailing newline stripped."""
+
+        def reader():
+            with open(path, "r") as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+        return reader
+
+
+creator = _Creator()

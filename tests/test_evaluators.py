@@ -176,3 +176,46 @@ def test_device_accumulator_rejects_non_additive():
             assert False, "expected ValueError"
         except ValueError:
             pass
+
+
+def test_seqtext_printer_maps_vocab():
+    """seqtext_printer renders id sequences through a vocabulary — the NMT
+    generation-inspection evaluator (reference evaluators.py:573)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.evaluators.evaluators import EVALUATORS
+
+    ev = EVALUATORS.get("seqtext_printer")(vocab={0: "<s>", 1: "hi", 2: "yo"})
+    ev.start()
+    ev.update(ev.batch_stats(ids=jnp.asarray([[0, 1, 2]])))
+    assert ev.lines == ["<s> hi yo"]
+    assert ev.result() == 1.0
+
+
+def test_classification_error_printer():
+    import jax.numpy as jnp
+
+    from paddle_tpu.evaluators.evaluators import EVALUATORS
+
+    ev = EVALUATORS.get("classification_error_printer")()
+    ev.start()
+    ev.update(ev.batch_stats(logits=jnp.asarray([[1.0, 0.0], [0.0, 1.0]]),
+                             labels=jnp.asarray([[0], [0]])))
+    assert ev.lines == ["0 1"]
+
+
+def test_v2_facade_modules():
+    """paddle.v2.reader/minibatch/plot/data_feeder module surface
+    (reference python/paddle/v2/{reader,minibatch,plot,data_feeder})."""
+    import numpy as np
+
+    import paddle_tpu.v2 as paddle
+
+    r = paddle.reader.creator.np_array(np.arange(4).reshape(2, 2))
+    assert [list(x) for x in r()] == [[0, 1], [2, 3]]
+    assert len(list(paddle.minibatch.batch(r, 2)())) == 1
+    p = paddle.plot.Ploter("train")
+    p.append("train", 0, 2.0)
+    assert p.__plot_data__["train"].value == [2.0]
+    fd = paddle.data_feeder.DataFeeder({"x": "dense"})
+    assert fd([([1.0],), ([2.0],)])["x"].shape == (2, 1)

@@ -84,3 +84,12 @@ def test_check_nan_flag_traps():
     # trap removed: silent nan again
     out = jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0))
     assert bool(jnp.isnan(out))
+
+
+def test_on_tunnel_backend_false_on_cpu():
+    """The virtual-CPU test platform must not read as the axon tunnel even
+    when the plugin is registered on the machine (identity check against
+    the DEFAULT backend, not mere registration)."""
+    from paddle_tpu.utils.devices import on_tunnel_backend
+
+    assert on_tunnel_backend() is False

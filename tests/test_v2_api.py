@@ -161,3 +161,21 @@ def test_v2_infer_generation_fields():
     np.testing.assert_array_equal(ids, ids2)
     assert prob.shape == (2, 3)
     assert np.all(np.diff(prob, axis=1) <= 1e-5)  # best-first
+
+
+def test_v2_reader_compose_alignment():
+    """compose raises ComposeNotAligned on length mismatch (the reference's
+    check_alignment=True default) instead of silently truncating."""
+    import numpy as np
+    import pytest as _pytest
+
+    import paddle_tpu.v2 as paddle
+
+    r1 = paddle.reader.creator.np_array(np.arange(3))
+    r2 = paddle.reader.creator.np_array(np.arange(2))
+    with _pytest.raises(paddle.reader.ComposeNotAligned):
+        list(paddle.reader.compose(r1, r2)())
+    assert len(list(paddle.reader.compose(r1, r1)())) == 3
+    # unaligned is allowed when explicitly requested
+    assert len(list(paddle.reader.compose(r1, r2,
+                                          check_alignment=False)())) == 2

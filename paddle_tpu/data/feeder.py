@@ -140,6 +140,15 @@ class DataFeeder:
             lengths = np.minimum(lengths, self.max_len)
         T = bucket_length(T, self.buckets)
         if kind == "ids_seq":
+            from paddle_tpu.data import native
+
+            if native.native_available():
+                # C++ pad core (csrc/dataio.cc ptd_pad_batch_i32) — the
+                # feeder's per-batch Python loop is host-CPU time stolen
+                # from the input pipeline
+                out, _ = native.pad_batch_i32(
+                    [list(s)[: lengths[i]] for i, s in enumerate(col)], T)
+                return out, lengths
             out = np.zeros((len(col), T), np.int32)
             for i, s in enumerate(col):
                 s = list(s)[: lengths[i]]

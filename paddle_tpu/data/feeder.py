@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["DataFeeder", "bucket_length"]
+__all__ = ["DataFeeder", "bucket_length", "feeder_kind_for_layer"]
 
 _DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
@@ -29,6 +29,26 @@ def bucket_length(n: int, buckets: Sequence[int] = _DEFAULT_BUCKETS) -> int:
         if n <= b:
             return b
     return n
+
+
+def feeder_kind_for_layer(layer) -> str:
+    """Derive the feeder slot kind for a data LayerOutput — THE single
+    mapping from data_spec/v2 input type to DataFeeder kinds (used by the
+    v2 trainer's auto-feeder and paddle.v2.topology.data_type)."""
+    t = layer.meta.get("v2_type")
+    if t is not None:
+        return t.feeder_kind
+    spec = layer.data_spec or {}
+    if spec.get("sparse") == "binary":
+        return "sparse_ids"
+    if spec.get("sparse") == "float":
+        return "sparse_pairs"
+    is_int = spec.get("dtype") == "int32"
+    if spec.get("nested"):
+        return "ids_nested" if is_int else "dense_nested"
+    if spec.get("is_seq"):
+        return "ids_seq" if is_int else "dense_seq"
+    return "int" if is_int else "dense"
 
 
 class DataFeeder:

@@ -30,7 +30,7 @@ from paddle_tpu.utils.devices import init  # noqa: F401
 from paddle_tpu.v2 import activation, attr, data_type, pooling  # noqa: F401
 from paddle_tpu.v2 import dataset, event, evaluator, layer, networks, optimizer  # noqa: F401
 from paddle_tpu.v2 import parameters, trainer  # noqa: F401
-from paddle_tpu.v2 import data_feeder, minibatch, plot, reader  # noqa: F401
+from paddle_tpu.v2 import data_feeder, minibatch, plot, reader, topology  # noqa: F401
 from paddle_tpu.v2.inference import infer  # noqa: F401
 from paddle_tpu.data.reader import batch  # noqa: F401
 

@@ -1,0 +1,58 @@
+"""``paddle.v2.topology`` facade (reference: python/paddle/v2/topology.py —
+Topology wraps output layers, exposes the serialized model proto, layer
+lookup, data layers, and data types for the feeder)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from paddle_tpu.nn.graph import LayerOutput
+from paddle_tpu.nn.graph import Topology as _NnTopology
+
+__all__ = ["Topology"]
+
+
+class Topology:
+    """Stores the whole network reachable from ``layers`` (plus
+    ``extra_layers``, e.g. evaluator inputs that are not costs)."""
+
+    def __init__(self, layers, extra_layers=None):
+        def check(ls):
+            ls = [ls] if isinstance(ls, LayerOutput) else list(ls)
+            for l in ls:
+                if not isinstance(l, LayerOutput):
+                    raise ValueError(
+                        f"Topology expects LayerOutput(s), got {type(l).__name__}")
+            return ls
+
+        self.layers = check(layers)
+        extra = check(extra_layers) if extra_layers is not None else []
+        self._topology = _NnTopology(self.layers + extra)
+
+    @property
+    def nn_topology(self) -> _NnTopology:
+        """The underlying compiled graph (framework-native tier)."""
+        return self._topology
+
+    def proto(self):
+        """The serialized ModelConfig (reference Topology.proto())."""
+        from paddle_tpu.config import dump_model_config
+
+        return dump_model_config(self._topology)
+
+    def get_layer(self, name: str) -> Optional[LayerOutput]:
+        for l in self._topology.layers:
+            if l.name == name:
+                return l
+        return None
+
+    def data_layers(self) -> Sequence[LayerOutput]:
+        return list(self._topology.data_layers)
+
+    def data_type(self):
+        """[(name, kind)] for every data layer, in graph order — what the
+        reference hands to DataFeeder (one shared derivation with the v2
+        trainer's auto-feeder, incl. nested and sparse slots)."""
+        from paddle_tpu.data.feeder import feeder_kind_for_layer
+
+        return [(l.name, feeder_kind_for_layer(l)) for l in self.data_layers()]

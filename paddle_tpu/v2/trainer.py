@@ -8,7 +8,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
-from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data.feeder import DataFeeder, feeder_kind_for_layer
 from paddle_tpu.nn.graph import LayerOutput
 from paddle_tpu.trainer.trainer import SGDTrainer
 from paddle_tpu.v2.parameters import Parameters
@@ -17,17 +17,7 @@ __all__ = ["SGD"]
 
 
 def _auto_feeder(topology, feeding: Optional[Dict[str, int]]):
-    types = {}
-    for l in topology.data_layers:
-        t = l.meta.get("v2_type")
-        if t is None:
-            spec = l.data_spec or {}
-            kind = "int" if spec.get("dtype") == "int32" else "dense"
-            if spec.get("is_seq"):
-                kind = "ids_seq" if kind == "int" else "dense_seq"
-            types[l.name] = kind
-        else:
-            types[l.name] = t.feeder_kind
+    types = {l.name: feeder_kind_for_layer(l) for l in topology.data_layers}
     return DataFeeder(types, feeding)
 
 

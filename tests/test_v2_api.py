@@ -179,3 +179,26 @@ def test_v2_reader_compose_alignment():
     # unaligned is allowed when explicitly requested
     assert len(list(paddle.reader.compose(r1, r2,
                                           check_alignment=False)())) == 2
+
+
+def test_v2_topology_wrapper():
+    """paddle.v2.topology.Topology: proto access, layer lookup, data layers
+    and feeder data types (reference python/paddle/v2/topology.py)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.v2 as paddle
+
+    nn.reset_naming()
+    words = nn.data("words", size=0, is_seq=True, dtype="int32")
+    emb = nn.embedding(words, 8, vocab_size=20, name="emb")
+    out = nn.fc(nn.pooling(emb, pooling_type="max"), 2, act="softmax",
+                name="out")
+    lbl = nn.data("label", size=2, dtype="int32")
+    cost = nn.classification_cost(input=out, label=lbl, name="cost")
+    topo = paddle.topology.Topology(cost)
+    assert topo.get_layer("emb") is not None
+    assert topo.get_layer("nope") is None
+    assert {n for n, _ in topo.data_type()} == {"words", "label"}
+    kinds = dict(topo.data_type())
+    assert kinds["words"] == "ids_seq" and kinds["label"] == "int"
+    mc = topo.proto()
+    assert any(lc.name == "out" for lc in mc.layers)

@@ -100,7 +100,11 @@ def _inherit_meta(node: LayerOutput, src: LayerOutput) -> LayerOutput:
     """Propagate shape/semantic metadata (spatial dims, sparse kind) through a
     pass-through layer WITHOUT copying serialization bookkeeping: blanket
     ``meta.update`` used to copy the parent's recorded ``config`` too, making
-    dropout/cmrnorm/maxout/... serialize as their parent layer."""
+    dropout/cmrnorm/maxout/... serialize as their parent layer.
+
+    Deliberately NOT inherited: ``device`` pins (``nn.device_pin``) — a
+    sharding constraint applies to the layer it was placed on; pass-through
+    layers fall where GSPMD propagates them unless pinned explicitly."""
     for key in ("hw", "sparse"):
         if key in src.meta:
             node.meta[key] = src.meta[key]
@@ -441,6 +445,22 @@ def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None
             f"pool {name!r}: output spatial dims ({oh}, {ow}) are not "
             f"positive — window {pool_size}/stride {stride}/padding "
             f"{padding!r} does not fit the {h}x{w} input")
+    # act-after-pool equals the conventional act-before-pool only for a
+    # monotone-NONDECREASING act commuting with max; avg pooling (or a
+    # non-monotone act like 'abs'/'square') breaks the identity silently
+    _MAX_COMMUTING = (None, "", "linear", "relu", "sigmoid", "tanh", "brelu",
+                      "softrelu", "stanh", "exponential", "elu")
+    if act not in (None, "", "linear"):
+        if pool_type != "max":
+            raise ConfigError(
+                f"pool {name!r}: act={act!r} is only supported with "
+                f"pool_type='max' (relu(max_pool(x)) == max_pool(relu(x)); "
+                f"no such identity holds for {pool_type!r} pooling)")
+        if act not in _MAX_COMMUTING:
+            raise ConfigError(
+                f"pool {name!r}: act={act!r} is not monotone-nondecreasing, "
+                f"so act-after-max-pool differs from the conventional "
+                f"act-before-pool; supported: {_MAX_COMMUTING[2:]}")
     op = O.max_pool2d if pool_type == "max" else O.avg_pool2d
     act_fn = O.get_activation(act)
 

@@ -14,6 +14,7 @@ from paddle_tpu.ops.crf import crf_decode, crf_nll
 from paddle_tpu.ops.ctc import ctc_loss
 from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_name
 from paddle_tpu.nn.layers import _inherit_meta
+from paddle_tpu.utils.error import ConfigError
 
 __all__ = [
     "crf_cost",
@@ -100,9 +101,20 @@ def ctc_cost(input: LayerOutput, label: LayerOutput, *,
     Blank convention follows the reference's ctc_layer: input size is
     num_classes + 1 and the blank is the LAST index (size - 1); labels use
     [0, num_classes).  For the warp-ctc convention (blank=0 by default,
-    anywhere in range) use ``warp_ctc``."""
+    anywhere in range) use ``warp_ctc``.
+
+    NOTE (convention change, round 3): the default blank moved from 0 to
+    ``input.size - 1`` for ctc_layer parity.  Callers built for blank-first
+    must pass ``blank=0`` explicitly; the static check below catches label
+    vocabularies that collide with the defaulted blank."""
     name = name or next_name("ctc_cost")
     blank_ix = input.size - 1 if blank is None else blank
+    if blank is None and label.size > blank_ix:
+        raise ConfigError(
+            f"ctc_cost {name!r}: label vocabulary ({label.size}) reaches the "
+            f"defaulted blank index {blank_ix} (= input.size - 1, the "
+            f"reference ctc_layer convention; changed from blank=0). Size "
+            f"the logits as num_classes + 1, or pass blank= explicitly")
 
     def forward(ctx, params, logits: Act, lab: Act) -> Act:
         lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)

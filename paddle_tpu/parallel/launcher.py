@@ -28,12 +28,38 @@ from paddle_tpu.utils import logger
 
 __all__ = ["ClusterLauncher", "launch_local"]
 
-_LOCAL_HOSTS = ("localhost", "127.0.0.1", "")
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1", "")
+
+
+def _parse_host(entry: str):
+    """Split 'user@host[:port]' -> (user|None, host, port|None) — the ONE
+    parser behind local-detection, the coordinator address, and ssh.
+
+    IPv6: a bare address ('::1', '2001:db8::2') never carries a port; use
+    bracket syntax '[2001:db8::2]:2222' to attach one."""
+    user, _, rest = entry.rpartition("@")
+    user = user or None
+    if rest.startswith("["):            # bracketed IPv6, optional :port
+        host, _, tail = rest[1:].partition("]")
+        port = tail[1:] if tail.startswith(":") else None
+    elif rest.count(":") == 1:          # host:port
+        host, _, port = rest.partition(":")
+    else:                               # plain host, or bare IPv6 (no port)
+        host, port = rest, None
+    return user, host, port or None
 
 
 def _host_part(entry: str) -> str:
     """'user@10.0.0.2:2222' -> '10.0.0.2' (port/user stripped)."""
-    return entry.split("@")[-1].split(":")[0]
+    return _parse_host(entry)[1]
+
+
+def _ssh_dest(entry: str):
+    """'user@10.0.0.2:2222' -> ('user@10.0.0.2', '2222'); port None if
+    absent.  ssh does not accept ':port' in the destination — it must ride a
+    separate '-p' flag."""
+    user, host, port = _parse_host(entry)
+    return (f"{user}@{host}" if user else host), port
 
 
 @dataclass
@@ -57,6 +83,8 @@ class ClusterLauncher:
         host = _host_part(self.hosts[0])
         if host in _LOCAL_HOSTS:
             host = "127.0.0.1"
+        if ":" in host:  # IPv6 literal: gRPC targets need [addr]:port
+            host = f"[{host}]"
         return f"{host}:{self.coordinator_port}"
 
     def launch(self, script: str, args: Sequence[str] = (),
@@ -73,7 +101,10 @@ class ClusterLauncher:
                 "PADDLE_TPU_NUM_PROCESSES": str(len(self.hosts)),
                 "PADDLE_TPU_PROCESS_ID": str(i),
             }
-            if _host_part(host) in _LOCAL_HOSTS:
+            dest, port = _ssh_dest(host)
+            # an explicit :port on a local name means a forwarded sshd —
+            # honor it with ssh; only a bare local name forks directly
+            if _host_part(host) in _LOCAL_HOSTS and port is None:
                 penv = {**os.environ, **(env or {}), **wiring}
                 p = subprocess.Popen([self.python, script, *args],
                                      env=penv, cwd=cwd)
@@ -85,7 +116,8 @@ class ClusterLauncher:
                 remote = (f"cd {q(cwd or '.')} && env {exports} "
                           f"{q(self.remote_python)} {q(script)} "
                           + " ".join(q(str(a)) for a in args))
-                p = subprocess.Popen([*self.ssh_cmd, host, remote])
+                port_flag = ("-p", port) if port else ()
+                p = subprocess.Popen([*self.ssh_cmd, *port_flag, dest, remote])
             logger.info("launched rank %d on %s (pid %d)", i, host or "local",
                         p.pid)
             self.procs.append(p)

@@ -91,7 +91,11 @@ def chunk(*, input: LayerOutput, label: LayerOutput, lengths: LayerOutput):
 
 def ctc_error(*, input: LayerOutput, label: LayerOutput,
               in_lengths: LayerOutput, label_lengths: LayerOutput,
-              blank: int = 0):
+              blank=None):
+    """``blank`` defaults to ``input.size - 1``, matching ``nn.ctc_cost``'s
+    ctc_layer convention (blank-last); pass 0 for warp-ctc models."""
+    if blank is None:
+        blank = input.size - 1
     gi, gl = _grab(input), _grab(label)
     gil, gll = _grab(in_lengths), _grab(label_lengths)
     ev = _E.CTCErrorEvaluator(blank=blank)

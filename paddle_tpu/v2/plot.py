@@ -44,6 +44,9 @@ class Ploter:
                 self._plt = None
 
     def append(self, title, step, value):
+        if title not in self.__plot_data__:
+            raise ValueError(
+                f"unknown plot title {title!r}; declared: {self.__args__}")
         self.__plot_data__[title].append(step, value)
 
     def plot(self, path=None):

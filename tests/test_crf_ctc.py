@@ -125,7 +125,7 @@ class TestCTC:
     def test_grad_flows_and_layer(self, rng):
         B, T, C, L = 2, 6, 5, 2
         feats = nn.data("feats", size=8, is_seq=True)
-        labels = nn.data("labels", size=C, is_seq=True, dtype="int32")
+        labels = nn.data("labels", size=C - 1, is_seq=True, dtype="int32")
         logits = nn.fc(feats, C, act="linear", name="logits")
         cost = nn.ctc_cost(logits, labels, name="ctc")
         trainer = SGDTrainer(cost, Adam(learning_rate=0.02), seed=0)

@@ -448,19 +448,20 @@ def img_pool(input: LayerOutput, *, pool_size: int, stride: Optional[int] = None
     # act-after-pool equals the conventional act-before-pool only for a
     # monotone-NONDECREASING act commuting with max; avg pooling (or a
     # non-monotone act like 'abs'/'square') breaks the identity silently
-    _MAX_COMMUTING = (None, "", "linear", "relu", "sigmoid", "tanh", "brelu",
-                      "softrelu", "stanh", "exponential", "elu")
+    _MAX_COMMUTING = ("linear", "relu", "sigmoid", "tanh", "brelu",
+                      "softrelu", "stanh", "exponential", "log", "sqrt")
     if act not in (None, "", "linear"):
         if pool_type != "max":
             raise ConfigError(
                 f"pool {name!r}: act={act!r} is only supported with "
                 f"pool_type='max' (relu(max_pool(x)) == max_pool(relu(x)); "
                 f"no such identity holds for {pool_type!r} pooling)")
-        if act not in _MAX_COMMUTING:
+        # callables pass through: the caller asserts monotonicity
+        if isinstance(act, str) and act not in _MAX_COMMUTING:
             raise ConfigError(
                 f"pool {name!r}: act={act!r} is not monotone-nondecreasing, "
                 f"so act-after-max-pool differs from the conventional "
-                f"act-before-pool; supported: {_MAX_COMMUTING[2:]}")
+                f"act-before-pool; supported: {_MAX_COMMUTING[1:]}")
     op = O.max_pool2d if pool_type == "max" else O.avg_pool2d
     act_fn = O.get_activation(act)
 

@@ -101,10 +101,11 @@ class ClusterLauncher:
                 "PADDLE_TPU_NUM_PROCESSES": str(len(self.hosts)),
                 "PADDLE_TPU_PROCESS_ID": str(i),
             }
-            dest, port = _ssh_dest(host)
+            user, hname, port = _parse_host(host)
+            dest = f"{user}@{hname}" if user else hname
             # an explicit :port on a local name means a forwarded sshd —
             # honor it with ssh; only a bare local name forks directly
-            if _host_part(host) in _LOCAL_HOSTS and port is None:
+            if hname in _LOCAL_HOSTS and port is None:
                 penv = {**os.environ, **(env or {}), **wiring}
                 p = subprocess.Popen([self.python, script, *args],
                                      env=penv, cwd=cwd)

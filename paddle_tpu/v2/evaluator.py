@@ -96,6 +96,12 @@ def ctc_error(*, input: LayerOutput, label: LayerOutput,
     ctc_layer convention (blank-last); pass 0 for warp-ctc models."""
     if blank is None:
         blank = input.size - 1
+        if label.size > blank:  # same collision guard as nn.ctc_cost
+            raise ValueError(
+                f"ctc_error: label vocabulary ({label.size}) reaches the "
+                f"defaulted blank index {blank} (= input.size - 1); size "
+                f"the logits as num_classes + 1 or pass blank= explicitly "
+                f"(0 for warp-ctc models)")
     gi, gl = _grab(input), _grab(label)
     gil, gll = _grab(in_lengths), _grab(label_lengths)
     ev = _E.CTCErrorEvaluator(blank=blank)

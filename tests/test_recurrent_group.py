@@ -287,3 +287,113 @@ class TestBeamSearchLayer:
         with pytest.raises(ConfigError):
             nn.beam_search(step, input=[nn.GeneratedInput(size=10)],
                            memories=[nn.Memory("m", 6)])
+
+
+class TestBeamOracle:
+    """Beam-search oracle tests — the analog of the reference's pinned
+    generation tests (test_recurrent_machine_generation.cpp +
+    rnn_gen_test_model_dir fixtures): exhaustive tiny-vocab equality against
+    a brute-force search, plus a checked-in golden fixture."""
+
+    V, H, L = 4, 8, 3  # vocab (bos=0, eos=1), hidden, generated length
+
+    def _lm(self):
+        """Deterministic tiny GRU LM (np.random.RandomState: stable forever,
+        unlike PRNG algorithm-versioned jax.random)."""
+        r = np.random.RandomState(42)
+        V, H = self.V, self.H
+        params = {
+            "emb": jnp.asarray(r.randn(V, H).astype(np.float32)),
+            "wx": jnp.asarray(0.5 * r.randn(H, 3 * H).astype(np.float32)),
+            "wh": jnp.asarray(0.5 * r.randn(H, 3 * H).astype(np.float32)),
+            "out": jnp.asarray(r.randn(H, V).astype(np.float32)),
+        }
+
+        def step_fn(p, tokens, mems):
+            e = jnp.take(p["emb"], tokens, axis=0)
+            h2 = O.gru_step(O.linear(e, p["wx"]), mems["h"], p["wh"])
+            return O.linear(h2, p["out"]), {"h": h2}
+
+        return params, step_fn
+
+    def _brute_force(self, params, step_fn, h0):
+        """Score EVERY genuine length-L sequence (post-eos slots all eos)
+        exactly as generate() does: sum of per-step log-softmax, finished
+        rows extend only with eos at zero cost.  Returns {seq: score}."""
+        import itertools
+
+        V, L, eos, bos = self.V, self.L, 1, 0
+        seqs = np.array(list(itertools.product(range(V), repeat=L)), np.int32)
+        N = len(seqs)
+        h = jnp.tile(h0[None], (N, 1))
+        prev = jnp.full((N,), bos, jnp.int32)
+        total = np.zeros(N, np.float64)
+        alive = np.ones(N, bool)
+        genuine = np.ones(N, bool)
+        for t in range(L):
+            logits, mems = step_fn(params, prev, {"h": h})
+            lp = np.asarray(jax.nn.log_softmax(
+                jnp.asarray(logits, jnp.float32), -1))
+            tok = seqs[:, t]
+            total += np.where(alive, lp[np.arange(N), tok], 0.0)
+            genuine &= alive | (tok == eos)  # non-eos after eos: not a path
+            alive &= tok != eos
+            h, prev = mems["h"], jnp.asarray(tok)
+        return {tuple(s): total[i] for i, s in enumerate(seqs) if genuine[i]}
+
+    def test_exhaustive_beam_equals_brute_force(self):
+        """With beam width >= V^L (every path representable), the beam search
+        must recover the GLOBAL best sequence and the exact score of every
+        genuine path — beam == brute-force argmax."""
+        V, H, L = self.V, self.H, self.L
+        params, step_fn = self._lm()
+        K = V ** L  # 64: covers all paths at every step
+        gen = nn.SequenceGenerator(step_fn, vocab_size=V)
+        h0 = jnp.zeros((H,), jnp.float32)
+        toks, scores = gen.generate(params, {"h": h0[None]}, batch_size=1,
+                                    beam_size=K, max_len=L)
+        toks, scores = np.asarray(toks[0]), np.asarray(scores[0])
+
+        oracle = self._brute_force(params, step_fn, h0)
+        # 1) global argmax: sequence and score
+        best_seq = max(oracle, key=oracle.get)
+        assert tuple(toks[0]) == best_seq
+        np.testing.assert_allclose(scores[0], oracle[best_seq],
+                                   rtol=1e-5, atol=1e-5)
+        # 2) every genuine path present exactly once with the exact score
+        found = {}
+        for k in range(K):
+            if scores[k] > -1e8:  # junk filler beams sit at ~-1e9
+                key = tuple(toks[k])
+                assert key not in found, f"duplicate beam {key}"
+                found[key] = scores[k]
+        assert set(found) == set(oracle)
+        for key, s in found.items():
+            np.testing.assert_allclose(s, oracle[key], rtol=1e-5, atol=1e-5,
+                                       err_msg=f"score mismatch for {key}")
+
+    def test_golden_fixture(self):
+        """Pinned generation against the checked-in fixture
+        (tests/golden/beam_golden.npz) — fixed RandomState(42) model, B=2
+        distinct initial states, beam 4, length 5.  Tokens must match
+        exactly; scores to 1e-4."""
+        from conftest import on_accelerator
+        if on_accelerator():
+            pytest.skip("golden floats pinned on the CPU float32 backend")
+        import os
+        path = os.path.join(os.path.dirname(__file__), "golden",
+                            "beam_golden.npz")
+        params, step_fn = self._lm()
+        r = np.random.RandomState(7)
+        h0 = jnp.asarray(r.randn(2, self.H).astype(np.float32))
+        gen = nn.SequenceGenerator(step_fn, vocab_size=self.V)
+        toks, scores = gen.generate(params, {"h": h0}, batch_size=2,
+                                    beam_size=4, max_len=5)
+        toks, scores = np.asarray(toks), np.asarray(scores)
+        if not os.path.exists(path):  # regeneration path (delete to refresh)
+            np.savez(path, tokens=toks, scores=scores)
+            pytest.fail("golden fixture was missing — regenerated from the "
+                        "CURRENT implementation; verify and commit it")
+        g = np.load(path)
+        np.testing.assert_array_equal(toks, g["tokens"])
+        np.testing.assert_allclose(scores, g["scores"], rtol=0, atol=1e-4)

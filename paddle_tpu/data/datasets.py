@@ -1,12 +1,15 @@
 """Dataset loaders — analog of python/paddle/v2/dataset.
 
 The reference auto-downloads mnist/cifar/imdb/imikolov/movielens/conll05/
-sentiment/uci_housing/wmt14 (python/paddle/v2/dataset/).  This environment has
-no network egress, so each loader (a) uses a local copy under
-``$PADDLE_TPU_DATA_HOME`` if present in the standard format, else (b) falls
-back to a *deterministic synthetic* generator with the real dataset's shapes,
-vocabulary sizes and label structure — enough to exercise and benchmark every
-model path end-to-end.
+sentiment/uci_housing/wmt14 (python/paddle/v2/dataset/).  This environment
+has no network egress, so each loader (a) parses a local copy of the REAL
+files under ``$PADDLE_TPU_DATA_HOME`` when present (format parsers:
+``data/formats.py``; expected paths in each loader's docstring), else (b)
+falls back to a *deterministic synthetic* generator with the real dataset's
+shapes, vocabulary sizes and label structure — enough to exercise and
+benchmark every model path end-to-end.  Synthetic tasks are separable by
+construction; only the real-data path is evidence of modeling power
+(tests/test_real_data.py gates convergence proofs on file presence).
 """
 
 from __future__ import annotations
@@ -18,11 +21,31 @@ from typing import Callable, Iterator, List, Tuple
 
 import numpy as np
 
+from paddle_tpu.data import formats
+
 __all__ = ["mnist", "cifar10", "imdb", "wmt14", "movielens",
            "movielens_features", "uci_housing", "imikolov", "conll05",
-           "conll05_features", "sentiment"]
+           "conll05_features", "sentiment", "data_home"]
 
-DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
+def data_home() -> str:
+    """$PADDLE_TPU_DATA_HOME, read per call (tests repoint it)."""
+    return os.environ.get("PADDLE_TPU_DATA_HOME",
+                          os.path.expanduser("~/.cache/paddle_tpu"))
+
+
+def _real(*parts: str):
+    """Path under data_home() if it exists, else None."""
+    p = os.path.join(data_home(), *parts)
+    return p if os.path.exists(p) else None
+
+
+_DICT_CACHE: dict = {}
+
+
+def _cached(key, build):
+    if key not in _DICT_CACHE:
+        _DICT_CACHE[key] = build()
+    return _DICT_CACHE[key]
 
 
 def _synth_rng(name: str, split: str) -> np.random.RandomState:
@@ -37,9 +60,10 @@ def _synth_rng(name: str, split: str) -> np.random.RandomState:
 def mnist(split: str = "train", *, n: int = 2048) -> Callable:
     """Yields (image [28,28,1] float in [0,1], label int).  Real data: idx
     files under $PADDLE_TPU_DATA_HOME/mnist/."""
-    d = os.path.join(DATA_HOME, "mnist")
-    img_f = os.path.join(d, f"{split}-images-idx3-ubyte")
-    lab_f = os.path.join(d, f"{split}-labels-idx1-ubyte")
+    d = os.path.join(data_home(), "mnist")
+    stem = "t10k" if split == "test" else split  # idx files name test 't10k'
+    img_f = os.path.join(d, f"{stem}-images-idx3-ubyte")
+    lab_f = os.path.join(d, f"{stem}-labels-idx1-ubyte")
     if os.path.exists(img_f) and os.path.exists(lab_f):
 
         def real_reader():
@@ -68,7 +92,13 @@ def mnist(split: str = "train", *, n: int = 2048) -> Callable:
 
 
 def cifar10(split: str = "train", *, n: int = 2048) -> Callable:
-    """Yields (image [32,32,3] float, label int)."""
+    """Yields (image [32,32,3] float in [0,1], label int).  Real data:
+    $PADDLE_TPU_DATA_HOME/cifar/cifar-10-python.tar.gz (the pickle tarball,
+    reference cifar.py:46-64)."""
+    tar = _real("cifar", "cifar-10-python.tar.gz")
+    if tar:
+        sub = "data_batch" if split == "train" else "test_batch"
+        return lambda: formats.iter_cifar_tar(tar, sub)
 
     def synth_reader():
         rng = _synth_rng("cifar10", split)
@@ -82,7 +112,16 @@ def cifar10(split: str = "train", *, n: int = 2048) -> Callable:
 
 
 def imdb(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Callable:
-    """Yields (word_ids list, label 0/1) — sentiment-classification shapes."""
+    """Yields (word_ids list, label 0/1; 1 = positive) —
+    sentiment-classification shapes.  Real data:
+    $PADDLE_TPU_DATA_HOME/imdb/aclImdb_v1.tar.gz (reference imdb.py:37-75);
+    the word dict is built from the train split, top ``vocab_size - 1``
+    words + <unk>."""
+    tar = _real("imdb", "aclImdb_v1.tar.gz")
+    if tar:
+        word_idx = _cached(("imdb", tar, vocab_size),
+                           lambda: formats.imdb_word_dict(tar, vocab_size))
+        return lambda: formats.iter_imdb(tar, split, word_idx)
 
     def synth_reader():
         rng = _synth_rng("imdb", split)
@@ -102,7 +141,15 @@ def wmt14(split: str = "train", *, dict_size: int = 30000, n: int = 2048) -> Cal
     """Yields (src_ids, trg_ids, trg_next_ids) — the seqToseq feed format
     (reference: demo/seqToseq/api_train_v2.py; dataset wmt14 with <s>=0,
     <e>=1, <unk>=2).  Synthetic pairs: target is a noisy transform of source
-    so attention has real structure to learn."""
+    so attention has real structure to learn.  Real data:
+    $PADDLE_TPU_DATA_HOME/wmt14/wmt14.tgz (src.dict/trg.dict + train/train,
+    test/test tab-separated pairs, reference wmt14.py:45-102)."""
+    tgz = _real("wmt14", "wmt14.tgz")
+    if tgz:
+        suffix = "train/train" if split == "train" else "test/test"
+        dicts = _cached(("wmt14", tgz, dict_size),
+                        lambda: formats.wmt14_dicts(tgz, dict_size))
+        return lambda: formats.iter_wmt14(tgz, suffix, dict_size, dicts=dicts)
 
     def synth_reader():
         rng = _synth_rng("wmt14", split)
@@ -120,7 +167,13 @@ def wmt14(split: str = "train", *, dict_size: int = 30000, n: int = 2048) -> Cal
 
 def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3706,
               n: int = 4096) -> Callable:
-    """Yields (user_id, movie_id, rating float) — recommendation shapes."""
+    """Yields (user_id, movie_id, rating float 1-5) — recommendation shapes
+    with 0-based ids.  Real data: $PADDLE_TPU_DATA_HOME/movielens/ml-1m.zip
+    (reference movielens.py:60-160; the reference keeps 1-based ids and
+    rescales ratings to 2r-5 — this loader normalizes both)."""
+    z = _real("movielens", "ml-1m.zip")
+    if z:
+        return lambda: formats.iter_movielens(z, split, features=False)
 
     def synth_reader():
         rng = _synth_rng("movielens", split)
@@ -152,8 +205,18 @@ def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
 
     Synthetic with ml-1m cardinalities; rating correlates with latent
     user/movie vectors plus a genre affinity so every feature is
-    informative."""
+    informative.  Real data: $PADDLE_TPU_DATA_HOME/movielens/ml-1m.zip —
+    ids 0-based, title ids capped at ML_SCHEMA['title_dict'], raw 1-5
+    rating (see ``movielens`` for the deviations from the reference)."""
     S = ML_SCHEMA
+    z = _real("movielens", "ml-1m.zip")
+    if z:
+        meta = _cached(("movielens", z, S["title_dict"]),
+                       lambda: formats.movielens_meta(
+                           z, title_vocab_cap=S["title_dict"]))
+        return lambda: formats.iter_movielens(
+            z, split, features=True, title_vocab_cap=S["title_dict"],
+            meta=meta)
 
     def synth_reader():
         rng = _synth_rng("movielens_features", split)
@@ -186,7 +249,14 @@ def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
     """Yields n-gram tuples (w0..w{n-2}, next_word) — the word2vec /
     n-gram-LM feed format (reference: python/paddle/v2/dataset/imikolov.py,
     demo/word2vec).  Synthetic text follows a Zipf-ish bigram chain so
-    embeddings have co-occurrence structure to learn."""
+    embeddings have co-occurrence structure to learn.  Real data:
+    $PADDLE_TPU_DATA_HOME/imikolov/simple-examples.tgz (PTB; reference
+    imikolov.py:30-88 — 'test' reads ptb.valid.txt as the reference does)."""
+    tgz = _real("imikolov", "simple-examples.tgz")
+    if tgz:
+        word_idx = _cached(("imikolov", tgz, vocab_size),
+                           lambda: formats.imikolov_word_dict(tgz, vocab_size))
+        return lambda: formats.iter_imikolov(tgz, split, word_idx, ngram)
 
     def synth_reader():
         rng = _synth_rng("imikolov", split)
@@ -203,12 +273,50 @@ def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
     return synth_reader
 
 
+def _conll05_real(vocab_size: int, n_labels: int, *, features: bool):
+    """Real-file reader for conll05/conll05_features, or None.  The public
+    CoNLL-05 release is the WSJ test set only (reference conll05.py:17-20:
+    'the default downloaded URL is test set') — every split serves it."""
+    tar = _real("conll05st", "conll05st-tests.tar.gz")
+    dicts = [_real("conll05st", f) for f in
+             ("wordDict.txt", "verbDict.txt", "targetDict.txt")]
+    if not tar or not all(dicts):
+        return None
+    wd, vd, ld = (_cached(("conll05", p), lambda p=p: formats.load_dict_file(p))
+                  for p in dicts)
+    if len(ld) > n_labels:
+        raise ValueError(
+            f"conll05: targetDict.txt has {len(ld)} labels but the model is "
+            f"sized for n_labels={n_labels}; pass n_labels={len(ld)}")
+
+    def clamp(ids):  # keep ids valid for vocab_size-sized embeddings
+        return [i if i < vocab_size else 0 for i in ids]
+
+    def reader():
+        for row in formats.iter_conll05(tar, wd, vd, ld, features=features):
+            if features:
+                w, c2, c1, c0, p1, p2, verb, mark, lab = row
+                yield (clamp(w), clamp(c2), clamp(c1), clamp(c0), clamp(p1),
+                       clamp(p2), clamp(verb), mark, lab)
+            else:
+                w, verb, lab = row
+                yield clamp(w), (verb if verb < vocab_size else 0), lab
+
+    return reader
+
+
 def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
             n: int = 1024) -> Callable:
     """Yields (word_ids, predicate_id, label_ids) — semantic-role-labeling
     sequence-tagging shapes (reference: python/paddle/v2/dataset/conll05.py,
     demo/semantic_role_labeling).  Labels use the reference's BIO scheme size
-    (67 classes)."""
+    (67 classes).  Real data under $PADDLE_TPU_DATA_HOME/conll05st/:
+    conll05st-tests.tar.gz + wordDict.txt/verbDict.txt/targetDict.txt; word
+    ids beyond ``vocab_size`` clamp to UNK (0) so embedding tables sized by
+    the parameter stay valid."""
+    r = _conll05_real(vocab_size, n_labels, features=False)
+    if r:
+        return r
 
     def synth_reader():
         rng = _synth_rng("conll05", split)
@@ -230,7 +338,10 @@ def conll05_features(split: str = "train", *, vocab_size: int = 5000,
     python/paddle/v2/dataset/conll05.py reader_creator — word_slot,
     ctx_n2/ctx_n1/ctx_0/ctx_p1/ctx_p2 slots (predicate-window words repeated
     per token), predicate slot (repeated), mark slot (1 inside the predicate
-    span), label_slot)."""
+    span), label_slot).  Real data: same files as ``conll05``."""
+    r = _conll05_real(vocab_size, n_labels, features=True)
+    if r:
+        return r
 
     def synth_reader():
         rng = _synth_rng("conll05_features", split)
@@ -253,14 +364,34 @@ def conll05_features(split: str = "train", *, vocab_size: int = 5000,
 
 
 def sentiment(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Callable:
-    """Yields (word_ids, label 0/1) — the demo/sentiment stacked-LSTM feed
-    (reference: python/paddle/v2/dataset/sentiment.py wraps NLTK movie
-    reviews; same shapes as imdb with a different corpus)."""
+    """Yields (word_ids, label 0/1; 1 = positive) — the demo/sentiment
+    stacked-LSTM feed (reference: python/paddle/v2/dataset/sentiment.py wraps
+    NLTK movie reviews).  Real data:
+    $PADDLE_TPU_DATA_HOME/sentiment/movie_reviews/{pos,neg}/*.txt (the
+    unpacked NLTK corpus layout); synthetic fallback shares imdb's
+    generator."""
+    d = _real("sentiment", "movie_reviews")
+    if d:
+        word_idx = _cached(("sentiment", d, vocab_size),
+                           lambda: formats.movie_reviews_word_dict(d, vocab_size))
+        return lambda: formats.iter_movie_reviews(d, split, word_idx)
     return imdb(split, vocab_size=vocab_size, n=n)
 
 
 def uci_housing(split: str = "train", *, n: int = 404) -> Callable:
-    """Yields (features [13], price float)."""
+    """Yields (features [13] normalized, price float).  Real data:
+    $PADDLE_TPU_DATA_HOME/uci_housing/housing.data (whitespace table;
+    (x-mean)/(max-min) normalization, 80/20 head/tail split — reference
+    uci_housing.py:57-71)."""
+    f = _real("uci_housing", "housing.data")
+    if f:
+        def real_reader():
+            train, test = _cached(("uci_housing", f),
+                                  lambda: formats.load_uci_housing(f))
+            for row in (train if split == "train" else test):
+                yield row[:13].astype(np.float32), float(row[13])
+
+        return real_reader
 
     def synth_reader():
         rng = _synth_rng("uci_housing", split)

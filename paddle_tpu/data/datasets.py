@@ -48,6 +48,20 @@ def _cached(key, build):
     return _DICT_CACHE[key]
 
 
+def _capped(reader_fn: Callable, n) -> Callable:
+    """Cap a real-data reader at ``n`` samples when an explicit size was
+    requested (n=None = the whole dataset).  Keeps n-bounded callers (tests,
+    demos) bounded even when real files are present."""
+    if n is None:
+        return reader_fn
+
+    def capped():
+        import itertools
+        return itertools.islice(reader_fn(), n)
+
+    return capped
+
+
 def _synth_rng(name: str, split: str) -> np.random.RandomState:
     # stable across processes (Python's hash() is randomized per process,
     # which would make synthetic datasets nondeterministic)
@@ -57,7 +71,7 @@ def _synth_rng(name: str, split: str) -> np.random.RandomState:
 # ---------------------------------------------------------------------------
 
 
-def mnist(split: str = "train", *, n: int = 2048) -> Callable:
+def mnist(split: str = "train", *, n: int | None = None) -> Callable:
     """Yields (image [28,28,1] float in [0,1], label int).  Real data: idx
     files under $PADDLE_TPU_DATA_HOME/mnist/."""
     d = os.path.join(data_home(), "mnist")
@@ -76,11 +90,12 @@ def mnist(split: str = "train", *, n: int = 2048) -> Callable:
             for i in range(num):
                 yield imgs[i].astype(np.float32) / 255.0, int(labs[i])
 
-        return real_reader
+        return _capped(real_reader, n)
 
     def synth_reader():
+        n_ = n if n is not None else 2048
         rng = _synth_rng("mnist", split)
-        for _ in range(n):
+        for _ in range(n_):
             label = rng.randint(0, 10)
             img = rng.rand(28, 28, 1).astype(np.float32) * 0.1
             # class-dependent blob so the task is learnable
@@ -91,18 +106,19 @@ def mnist(split: str = "train", *, n: int = 2048) -> Callable:
     return synth_reader
 
 
-def cifar10(split: str = "train", *, n: int = 2048) -> Callable:
+def cifar10(split: str = "train", *, n: int | None = None) -> Callable:
     """Yields (image [32,32,3] float in [0,1], label int).  Real data:
     $PADDLE_TPU_DATA_HOME/cifar/cifar-10-python.tar.gz (the pickle tarball,
     reference cifar.py:46-64)."""
     tar = _real("cifar", "cifar-10-python.tar.gz")
     if tar:
         sub = "data_batch" if split == "train" else "test_batch"
-        return lambda: formats.iter_cifar_tar(tar, sub)
+        return _capped(lambda: formats.iter_cifar_tar(tar, sub), n)
 
     def synth_reader():
+        n_ = n if n is not None else 2048
         rng = _synth_rng("cifar10", split)
-        for _ in range(n):
+        for _ in range(n_):
             label = rng.randint(0, 10)
             img = rng.rand(32, 32, 3).astype(np.float32) * 0.2
             img[:, :, label % 3] += 0.3 + 0.05 * label
@@ -111,7 +127,7 @@ def cifar10(split: str = "train", *, n: int = 2048) -> Callable:
     return synth_reader
 
 
-def imdb(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Callable:
+def imdb(split: str = "train", *, vocab_size: int = 5000, n: int | None = None) -> Callable:
     """Yields (word_ids list, label 0/1; 1 = positive) —
     sentiment-classification shapes.  Real data:
     $PADDLE_TPU_DATA_HOME/imdb/aclImdb_v1.tar.gz (reference imdb.py:37-75);
@@ -121,7 +137,13 @@ def imdb(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Call
     if tar:
         word_idx = _cached(("imdb", tar, vocab_size),
                            lambda: formats.imdb_word_dict(tar, vocab_size))
-        return lambda: formats.iter_imdb(tar, split, word_idx)
+        return _capped(lambda: formats.iter_imdb(tar, split, word_idx), n)
+    return _imdb_synth(split, vocab_size, n if n is not None else 1024)
+
+
+def _imdb_synth(split: str, vocab_size: int, n: int) -> Callable:
+    """Synthetic sentiment stream shared by imdb() and sentiment()'s
+    fallbacks (label-disjoint vocab halves -> separable)."""
 
     def synth_reader():
         rng = _synth_rng("imdb", split)
@@ -137,7 +159,7 @@ def imdb(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Call
     return synth_reader
 
 
-def wmt14(split: str = "train", *, dict_size: int = 30000, n: int = 2048) -> Callable:
+def wmt14(split: str = "train", *, dict_size: int = 30000, n: int | None = None) -> Callable:
     """Yields (src_ids, trg_ids, trg_next_ids) — the seqToseq feed format
     (reference: demo/seqToseq/api_train_v2.py; dataset wmt14 with <s>=0,
     <e>=1, <unk>=2).  Synthetic pairs: target is a noisy transform of source
@@ -149,11 +171,13 @@ def wmt14(split: str = "train", *, dict_size: int = 30000, n: int = 2048) -> Cal
         suffix = "train/train" if split == "train" else "test/test"
         dicts = _cached(("wmt14", tgz, dict_size),
                         lambda: formats.wmt14_dicts(tgz, dict_size))
-        return lambda: formats.iter_wmt14(tgz, suffix, dict_size, dicts=dicts)
+        return _capped(
+            lambda: formats.iter_wmt14(tgz, suffix, dict_size, dicts=dicts), n)
 
     def synth_reader():
+        n_ = n if n is not None else 2048
         rng = _synth_rng("wmt14", split)
-        for _ in range(n):
+        for _ in range(n_):
             L = rng.randint(4, 30)
             src = rng.randint(3, dict_size, L).tolist()
             # target = reversed source with id shift (mod vocab), phrase-ish
@@ -166,22 +190,24 @@ def wmt14(split: str = "train", *, dict_size: int = 30000, n: int = 2048) -> Cal
 
 
 def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3706,
-              n: int = 4096) -> Callable:
+              n: int | None = None) -> Callable:
     """Yields (user_id, movie_id, rating float 1-5) — recommendation shapes
     with 0-based ids.  Real data: $PADDLE_TPU_DATA_HOME/movielens/ml-1m.zip
     (reference movielens.py:60-160; the reference keeps 1-based ids and
     rescales ratings to 2r-5 — this loader normalizes both)."""
     z = _real("movielens", "ml-1m.zip")
     if z:
-        return lambda: formats.iter_movielens(z, split, features=False)
+        return _capped(
+            lambda: formats.iter_movielens(z, split, features=False), n)
 
     def synth_reader():
+        n_ = n if n is not None else 4096
         rng = _synth_rng("movielens", split)
         u_bias = rng.randn(n_users) * 0.5
         m_bias = rng.randn(n_movies) * 0.5
         u_vec = rng.randn(n_users, 8)
         m_vec = rng.randn(n_movies, 8)
-        for _ in range(n):
+        for _ in range(n_):
             u = rng.randint(0, n_users)
             m = rng.randint(0, n_movies)
             r = 3.0 + u_bias[u] + m_bias[m] + 0.3 * float(u_vec[u] @ m_vec[m])
@@ -197,7 +223,7 @@ ML_SCHEMA = dict(n_users=6040, n_movies=3952, n_genders=2, n_ages=7,
                  n_jobs=21, n_categories=18, title_dict=5175)
 
 
-def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
+def movielens_features(split: str = "train", *, n: int | None = None) -> Callable:
     """Yields the 8-slot full-feature rows of the reference MovieLens demo
     (reference: python/paddle/v2/dataset/movielens.py train()/test() yield
     user.value() + movie.value() + [rating]): (user_id, gender_id, age_id,
@@ -214,11 +240,12 @@ def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
         meta = _cached(("movielens", z, S["title_dict"]),
                        lambda: formats.movielens_meta(
                            z, title_vocab_cap=S["title_dict"]))
-        return lambda: formats.iter_movielens(
+        return _capped(lambda: formats.iter_movielens(
             z, split, features=True, title_vocab_cap=S["title_dict"],
-            meta=meta)
+            meta=meta), n)
 
     def synth_reader():
+        n_ = n if n is not None else 4096
         rng = _synth_rng("movielens_features", split)
         nu, nm = S["n_users"], S["n_movies"]
         u_vec = rng.randn(nu, 8)
@@ -227,7 +254,7 @@ def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
                            rng.randint(0, S["n_ages"], nu),
                            rng.randint(0, S["n_jobs"], nu)], 1)
         genre_aff = rng.randn(S["n_genders"], S["n_categories"]) * 0.3
-        for _ in range(n):
+        for _ in range(n_):
             u = rng.randint(0, nu)
             m = rng.randint(0, nm)
             cats = sorted(rng.choice(S["n_categories"],
@@ -245,7 +272,7 @@ def movielens_features(split: str = "train", *, n: int = 4096) -> Callable:
 
 
 def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
-             n: int = 4096) -> Callable:
+             n: int | None = None) -> Callable:
     """Yields n-gram tuples (w0..w{n-2}, next_word) — the word2vec /
     n-gram-LM feed format (reference: python/paddle/v2/dataset/imikolov.py,
     demo/word2vec).  Synthetic text follows a Zipf-ish bigram chain so
@@ -256,14 +283,16 @@ def imikolov(split: str = "train", *, vocab_size: int = 2000, ngram: int = 5,
     if tgz:
         word_idx = _cached(("imikolov", tgz, vocab_size),
                            lambda: formats.imikolov_word_dict(tgz, vocab_size))
-        return lambda: formats.iter_imikolov(tgz, split, word_idx, ngram)
+        return _capped(
+            lambda: formats.iter_imikolov(tgz, split, word_idx, ngram), n)
 
     def synth_reader():
+        n_ = n if n is not None else 4096
         rng = _synth_rng("imikolov", split)
         # bigram transition: each word prefers a small successor set
         succ = rng.randint(0, vocab_size, (vocab_size, 4))
         w = rng.randint(0, vocab_size)
-        for _ in range(n):
+        for _ in range(n_):
             ctx = []
             for _ in range(ngram):
                 w = int(succ[w, rng.randint(0, 4)]) if rng.rand() < 0.8 else rng.randint(0, vocab_size)
@@ -306,7 +335,7 @@ def _conll05_real(vocab_size: int, n_labels: int, *, features: bool):
 
 
 def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
-            n: int = 1024) -> Callable:
+            n: int | None = None) -> Callable:
     """Yields (word_ids, predicate_id, label_ids) — semantic-role-labeling
     sequence-tagging shapes (reference: python/paddle/v2/dataset/conll05.py,
     demo/semantic_role_labeling).  Labels use the reference's BIO scheme size
@@ -316,11 +345,12 @@ def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
     the parameter stay valid."""
     r = _conll05_real(vocab_size, n_labels, features=False)
     if r:
-        return r
+        return _capped(r, n)
 
     def synth_reader():
+        n_ = n if n is not None else 1024
         rng = _synth_rng("conll05", split)
-        for _ in range(n):
+        for _ in range(n_):
             L = rng.randint(5, 40)
             words = rng.randint(2, vocab_size, L).tolist()
             pred_pos = rng.randint(0, L)
@@ -333,7 +363,7 @@ def conll05(split: str = "train", *, vocab_size: int = 5000, n_labels: int = 67,
 
 
 def conll05_features(split: str = "train", *, vocab_size: int = 5000,
-                     n_labels: int = 67, n: int = 1024) -> Callable:
+                     n_labels: int = 67, n: int | None = None) -> Callable:
     """Yields the reference's full 9-slot SRL rows (reference:
     python/paddle/v2/dataset/conll05.py reader_creator — word_slot,
     ctx_n2/ctx_n1/ctx_0/ctx_p1/ctx_p2 slots (predicate-window words repeated
@@ -341,11 +371,12 @@ def conll05_features(split: str = "train", *, vocab_size: int = 5000,
     span), label_slot).  Real data: same files as ``conll05``."""
     r = _conll05_real(vocab_size, n_labels, features=True)
     if r:
-        return r
+        return _capped(r, n)
 
     def synth_reader():
+        n_ = n if n is not None else 1024
         rng = _synth_rng("conll05_features", split)
-        for _ in range(n):
+        for _ in range(n_):
             L = rng.randint(5, 40)
             words = rng.randint(2, vocab_size, L).tolist()
             p = rng.randint(0, L)
@@ -363,22 +394,24 @@ def conll05_features(split: str = "train", *, vocab_size: int = 5000,
     return synth_reader
 
 
-def sentiment(split: str = "train", *, vocab_size: int = 5000, n: int = 1024) -> Callable:
+def sentiment(split: str = "train", *, vocab_size: int = 5000, n: int | None = None) -> Callable:
     """Yields (word_ids, label 0/1; 1 = positive) — the demo/sentiment
     stacked-LSTM feed (reference: python/paddle/v2/dataset/sentiment.py wraps
     NLTK movie reviews).  Real data:
     $PADDLE_TPU_DATA_HOME/sentiment/movie_reviews/{pos,neg}/*.txt (the
-    unpacked NLTK corpus layout); synthetic fallback shares imdb's
-    generator."""
+    unpacked NLTK corpus layout); the fallback is imdb's SYNTHETIC
+    generator (never real aclImdb — a different corpus under this name
+    would be misleading)."""
     d = _real("sentiment", "movie_reviews")
     if d:
         word_idx = _cached(("sentiment", d, vocab_size),
                            lambda: formats.movie_reviews_word_dict(d, vocab_size))
-        return lambda: formats.iter_movie_reviews(d, split, word_idx)
-    return imdb(split, vocab_size=vocab_size, n=n)
+        return _capped(
+            lambda: formats.iter_movie_reviews(d, split, word_idx), n)
+    return _imdb_synth(split, vocab_size, n if n is not None else 1024)
 
 
-def uci_housing(split: str = "train", *, n: int = 404) -> Callable:
+def uci_housing(split: str = "train", *, n: int | None = None) -> Callable:
     """Yields (features [13] normalized, price float).  Real data:
     $PADDLE_TPU_DATA_HOME/uci_housing/housing.data (whitespace table;
     (x-mean)/(max-min) normalization, 80/20 head/tail split — reference
@@ -391,12 +424,13 @@ def uci_housing(split: str = "train", *, n: int = 404) -> Callable:
             for row in (train if split == "train" else test):
                 yield row[:13].astype(np.float32), float(row[13])
 
-        return real_reader
+        return _capped(real_reader, n)
 
     def synth_reader():
+        n_ = n if n is not None else 404
         rng = _synth_rng("uci_housing", split)
         w = rng.randn(13)
-        for _ in range(n):
+        for _ in range(n_):
             x = rng.randn(13).astype(np.float32)
             y = float(x @ w + rng.randn() * 0.1 + 22.0)
             yield x, y

@@ -102,13 +102,35 @@ def imdb_word_dict(tar_path: str, vocab_size: int) -> Dict[str, int]:
 
 def iter_imdb(tar_path: str, split: str,
               word_idx: Dict[str, int]) -> Iterator[Tuple[List[int], int]]:
-    """Yield (word_ids, label) with label 1 = positive (this repo's imdb
-    convention; the reference enumerates pos/neg alternately instead)."""
+    """Yield (word_ids, label) with label 1 = positive, ALTERNATING classes
+    like the reference's queue-based cross-read (imdb.py:77-110) — vital
+    because the tarball stores each class contiguously, so a head-slice of
+    archive order (e.g. an ``n=`` cap) would otherwise be single-label.
+    One sequential decompress scan; the leading class buffers in memory
+    until the other starts (~12.5k docs worst case)."""
+    from collections import deque
+
     unk = word_idx["<unk>"]
-    for sense, label in (("pos", 1), ("neg", 0)):
-        pat = re.compile(rf"aclImdb/{split}/{sense}/.*\.txt$")
-        for doc in _iter_imdb_docs(tar_path, pat):
-            yield [word_idx.get(w, unk) for w in doc], label
+    pat = re.compile(rf"aclImdb/{split}/(pos|neg)/.*\.txt$")
+    queues = {0: deque(), 1: deque()}
+    want = 1  # pos first, then strict alternation while both classes flow
+    with tarfile.open(tar_path, mode="r") as tf:
+        member = tf.next()
+        while member is not None:
+            m = pat.match(member.name) if member.isfile() else None
+            if m:
+                raw = tf.extractfile(member).read().decode("utf-8", "replace")
+                doc = raw.rstrip("\n\r").translate(_PUNCT_TABLE).lower().split()
+                queues[1 if m.group(1) == "pos" else 0].append(
+                    [word_idx.get(w, unk) for w in doc])
+                while queues[want]:
+                    yield queues[want].popleft(), want
+                    want = 1 - want
+            member = tf.next()
+    while queues[0] or queues[1]:  # unbalanced tail drains every other turn
+        if queues[want]:
+            yield queues[want].popleft(), want
+        want = 1 - want
 
 
 # ---------------------------------------------------------------------------
@@ -369,23 +391,35 @@ def _iter_conll05_sentences(tar_path: str):
             raise ValueError(f"{tar_path}: missing words/props members")
         with gzip.GzipFile(fileobj=tf.extractfile(words_m)) as wf, \
                 gzip.GzipFile(fileobj=tf.extractfile(props_m)) as pf:
+            import itertools
+
             words: List[str] = []
             rows: List[List[str]] = []
-            for wraw, praw in zip(wf, pf):
+
+            def flush():
+                lemmas = [r[0] for r in rows]
+                verbs = [l for l in lemmas if l != "-"]
+                n_pred = len(rows[0]) - 1
+                for p in range(n_pred):
+                    tags = [r[1 + p] for r in rows]
+                    yield words, verbs[p], _bio_from_brackets(tags)
+
+            for wraw, praw in itertools.zip_longest(wf, pf):
+                if wraw is None or praw is None:
+                    raise ValueError(
+                        f"{tar_path}: words/props line counts differ — "
+                        "corrupt or mismatched corpus files")
                 word = wraw.decode("utf-8", "replace").strip()
                 cols = praw.decode("utf-8", "replace").strip().split()
                 if not cols:  # sentence boundary
                     if rows:
-                        lemmas = [r[0] for r in rows]
-                        verbs = [l for l in lemmas if l != "-"]
-                        n_pred = len(rows[0]) - 1
-                        for p in range(n_pred):
-                            tags = [r[1 + p] for r in rows]
-                            yield words, verbs[p], _bio_from_brackets(tags)
+                        yield from flush()
                     words, rows = [], []
                 else:
                     words.append(word)
                     rows.append(cols)
+            if rows:  # final sentence without a trailing blank line
+                yield from flush()
 
 
 def iter_conll05(tar_path: str, word_dict: Dict[str, int],

@@ -50,8 +50,17 @@ def _interpret() -> bool:
 
 
 def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
-                 h_scr, c_scr, *, hidden: int, mxu_dtype):
+                 *rest, hidden: int, mxu_dtype):
     from jax.experimental import pallas as pl
+
+    # rest carries the optional residual outputs before the two scratch
+    # refs: (zseq, hprev, cprev, h_scr, c_scr) in training, (h_scr, c_scr)
+    # on the residual-free inference variant
+    save_residuals = len(rest) == 5
+    if save_residuals:
+        zseq_ref, hprev_ref, cprev_ref, h_scr, c_scr = rest
+    else:
+        h_scr, c_scr = rest
 
     t = pl.program_id(0)
     T = pl.num_programs(0)
@@ -78,6 +87,12 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
     h_new = o * jnp.tanh(c_new)
     m = m_ref[0]                            # [B, 1]
     keep = m > 0
+    if save_residuals:
+        # backward residuals: pre-activations + held carries stream straight
+        # out of the forward, so the backward pass needs NO replay scan
+        zseq_ref[0] = z
+        hprev_ref[0] = h
+        cprev_ref[0] = c
     h_new = jnp.where(keep, h_new, h)
     c_new = jnp.where(keep, c_new, c)
     h_scr[...] = h_new
@@ -93,7 +108,11 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
         cfin_ref[...] = c_new
 
 
-def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
+def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
+    """``residuals=False`` (inference / primal-only forward) skips the
+    z/h_prev/c_prev outputs entirely — pallas_call is opaque to XLA, so
+    unused outputs would otherwise be materialized to HBM (hundreds of MB
+    at the gate ceiling), not DCE'd."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -103,6 +122,27 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
     H = H4 // 4
     kernel = functools.partial(_lstm_kernel, hidden=H,
                                mxu_dtype=compute_dtype())
+    out_specs = [
+        pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        pl.BlockSpec((B, H), lambda t: (0, 0)),
+        pl.BlockSpec((B, H), lambda t: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+    ]
+    if residuals:
+        out_specs += [
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((T, B, H4), jnp.float32),   # z residual
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # h_prev
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # c_prev
+        ]
     return pl.pallas_call(
         kernel,
         grid=(T,),
@@ -111,16 +151,8 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h):
             pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
             pl.BlockSpec((H, H4), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((B, H), lambda t: (0, 0)),
-            pl.BlockSpec((B, H), lambda t: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
@@ -163,7 +195,8 @@ def lstm_forward_pallas(xp, mask, w_h):
     the hand-written fast backward."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-    h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
+    h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
+                                      residuals=False)
     return jnp.moveaxis(h_tb, 0, 1), h_f, c_f
 
 
@@ -187,9 +220,15 @@ lstm_forward_pallas.defvjp(_lstm_fwd, _lstm_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *,
+def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
                 hidden: int, mxu_dtype):
     from jax.experimental import pallas as pl
+
+    save_residuals = len(rest) == 3  # (zseq, hprev, h_scr) vs (h_scr,)
+    if save_residuals:
+        zseq_ref, hprev_ref, h_scr = rest
+    else:
+        (h_scr,) = rest
 
     t = pl.program_id(0)
     T = pl.num_programs(0)
@@ -207,11 +246,16 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *,
                                   preferred_element_type=jnp.float32)
     r = jax.nn.sigmoid(zr[:, :H])
     u = jax.nn.sigmoid(zr[:, H:])
-    cand = jnp.tanh(xp[:, 2 * H :] + jnp.dot((r * h).astype(mxu_dtype),
-                                             w[:, 2 * H :],
-                                             preferred_element_type=jnp.float32))
+    zc = xp[:, 2 * H :] + jnp.dot((r * h).astype(mxu_dtype), w[:, 2 * H :],
+                                  preferred_element_type=jnp.float32)
+    cand = jnp.tanh(zc)
     h_new = u * h + (1.0 - u) * cand
     m = m_ref[0]
+    if save_residuals:
+        # backward residuals (see _lstm_kernel)
+        zseq_ref[0, :, : 2 * H] = zr
+        zseq_ref[0, :, 2 * H:] = zc
+        hprev_ref[0] = h
     h_new = jnp.where(m > 0, h_new, h)
     h_scr[...] = h_new
     hseq_ref[0] = h_new * m
@@ -221,7 +265,9 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, h_scr, *,
         hfin_ref[...] = h_new
 
 
-def _gru_pallas_raw(xp_tb, mask_tb, w_h):
+def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
+    """``residuals=False``: inference variant without the z/h_prev outputs
+    (see _lstm_pallas_raw)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -231,6 +277,23 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h):
     H = H3 // 3
     kernel = functools.partial(_gru_kernel, hidden=H,
                                mxu_dtype=compute_dtype())
+    out_specs = [
+        pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        pl.BlockSpec((B, H), lambda t: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+    ]
+    if residuals:
+        out_specs += [
+            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((T, B, H3), jnp.float32),   # z residual
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # h_prev
+        ]
     return pl.pallas_call(
         kernel,
         grid=(T,),
@@ -239,14 +302,8 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h):
             pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
             pl.BlockSpec((H, H3), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((B, H), lambda t: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
         interpret=_interpret(),
     )(xp_tb, mask_tb[..., None], w_h)
@@ -277,7 +334,8 @@ def gru_forward_pallas(xp, mask, w_h):
     ops/rnn_fused.gru_sequence_fused — see lstm_forward_pallas."""
     xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
     m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-    h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32))
+    h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
+                                residuals=False)
     return jnp.moveaxis(h_tb, 0, 1), h_f
 
 
@@ -294,3 +352,173 @@ def _gru_bwd(res, ct):
 
 
 gru_forward_pallas.defvjp(_gru_fwd, _gru_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Backward time-loop kernels: the reverse scans of rnn_fused as single
+# Pallas programs.  Residuals (z, carries) stream in per step, the d_h/d_c
+# cotangent carries live in VMEM scratch, the transposed recurrent weight
+# stays resident, and the per-step d_z cotangent streams out — the
+# hand-written reverse half of hl_cuda_lstm.cu, TPU-style.  The batched
+# d_w_h einsum and d_xp remain outside (they are one-shot MXU ops).
+# ---------------------------------------------------------------------------
+
+
+def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, dhfin_ref,
+                     dcfin_ref, dz_ref, dh0_ref, dc0_ref, dh_scr, dc_scr, *,
+                     hidden: int):
+    """One reverse step (grid runs t = T-1 .. 0 via the index maps).
+    Mirrors rnn_fused._lstm_seq_bwd.rev_step numerics exactly (f32)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)  # first grid step == last timestep: d_hfin/d_cfin seed
+    def _init():
+        dh_scr[...] = dhfin_ref[...]
+        dc_scr[...] = dcfin_ref[...]
+
+    d_h = dh_scr[...]
+    d_c = dc_scr[...]
+    z = z_ref[0]
+    cp = cp_ref[0]
+    i = jax.nn.sigmoid(z[:, :H])
+    f = jax.nn.sigmoid(z[:, H: 2 * H])
+    o = jax.nn.sigmoid(z[:, 2 * H: 3 * H])
+    g = jnp.tanh(z[:, 3 * H:])
+    tc = jnp.tanh(f * cp + i * g)
+    m = m_ref[0]
+    mcol = (m > 0).astype(jnp.float32)
+    d_hnew = mcol * (dout_ref[0] + d_h)
+    d_cnew = mcol * d_c + d_hnew * o * (1.0 - tc * tc)
+    d_z = jnp.concatenate([
+        d_cnew * g * i * (1 - i),
+        d_cnew * cp * f * (1 - f),
+        d_hnew * tc * o * (1 - o),
+        d_cnew * i * (1 - g * g)], -1)
+    d_hp = jnp.dot(d_z, wt_ref[...], preferred_element_type=jnp.float32)
+    dh_scr[...] = (1.0 - mcol) * d_h + d_hp
+    dc_scr[...] = (1.0 - mcol) * d_c + d_cnew * f
+    dz_ref[0] = d_z
+
+    @pl.when(t == T - 1)  # last grid step == timestep 0
+    def _fin():
+        dh0_ref[...] = dh_scr[...]
+        dc0_ref[...] = dc_scr[...]
+
+
+def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, d_hfin, d_cfin):
+    """dout/m/z/cp: [T,B,*] f32; w_t: [4H,H] (w_h transposed);
+    d_hfin/d_cfin: [B,H] cotangent seeds (loaded into the carry scratch at
+    the last timestep — they propagate through masked tails exactly as the
+    scan's initial carry does).  Returns (d_z [T,B,4H], d_h0, d_c0)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, H4 = z_tb.shape
+    H = H4 // 4
+    rev = lambda t: (T - 1 - t, 0, 0)
+    kernel = functools.partial(_lstm_bwd_kernel, hidden=H)
+    d_z, d_h0, d_c0 = pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, 1), rev),
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((H4, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H4), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dout_tb, m_tb[..., None], z_tb, cp_tb, w_t, d_hfin, d_cfin)
+    return d_z, d_h0, d_c0
+
+
+def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
+                    dz_ref, dh0_ref, dh_scr, *, hidden: int):
+    """Reverse GRU step — mirrors rnn_fused._gru_seq_bwd.rev_step (f32)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)  # d_hfin seeds the carry at the last timestep
+    def _init():
+        dh_scr[...] = dhfin_ref[...]
+
+    d_c = dh_scr[...]
+    z = z_ref[0]
+    hp = hp_ref[0]
+    r = jax.nn.sigmoid(z[:, :H])
+    u = jax.nn.sigmoid(z[:, H: 2 * H])
+    cand = jnp.tanh(z[:, 2 * H:])
+    m = m_ref[0]
+    mcol = (m > 0).astype(jnp.float32)
+    d_hnew = mcol * (dout_ref[0] + d_c)
+    d_u = d_hnew * (hp - cand)
+    d_zc = d_hnew * (1.0 - u) * (1.0 - cand * cand)
+    w_t = wt_ref[...]
+    d_rh = jnp.dot(d_zc, w_t[2 * H:, :], preferred_element_type=jnp.float32)
+    d_r = d_rh * hp
+    d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
+    d_hp = (d_hnew * u + d_rh * r
+            + jnp.dot(d_zr, w_t[: 2 * H, :],
+                      preferred_element_type=jnp.float32))
+    dh_scr[...] = (1.0 - mcol) * d_c + d_hp
+    dz_ref[0, :, : 2 * H] = d_zr
+    dz_ref[0, :, 2 * H:] = d_zc
+
+    @pl.when(t == T - 1)
+    def _fin():
+        dh0_ref[...] = dh_scr[...]
+
+
+def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, B, H3 = z_tb.shape
+    H = H3 // 3
+    rev = lambda t: (T - 1 - t, 0, 0)
+    kernel = functools.partial(_gru_bwd_kernel, hidden=H)
+    return pl.pallas_call(
+        kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((1, B, 1), rev),
+            pl.BlockSpec((1, B, H3), rev),
+            pl.BlockSpec((1, B, H), rev),
+            pl.BlockSpec((H3, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H3), rev),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H3), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        interpret=_interpret(),
+    )(dout_tb, m_tb[..., None], z_tb, hp_tb, w_t, d_hfin)

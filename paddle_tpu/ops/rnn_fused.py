@@ -2,18 +2,24 @@
 
 Same restructuring as ops/attention_decoder.py, applied to the plain
 recurrent layers (the encoder of the seq2seq flagship, stacked LSTM/GRU text
-models): XLA's autodiff of the time scan accumulates the recurrent weight
-gradient (3-6 MB) through HBM on every reverse step; the hand-written VJP
-emits the small per-step pre-activation cotangents instead and reconstructs
-``d_w_h`` afterwards as one batched MXU contraction
-(``einsum('tbh,tbz->hz', h_prev, d_z)``), which also serves as ``d_xp``
-directly since the input projection enters the cell additively.
+models).  Two structural changes vs XLA's autodiff of the time scan:
 
-Forward runs the fused Pallas time-loop kernel when the shape gate allows
-(ops/pallas_kernels.py), else the masked lax.scan — both inside the same
-custom_vjp, so the fast backward applies either way.  Semantics match
-``scan_rnn`` + ``gru_step``/``lstm_step`` exactly (carry held and outputs
-zeroed at masked steps); equivalence is pinned by tests/test_rnn_fused.py.
+1. The forward (Pallas kernel or masked lax.scan — one numerics source of
+   truth either way) SAVES the per-step pre-activations ``z`` and the held
+   carries ``h_prev``/``c_prev``.  The backward therefore needs NO forward
+   replay scan: the time-sequential work drops from three T-length loops
+   per layer (fwd + replay + reverse) to two (fwd + reverse), and the
+   reverse step recomputes gates from ``z`` with pure elementwise math —
+   its only matmul is the unavoidable ``d_z @ w_h^T`` carry propagation.
+2. The recurrent weight gradient is NOT dragged through the scan: the
+   reverse loop emits the small per-step cotangents ``d_z`` and ``d_w_h``
+   is reconstructed afterwards as one batched MXU contraction
+   (``einsum('tbh,tbz->hz', h_prev, d_z)``), which also serves as ``d_xp``
+   directly since the input projection enters the cell additively.
+
+Semantics match ``scan_rnn`` + ``gru_step``/``lstm_step`` exactly (carry
+held and outputs zeroed at masked steps); equivalence is pinned by
+tests/test_rnn_fused.py.
 
 Reference analog: the fused CUDA cells hl_cuda_lstm.cu:26-58 /
 hl_gru_ops.cuh — the reference hand-writes both directions of its hot
@@ -38,13 +44,24 @@ from paddle_tpu.ops.matmul import linear
 __all__ = ["gru_sequence_fused", "lstm_sequence_fused"]
 
 
+def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
+    """Backward Pallas gate: same tile/VMEM constraints as the forward
+    (_use_pallas_rnn), evaluated without the boot-state checks (residuals
+    already encode them)."""
+    from paddle_tpu.ops.rnn import _use_pallas_rnn
+
+    return _use_pallas_rnn(batch, hidden, None, None, None, None, None,
+                           "tanh", "sigmoid", "tanh", False)
+
+
 # ---------------------------------------------------------------------------
 # GRU
 # ---------------------------------------------------------------------------
 
 
 def _gru_fwd_scan(xp, mask, w_h, h0):
-    """Masked forward scan; xp [B,T,3H], mask [B,T] -> h_seq [B,T,H], h_fin.
+    """Masked forward scan; xp [B,T,3H], mask [B,T] -> (h_seq [B,T,H],
+    h_fin, z_tb [T,B,3H] pre-activations, hprev_tb [T,B,H]).
     Mirrors scan_rnn(gru_step) numerics (bf16 matmul operands in linear)."""
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
@@ -54,14 +71,16 @@ def _gru_fwd_scan(xp, mask, w_h, h0):
         xp_t, m_t = inp
         zr = xp_t[..., : 2 * H] + linear(h, w_h[:, : 2 * H])
         r, u = jnp.split(jax.nn.sigmoid(zr), 2, axis=-1)
-        cand = jnp.tanh(xp_t[..., 2 * H:] + linear(r * h, w_h[:, 2 * H:]))
+        zc = xp_t[..., 2 * H:] + linear(r * h, w_h[:, 2 * H:])
+        cand = jnp.tanh(zc)
         h_new = u * h + (1.0 - u) * cand
         keep = (m_t > 0)[:, None]
         h_out = jnp.where(keep, h_new, h)
-        return h_out, h_out * m_t[:, None].astype(h_out.dtype)
+        z = jnp.concatenate([zr, zc], -1)
+        return h_out, (h_out * m_t[:, None].astype(h_out.dtype), z, h)
 
-    h_fin, outs = lax.scan(step, h0, (xp_tb, m_tb))
-    return jnp.moveaxis(outs, 0, 1), h_fin
+    h_fin, (outs, z_tb, hprev_tb) = lax.scan(step, h0, (xp_tb, m_tb))
+    return jnp.moveaxis(outs, 0, 1), h_fin, z_tb, hprev_tb
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -70,10 +89,14 @@ def gru_sequence_fused(xp, mask, w_h, h0, allow_pallas=False):
     ``allow_pallas`` (static) lets the forward use the Pallas time-loop
     kernel — only legal when the caller statically knows h0 is zeros (the
     kernel boots from zeros)."""
-    return _gru_core_fwd(xp, mask, w_h, h0, allow_pallas)
+    # primal-only call (inference, no grad pending): skip the residuals —
+    # the Pallas outputs would be materialized to HBM even if unused
+    h_seq, h_fin = _gru_core_fwd(xp, mask, w_h, h0, allow_pallas,
+                                 residuals=False)[:2]
+    return h_seq, h_fin
 
 
-def _gru_core_fwd(xp, mask, w_h, h0, allow_pallas):
+def _gru_core_fwd(xp, mask, w_h, h0, allow_pallas, *, residuals=True):
     if allow_pallas:
         from paddle_tpu.ops.rnn import _use_pallas_rnn
 
@@ -85,72 +108,82 @@ def _gru_core_fwd(xp, mask, w_h, h0, allow_pallas):
 
             xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
             m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-            h_tb, h_fin = _gru_pallas_raw(xp_tb, m_tb,
-                                          w_h.astype(jnp.float32))
-            return jnp.moveaxis(h_tb, 0, 1), h_fin
-    return _gru_fwd_scan(xp, mask, w_h, h0)
+            outs = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
+                                   residuals=residuals)
+            h_tb, h_fin = outs[0], outs[1]
+            z_tb, hprev_tb = (outs[2], outs[3]) if residuals else (None, None)
+            return jnp.moveaxis(h_tb, 0, 1), h_fin, z_tb, hprev_tb
+    out = _gru_fwd_scan(xp, mask, w_h, h0)
+    return out if residuals else (out[0], out[1], None, None)
 
 
 def _gru_seq_fwd(xp, mask, w_h, h0, allow_pallas):
-    h_seq, h_fin = _gru_core_fwd(xp, mask, w_h, h0, allow_pallas)
-    return (h_seq, h_fin), (xp, mask, w_h, h0, h_seq)
+    h_seq, h_fin, z_tb, hprev_tb = _gru_core_fwd(xp, mask, w_h, h0,
+                                                 allow_pallas)
+    # zero-size sentinels carry the caller dtypes through the residual
+    # pytree (dtype objects are not valid JAX residuals)
+    meta = (jnp.zeros((0,), xp.dtype), jnp.zeros((0,), h0.dtype))
+    return (h_seq, h_fin), (mask, w_h, z_tb, hprev_tb, meta)
 
 
 def _gru_seq_bwd(allow_pallas, res, ct):
-    xp, mask, w_h, h0, h_seq = res
+    mask, w_h, z_tb, hprev_tb, (xp_s, h0_s) = res
+    xp_dtype, h0_dtype = xp_s.dtype, h0_s.dtype
     d_hseq, d_hfin = ct
-    B, T, H3 = xp.shape
+    T, B, H3 = z_tb.shape
     H = H3 // 3
     f32 = jnp.float32
     w_f = w_h.astype(f32)
 
-    xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
     d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
-    # reconstruct the held carry at masked steps (saved h_seq is zeroed there)
-    def carry_fix(c, om):
-        out_t, m_t = om
-        c_t = jnp.where((m_t > 0)[:, None], out_t, c)
-        return c_t, c_t
-    _, carries = lax.scan(carry_fix, h0, (jnp.moveaxis(h_seq, 1, 0), m_tb))
-    h_prev = jnp.concatenate([h0[None], carries[:-1]], 0)   # [T,B,H]
+    hp_f = hprev_tb.astype(f32)
 
-    def rev_step(d_c, inp):
-        d_out_t, m_t, xp_t, hp_t = inp
-        mcol = (m_t > 0)[:, None].astype(f32)
-        d_hnew = mcol * (d_out_t + d_c)
-        hp = hp_t.astype(f32)
-        zr = xp_t[..., : 2 * H].astype(f32) + linear(hp_t, w_h[:, : 2 * H]).astype(f32)
-        ru = jax.nn.sigmoid(zr)
-        r, u = jnp.split(ru, 2, axis=-1)
-        rh = r * hp
-        cand = jnp.tanh(xp_t[..., 2 * H:].astype(f32)
-                        + linear((r * hp_t.astype(f32)).astype(hp_t.dtype),
-                                 w_h[:, 2 * H:]).astype(f32))
-        d_u = d_hnew * (hp - cand)
-        d_cand = d_hnew * (1.0 - u)
-        d_hp = d_hnew * u
-        d_zc = d_cand * (1.0 - cand * cand)
-        d_rh = d_zc @ w_f[:, 2 * H:].T
-        d_r = d_rh * hp
-        d_hp = d_hp + d_rh * r
-        d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
-        d_hp = d_hp + d_zr @ w_f[:, : 2 * H].T
-        d_xp_t = jnp.concatenate([d_zr, d_zc], -1)
-        d_c_out = (1.0 - mcol) * d_c + d_hp
-        return d_c_out, (d_xp_t, rh)
+    T_, B = m_tb.shape
+    if allow_pallas and _bwd_pallas_ok(B, H):
+        from paddle_tpu.ops.pallas_kernels import _gru_bwd_pallas_raw
 
-    d_c0 = d_hfin.astype(f32)
-    d_h0, (d_xp_tb, rh_tb) = lax.scan(
-        rev_step, d_c0, (d_out_tb, m_tb, xp_tb, h_prev), reverse=True)
+        d_xp_tb, d_h0 = _gru_bwd_pallas_raw(
+            d_out_tb, m_tb.astype(f32), z_tb.astype(f32), hp_f,
+            w_f.T.copy(), d_hfin.astype(f32))
+    else:
+        # gates recomputed from the SAVED pre-activations, vectorized over
+        # all timesteps at once (pure elementwise — XLA fuses; no replay)
+        z_f = z_tb.astype(f32)
+        ru = jax.nn.sigmoid(z_f[..., : 2 * H])
+        r = ru[..., :H]
+        u = ru[..., H:]
+        cand = jnp.tanh(z_f[..., 2 * H:])
+
+        def rev_step(d_c, inp):
+            d_out_t, m_t, r_t, u_t, cand_t, hp_t = inp
+            mcol = (m_t > 0)[:, None].astype(f32)
+            d_hnew = mcol * (d_out_t + d_c)
+            d_u = d_hnew * (hp_t - cand_t)
+            d_cand = d_hnew * (1.0 - u_t)
+            d_hp = d_hnew * u_t
+            d_zc = d_cand * (1.0 - cand_t * cand_t)
+            d_rh = d_zc @ w_f[:, 2 * H:].T
+            d_r = d_rh * hp_t
+            d_hp = d_hp + d_rh * r_t
+            d_zr = jnp.concatenate(
+                [d_r * r_t * (1 - r_t), d_u * u_t * (1 - u_t)], -1)
+            d_hp = d_hp + d_zr @ w_f[:, : 2 * H].T
+            d_xp_t = jnp.concatenate([d_zr, d_zc], -1)
+            d_c_out = (1.0 - mcol) * d_c + d_hp
+            return d_c_out, d_xp_t
+
+        d_h0, d_xp_tb = lax.scan(
+            rev_step, d_hfin.astype(f32),
+            (d_out_tb, m_tb, r, u, cand, hp_f), reverse=True)
 
     # batched weight gradient: zr part against h_prev, cand part against r*h
-    hp_f = h_prev.astype(f32)
+    rh = jax.nn.sigmoid(z_tb[..., :H].astype(f32)) * hp_f
     d_w_gates = jnp.einsum("tbh,tbz->hz", hp_f, d_xp_tb[..., : 2 * H])
-    d_w_cand = jnp.einsum("tbh,tbz->hz", rh_tb, d_xp_tb[..., 2 * H:])
+    d_w_cand = jnp.einsum("tbh,tbz->hz", rh, d_xp_tb[..., 2 * H:])
     d_wh = jnp.concatenate([d_w_gates, d_w_cand], axis=1).astype(w_h.dtype)
-    d_xp = jnp.moveaxis(d_xp_tb, 0, 1).astype(xp.dtype)
-    return d_xp, None, d_wh, d_h0.astype(h0.dtype)
+    d_xp = jnp.moveaxis(d_xp_tb, 0, 1).astype(xp_dtype)
+    return d_xp, None, d_wh, d_h0.astype(h0_dtype)
 
 
 gru_sequence_fused.defvjp(_gru_seq_fwd, _gru_seq_bwd)
@@ -162,7 +195,8 @@ gru_sequence_fused.defvjp(_gru_seq_fwd, _gru_seq_bwd)
 
 
 def _lstm_fwd_scan(xp, mask, w_h, h0, c0):
-    """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step)."""
+    """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step)
+    -> (h_seq, h_fin, c_fin, z_tb [T,B,4H], hprev_tb, cprev_tb)."""
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
@@ -180,18 +214,23 @@ def _lstm_fwd_scan(xp, mask, w_h, h0, c0):
         keep = (m_t > 0)[:, None]
         h_out = jnp.where(keep, h_new, h)
         c_out = jnp.where(keep, c_new, c)
-        return (h_out, c_out), h_out * m_t[:, None].astype(h_out.dtype)
+        return ((h_out, c_out),
+                (h_out * m_t[:, None].astype(h_out.dtype), z, h, c))
 
-    (h_fin, c_fin), outs = lax.scan(step, (h0, c0), (xp_tb, m_tb))
-    return jnp.moveaxis(outs, 0, 1), h_fin, c_fin
+    (h_fin, c_fin), (outs, z_tb, hprev_tb, cprev_tb) = lax.scan(
+        step, (h0, c0), (xp_tb, m_tb))
+    return jnp.moveaxis(outs, 0, 1), h_fin, c_fin, z_tb, hprev_tb, cprev_tb
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(5,))
 def lstm_sequence_fused(xp, mask, w_h, h0, c0, allow_pallas=False):
-    return _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas)
+    # primal-only call (inference): residual-free variant — see GRU twin
+    h_seq, h_fin, c_fin = _lstm_core_fwd(xp, mask, w_h, h0, c0,
+                                         allow_pallas, residuals=False)[:3]
+    return h_seq, h_fin, c_fin
 
 
-def _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas):
+def _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas, *, residuals=True):
     if allow_pallas:
         from paddle_tpu.ops.rnn import _use_pallas_rnn
 
@@ -203,85 +242,85 @@ def _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas):
 
             xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
             m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-            h_tb, h_fin, c_fin = _lstm_pallas_raw(xp_tb, m_tb,
-                                                  w_h.astype(jnp.float32))
-            return jnp.moveaxis(h_tb, 0, 1), h_fin, c_fin
-    return _lstm_fwd_scan(xp, mask, w_h, h0, c0)
+            outs = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
+                                    residuals=residuals)
+            h_tb, h_fin, c_fin = outs[0], outs[1], outs[2]
+            z_tb, hprev_tb, cprev_tb = (
+                (outs[3], outs[4], outs[5]) if residuals
+                else (None, None, None))
+            return (jnp.moveaxis(h_tb, 0, 1), h_fin, c_fin,
+                    z_tb, hprev_tb, cprev_tb)
+    out = _lstm_fwd_scan(xp, mask, w_h, h0, c0)
+    return out if residuals else (out[0], out[1], out[2], None, None, None)
 
 
 def _lstm_seq_fwd(xp, mask, w_h, h0, c0, allow_pallas):
-    h_seq, h_fin, c_fin = _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas)
-    return (h_seq, h_fin, c_fin), (xp, mask, w_h, h0, c0)
+    h_seq, h_fin, c_fin, z_tb, hprev_tb, cprev_tb = _lstm_core_fwd(
+        xp, mask, w_h, h0, c0, allow_pallas)
+    meta = (jnp.zeros((0,), xp.dtype), jnp.zeros((0,), h0.dtype),
+            jnp.zeros((0,), c0.dtype))  # dtype sentinels (see GRU fwd)
+    return ((h_seq, h_fin, c_fin),
+            (mask, w_h, z_tb, hprev_tb, cprev_tb, meta))
 
 
 def _lstm_seq_bwd(allow_pallas, res, ct):
-    xp, mask, w_h, h0, c0 = res
+    mask, w_h, z_tb, hprev_tb, cprev_tb, (xp_s, h0_s, c0_s) = res
+    xp_dt, h0_dt, c0_dt = xp_s.dtype, h0_s.dtype, c0_s.dtype
     d_hseq, d_hfin, d_cfin = ct
-    B, T, H4 = xp.shape
+    T, B, H4 = z_tb.shape
     H = H4 // 4
     f32 = jnp.float32
     w_f = w_h.astype(f32)
 
-    xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
     d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
 
-    # forward replay: the only sequential recurrent matmul of the backward —
-    # emits h_prev and the pre-activations z so rev_step is matmul-free on
-    # the recompute side (the c carry is not saved by fwd, so a replay is
-    # needed either way)
-    def replay(carry, inp):
-        h, c = carry
-        xp_t, m_t = inp
-        z = xp_t + linear(h, w_h)
+    T_, B = m_tb.shape
+    if allow_pallas and _bwd_pallas_ok(B, H):
+        from paddle_tpu.ops.pallas_kernels import _lstm_bwd_pallas_raw
+
+        d_z_tb, d_h0, d_c0 = _lstm_bwd_pallas_raw(
+            d_out_tb, m_tb.astype(f32), z_tb.astype(f32),
+            cprev_tb.astype(f32), w_f.T.copy(),
+            d_hfin.astype(f32), d_cfin.astype(f32))
+    else:
+        # gate math vectorized over every timestep from the saved z/c_prev —
+        # the reverse scan below is left with elementwise chain math plus
+        # the single unavoidable carry matmul d_z @ w^T
+        z = z_tb.astype(f32)
+        cp = cprev_tb.astype(f32)
         i = jax.nn.sigmoid(z[..., :H])
         f = jax.nn.sigmoid(z[..., H: 2 * H])
         o = jax.nn.sigmoid(z[..., 2 * H: 3 * H])
         g = jnp.tanh(z[..., 3 * H:])
-        c_new = f * c + i * g
-        h_new = o * jnp.tanh(c_new)
-        keep = (m_t > 0)[:, None]
-        h_out = jnp.where(keep, h_new, h)
-        c_out = jnp.where(keep, c_new, c)
-        return (h_out, c_out), (h, c, z)
+        tc = jnp.tanh(f * cp + i * g)
 
-    _, (h_prev, c_prev, z_all) = lax.scan(replay, (h0, c0), (xp_tb, m_tb))
+        def rev_step(carry, inp):
+            d_h, d_c = carry
+            d_out_t, m_t, i_t, f_t, o_t, g_t, tc_t, cp_t = inp
+            mcol = (m_t > 0)[:, None].astype(f32)
+            d_hnew = mcol * (d_out_t + d_h)
+            d_cnew = mcol * d_c + d_hnew * o_t * (1.0 - tc_t * tc_t)
+            d_f = d_cnew * cp_t
+            d_i = d_cnew * g_t
+            d_g = d_cnew * i_t
+            d_cp = d_cnew * f_t
+            d_z = jnp.concatenate([
+                d_i * i_t * (1 - i_t), d_f * f_t * (1 - f_t),
+                d_hnew * tc_t * o_t * (1 - o_t), d_g * (1 - g_t * g_t)], -1)
+            d_hp = d_z @ w_f.T
+            d_h_out = (1.0 - mcol) * d_h + d_hp
+            d_c_out = (1.0 - mcol) * d_c + d_cp
+            return (d_h_out, d_c_out), d_z
 
-    def rev_step(carry, inp):
-        d_h, d_c = carry
-        d_out_t, m_t, z_t, cp_t = inp
-        mcol = (m_t > 0)[:, None].astype(f32)
-        d_hnew = mcol * (d_out_t + d_h)
-        d_cnew = mcol * d_c
-        cp = cp_t.astype(f32)
-        z = z_t.astype(f32)
-        i = jax.nn.sigmoid(z[..., :H])
-        f = jax.nn.sigmoid(z[..., H: 2 * H])
-        o = jax.nn.sigmoid(z[..., 2 * H: 3 * H])
-        g = jnp.tanh(z[..., 3 * H:])
-        c_new = f * cp + i * g
-        tc = jnp.tanh(c_new)
-        d_o = d_hnew * tc
-        d_cnew = d_cnew + d_hnew * o * (1.0 - tc * tc)
-        d_f = d_cnew * cp
-        d_i = d_cnew * g
-        d_g = d_cnew * i
-        d_cp = d_cnew * f
-        d_z = jnp.concatenate([
-            d_i * i * (1 - i), d_f * f * (1 - f),
-            d_o * o * (1 - o), d_g * (1 - g * g)], -1)
-        d_hp = d_z @ w_f.T
-        d_h_out = (1.0 - mcol) * d_h + d_hp
-        d_c_out = (1.0 - mcol) * d_c + d_cp
-        return (d_h_out, d_c_out), d_z
+        (d_h0, d_c0), d_z_tb = lax.scan(
+            rev_step, (d_hfin.astype(f32), d_cfin.astype(f32)),
+            (d_out_tb, m_tb, i, f, o, g, tc, cp), reverse=True)
 
-    (d_h0, d_c0), d_z_tb = lax.scan(
-        rev_step, (d_hfin.astype(f32), d_cfin.astype(f32)),
-        (d_out_tb, m_tb, z_all, c_prev), reverse=True)
-
-    d_wh = jnp.einsum("tbh,tbz->hz", h_prev.astype(f32), d_z_tb).astype(w_h.dtype)
-    d_xp = jnp.moveaxis(d_z_tb, 0, 1).astype(xp.dtype)
-    return d_xp, None, d_wh, d_h0.astype(h0.dtype), d_c0.astype(c0.dtype)
+    d_wh = jnp.einsum("tbh,tbz->hz",
+                      hprev_tb.astype(f32), d_z_tb).astype(w_h.dtype)
+    d_xp = jnp.moveaxis(d_z_tb, 0, 1).astype(xp_dt)
+    return d_xp, None, d_wh, d_h0.astype(h0_dt), d_c0.astype(c0_dt)
 
 
 lstm_sequence_fused.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)

@@ -4,7 +4,8 @@ Replaces the reference's MultiGradientMachine (single-node DP),
 ParameterServer2 tier (multi-node DP), and ParallelNeuralNetwork (layer-device
 model parallelism) with mesh shardings + XLA collectives, and adds the modern
 strategies the reference predates: tensor parallelism, sequence parallelism
-(ring attention), sharded embeddings. See SURVEY.md §2 parallelism map & §5.8.
+(ring attention), pipeline parallelism (GPipe over a 'stage' axis,
+``pipeline.py``), sharded embeddings. See SURVEY.md §2 parallelism map & §5.8.
 """
 
 from paddle_tpu.parallel.sharding import (
@@ -15,6 +16,12 @@ from paddle_tpu.parallel.sharding import (
     P,
 )
 from paddle_tpu.parallel.api import make_parallel_train_step, shard_batch
+from paddle_tpu.parallel.pipeline import (
+    stack_stage_params,
+    shard_stage_params,
+    pipeline_apply,
+    make_pipeline_train_step,
+)
 from paddle_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
 from paddle_tpu.parallel.embedding import sharded_embedding_lookup, shard_table
 from paddle_tpu.parallel.distributed import (

@@ -110,3 +110,59 @@ def test_lstm_pallas_matches_scan_bf16_policy(rng):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(c_f_p), np.asarray(c_f),
                                rtol=1e-5, atol=1e-6)
+
+
+class TestBackwardKernels:
+    """The Pallas reverse-loop kernels (interpret mode) must produce the
+    exact gradients of the scan forward they pair with in rnn_fused."""
+
+    def test_lstm_fused_grads_with_pallas_bwd(self, rng, monkeypatch):
+        from paddle_tpu.ops.rnn_fused import lstm_sequence_fused
+        B, T, H = 4, 6, 8
+        xp, mask, w_h = _data(rng, B=B, T=T, H=H, gates=4)
+        z = jnp.zeros((B, H), jnp.float32)
+        ct_seq = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
+        ct_h = jnp.asarray(rng.randn(B, H).astype(np.float32))
+        ct_c = jnp.asarray(rng.randn(B, H).astype(np.float32))
+
+        def obj(fn):
+            def f(xp, w_h):
+                h_seq, h_f, c_f = fn(xp, mask, w_h, z, z, True)
+                return ((h_seq * ct_seq).sum() + (h_f * ct_h).sum()
+                        + (c_f * ct_c).sum())
+            return f
+
+        # reference: identical function with the scan backward (gate off)
+        monkeypatch.setattr("paddle_tpu.ops.rnn_fused._bwd_pallas_ok",
+                            lambda B, H: False)
+        g_ref = jax.grad(obj(lstm_sequence_fused), (0, 1))(xp, w_h)
+        monkeypatch.setattr("paddle_tpu.ops.rnn_fused._bwd_pallas_ok",
+                            lambda B, H: True)
+        g_pal = jax.grad(obj(lstm_sequence_fused), (0, 1))(xp, w_h)
+        for a, b in zip(g_ref, g_pal):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_gru_fused_grads_with_pallas_bwd(self, rng, monkeypatch):
+        from paddle_tpu.ops.rnn_fused import gru_sequence_fused
+        B, T, H = 4, 6, 8
+        xp, mask, w_h = _data(rng, B=B, T=T, H=H, gates=3)
+        z = jnp.zeros((B, H), jnp.float32)
+        ct_seq = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
+        ct_h = jnp.asarray(rng.randn(B, H).astype(np.float32))
+
+        def obj():
+            def f(xp, w_h):
+                h_seq, h_f = gru_sequence_fused(xp, mask, w_h, z, True)
+                return (h_seq * ct_seq).sum() + (h_f * ct_h).sum()
+            return f
+
+        monkeypatch.setattr("paddle_tpu.ops.rnn_fused._bwd_pallas_ok",
+                            lambda B, H: False)
+        g_ref = jax.grad(obj(), (0, 1))(xp, w_h)
+        monkeypatch.setattr("paddle_tpu.ops.rnn_fused._bwd_pallas_ok",
+                            lambda B, H: True)
+        g_pal = jax.grad(obj(), (0, 1))(xp, w_h)
+        for a, b in zip(g_ref, g_pal):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)

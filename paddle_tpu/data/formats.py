@@ -75,13 +75,16 @@ def iter_cifar_tar(path: str, sub_name: str) -> Iterator[Tuple[np.ndarray, int]]
 _PUNCT_TABLE = str.maketrans("", "", string.punctuation)
 
 
-def _iter_imdb_docs(tar_path: str, pattern: re.Pattern) -> Iterator[List[str]]:
+def _iter_imdb_docs(tar_path: str, pattern: re.Pattern):
+    """Yield (match, tokens) for members matching ``pattern`` — ONE
+    sequential decompress scan; tokenization lives here and only here."""
     with tarfile.open(tar_path, mode="r") as tf:
         member = tf.next()  # sequential scan: the tarball is ~80k tiny files
         while member is not None:
-            if member.isfile() and pattern.match(member.name):
+            m = pattern.match(member.name) if member.isfile() else None
+            if m:
                 raw = tf.extractfile(member).read().decode("utf-8", "replace")
-                yield raw.rstrip("\n\r").translate(_PUNCT_TABLE).lower().split()
+                yield m, raw.rstrip("\n\r").translate(_PUNCT_TABLE).lower().split()
             member = tf.next()
 
 
@@ -91,7 +94,7 @@ def imdb_word_dict(tar_path: str, vocab_size: int) -> Dict[str, int]:
     cutoff expressed as a vocab cap."""
     freq: Dict[str, int] = defaultdict(int)
     pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
-    for doc in _iter_imdb_docs(tar_path, pat):
+    for _, doc in _iter_imdb_docs(tar_path, pat):
         for w in doc:
             freq[w] += 1
     ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
@@ -114,19 +117,12 @@ def iter_imdb(tar_path: str, split: str,
     pat = re.compile(rf"aclImdb/{split}/(pos|neg)/.*\.txt$")
     queues = {0: deque(), 1: deque()}
     want = 1  # pos first, then strict alternation while both classes flow
-    with tarfile.open(tar_path, mode="r") as tf:
-        member = tf.next()
-        while member is not None:
-            m = pat.match(member.name) if member.isfile() else None
-            if m:
-                raw = tf.extractfile(member).read().decode("utf-8", "replace")
-                doc = raw.rstrip("\n\r").translate(_PUNCT_TABLE).lower().split()
-                queues[1 if m.group(1) == "pos" else 0].append(
-                    [word_idx.get(w, unk) for w in doc])
-                while queues[want]:
-                    yield queues[want].popleft(), want
-                    want = 1 - want
-            member = tf.next()
+    for m, doc in _iter_imdb_docs(tar_path, pat):
+        queues[1 if m.group(1) == "pos" else 0].append(
+            [word_idx.get(w, unk) for w in doc])
+        while queues[want]:
+            yield queues[want].popleft(), want
+            want = 1 - want
     while queues[0] or queues[1]:  # unbalanced tail drains every other turn
         if queues[want]:
             yield queues[want].popleft(), want

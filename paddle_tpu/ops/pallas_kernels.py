@@ -49,7 +49,8 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
+def _lstm_kernel(xp_ref, m_ref, wh_ref, pi_ref, pf_ref, po_ref,
+                 hseq_ref, hfin_ref, cfin_ref,
                  *rest, hidden: int, mxu_dtype):
     from jax.experimental import pallas as pl
 
@@ -79,11 +80,13 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
     z = xp + jnp.dot(h.astype(mxu_dtype), wh_ref[...].astype(mxu_dtype),
                      preferred_element_type=jnp.float32)
     H = hidden
-    i = jax.nn.sigmoid(z[:, :H])
-    f = jax.nn.sigmoid(z[:, H : 2 * H])
-    o = jax.nn.sigmoid(z[:, 2 * H : 3 * H])
+    # peephole ("check") vectors ride resident [1,H] blocks; zeros = plain
+    # cell (hl_lstm_ops.cuh: i,f see c_prev, o sees c_new)
+    i = jax.nn.sigmoid(z[:, :H] + pi_ref[0] * c)
+    f = jax.nn.sigmoid(z[:, H : 2 * H] + pf_ref[0] * c)
     g = jnp.tanh(z[:, 3 * H :])
     c_new = f * c + i * g
+    o = jax.nn.sigmoid(z[:, 2 * H : 3 * H] + po_ref[0] * c_new)
     h_new = o * jnp.tanh(c_new)
     m = m_ref[0]                            # [B, 1]
     keep = m > 0
@@ -108,11 +111,14 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, cfin_ref,
         cfin_ref[...] = c_new
 
 
-def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
-    """``residuals=False`` (inference / primal-only forward) skips the
-    z/h_prev/c_prev outputs entirely — pallas_call is opaque to XLA, so
-    unused outputs would otherwise be materialized to HBM (hundreds of MB
-    at the gate ceiling), not DCE'd."""
+def _lstm_pallas_raw(xp_tb, mask_tb, w_h, pi, pf, po, *,
+                     residuals: bool = True):
+    """TIME-MAJOR: xp [T,B,4H], mask [T,B] — Mosaic requires the last two
+    block dims tile-aligned or full, so time must lead; callers transpose
+    once per layer.  ``residuals=False`` (inference / primal-only forward)
+    skips the z/h_prev/c_prev outputs entirely — pallas_call is opaque to
+    XLA, so unused outputs would otherwise be materialized to HBM (hundreds
+    of MB at the gate ceiling), not DCE'd."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -122,8 +128,9 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
     H = H4 // 4
     kernel = functools.partial(_lstm_kernel, hidden=H,
                                mxu_dtype=compute_dtype())
+    step = lambda t: (t, 0, 0)
     out_specs = [
-        pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        pl.BlockSpec((1, B, H), step),
         pl.BlockSpec((B, H), lambda t: (0, 0)),
         pl.BlockSpec((B, H), lambda t: (0, 0)),
     ]
@@ -134,9 +141,9 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
     ]
     if residuals:
         out_specs += [
-            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H4), step),
+            pl.BlockSpec((1, B, H), step),
+            pl.BlockSpec((1, B, H), step),
         ]
         out_shape += [
             jax.ShapeDtypeStruct((T, B, H4), jnp.float32),   # z residual
@@ -147,9 +154,12 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
         kernel,
         grid=(T,),
         in_specs=[
-            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H4), step),
+            pl.BlockSpec((1, B, 1), step),
             pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -158,7 +168,8 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
             pltpu.VMEM((B, H), jnp.float32),
         ],
         interpret=_interpret(),
-    )(xp_tb, mask_tb[..., None], w_h)
+    )(xp_tb, mask_tb[..., None], w_h, pi.reshape(1, H), pf.reshape(1, H),
+      po.reshape(1, H))
 
 
 def _lstm_reference(xp, mask, w_h):
@@ -193,10 +204,12 @@ def lstm_forward_pallas(xp, mask, w_h):
     autodiff-of-reference).  The PRODUCTION path is
     ops/rnn_fused.lstm_sequence_fused, which pairs the same raw kernel with
     the hand-written fast backward."""
-    xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
-    m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-    h_tb, h_f, c_f = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
-                                      residuals=False)
+    H = w_h.shape[0]
+    zp = jnp.zeros((H,), jnp.float32)
+    h_tb, h_f, c_f = _lstm_pallas_raw(
+        jnp.moveaxis(xp.astype(jnp.float32), 1, 0),
+        jnp.moveaxis(mask.astype(jnp.float32), 1, 0),
+        w_h.astype(jnp.float32), zp, zp, zp, residuals=False)
     return jnp.moveaxis(h_tb, 0, 1), h_f, c_f
 
 
@@ -266,8 +279,8 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
 
 
 def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
-    """``residuals=False``: inference variant without the z/h_prev outputs
-    (see _lstm_pallas_raw)."""
+    """TIME-MAJOR (see _lstm_pallas_raw).  ``residuals=False``: inference
+    variant without the z/h_prev outputs."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -277,8 +290,9 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
     H = H3 // 3
     kernel = functools.partial(_gru_kernel, hidden=H,
                                mxu_dtype=compute_dtype())
+    step = lambda t: (t, 0, 0)
     out_specs = [
-        pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        pl.BlockSpec((1, B, H), step),
         pl.BlockSpec((B, H), lambda t: (0, 0)),
     ]
     out_shape = [
@@ -287,8 +301,8 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
     ]
     if residuals:
         out_specs += [
-            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H3), step),
+            pl.BlockSpec((1, B, H), step),
         ]
         out_shape += [
             jax.ShapeDtypeStruct((T, B, H3), jnp.float32),   # z residual
@@ -298,8 +312,8 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
         kernel,
         grid=(T,),
         in_specs=[
-            pl.BlockSpec((1, B, H3), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, 1), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H3), step),
+            pl.BlockSpec((1, B, 1), step),
             pl.BlockSpec((H, H3), lambda t: (0, 0)),
         ],
         out_specs=out_specs,
@@ -332,10 +346,10 @@ def gru_forward_pallas(xp, mask, w_h):
 
     Direct kernel entry (tests/interpret mode); production uses
     ops/rnn_fused.gru_sequence_fused — see lstm_forward_pallas."""
-    xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
-    m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
-    h_tb, h_f = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
-                                residuals=False)
+    h_tb, h_f = _gru_pallas_raw(
+        jnp.moveaxis(xp.astype(jnp.float32), 1, 0),
+        jnp.moveaxis(mask.astype(jnp.float32), 1, 0),
+        w_h.astype(jnp.float32), residuals=False)
     return jnp.moveaxis(h_tb, 0, 1), h_f
 
 
@@ -364,12 +378,21 @@ gru_forward_pallas.defvjp(_gru_fwd, _gru_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, dhfin_ref,
-                     dcfin_ref, dz_ref, dh0_ref, dc0_ref, dh_scr, dc_scr, *,
-                     hidden: int):
+def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, pi_ref,
+                     pf_ref, po_ref, dhfin_ref, dcfin_ref,
+                     dz_ref, *rest, hidden: int):
     """One reverse step (grid runs t = T-1 .. 0 via the index maps).
-    Mirrors rnn_fused._lstm_seq_bwd.rev_step numerics exactly (f32)."""
+    Mirrors rnn_fused._lstm_seq_bwd.rev_step numerics exactly (f32),
+    including peephole feedthrough; streams c_new back out for the d_po
+    reduction when peepholes are live (rest = (cn_ref, dh0, dc0, scratches)
+    or (dh0, dc0, scratches))."""
     from jax.experimental import pallas as pl
+
+    if len(rest) == 5:
+        cn_ref, dh0_ref, dc0_ref, dh_scr, dc_scr = rest
+    else:
+        cn_ref = None
+        dh0_ref, dc0_ref, dh_scr, dc_scr = rest
 
     t = pl.program_id(0)
     T = pl.num_programs(0)
@@ -384,24 +407,31 @@ def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, dhfin_ref,
     d_c = dc_scr[...]
     z = z_ref[0]
     cp = cp_ref[0]
-    i = jax.nn.sigmoid(z[:, :H])
-    f = jax.nn.sigmoid(z[:, H: 2 * H])
-    o = jax.nn.sigmoid(z[:, 2 * H: 3 * H])
+    pi = pi_ref[0]
+    pf = pf_ref[0]
+    po = po_ref[0]
+    i = jax.nn.sigmoid(z[:, :H] + pi * cp)
+    f = jax.nn.sigmoid(z[:, H: 2 * H] + pf * cp)
     g = jnp.tanh(z[:, 3 * H:])
-    tc = jnp.tanh(f * cp + i * g)
+    cn = f * cp + i * g
+    o = jax.nn.sigmoid(z[:, 2 * H: 3 * H] + po * cn)
+    tc = jnp.tanh(cn)
     m = m_ref[0]
     mcol = (m > 0).astype(jnp.float32)
     d_hnew = mcol * (dout_ref[0] + d_h)
-    d_cnew = mcol * d_c + d_hnew * o * (1.0 - tc * tc)
+    d_zo = d_hnew * tc * o * (1 - o)
+    d_cnew = mcol * d_c + d_hnew * o * (1.0 - tc * tc) + d_zo * po
+    d_zi = d_cnew * g * i * (1 - i)
+    d_zf = d_cnew * cp * f * (1 - f)
     d_z = jnp.concatenate([
-        d_cnew * g * i * (1 - i),
-        d_cnew * cp * f * (1 - f),
-        d_hnew * tc * o * (1 - o),
-        d_cnew * i * (1 - g * g)], -1)
+        d_zi, d_zf, d_zo, d_cnew * i * (1 - g * g)], -1)
     d_hp = jnp.dot(d_z, wt_ref[...], preferred_element_type=jnp.float32)
     dh_scr[...] = (1.0 - mcol) * d_h + d_hp
-    dc_scr[...] = (1.0 - mcol) * d_c + d_cnew * f
+    dc_scr[...] = ((1.0 - mcol) * d_c + d_cnew * f
+                   + d_zi * pi + d_zf * pf)
     dz_ref[0] = d_z
+    if cn_ref is not None:
+        cn_ref[0] = cn
 
     @pl.when(t == T - 1)  # last grid step == timestep 0
     def _fin():
@@ -409,11 +439,14 @@ def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, dhfin_ref,
         dc0_ref[...] = dc_scr[...]
 
 
-def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, d_hfin, d_cfin):
-    """dout/m/z/cp: [T,B,*] f32; w_t: [4H,H] (w_h transposed);
-    d_hfin/d_cfin: [B,H] cotangent seeds (loaded into the carry scratch at
-    the last timestep — they propagate through masked tails exactly as the
-    scan's initial carry does).  Returns (d_z [T,B,4H], d_h0, d_c0)."""
+def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, pi, pf, po,
+                         d_hfin, d_cfin, *, want_cn: bool = True):
+    """TIME-MAJOR: dout/m/z/cp [T,B,*] f32; w_t: [4H,H] (w_h transposed);
+    pi/pf/po: [1,H] peephole rows; d_hfin/d_cfin: [B,H] cotangent seeds
+    (loaded into the carry scratch at the last timestep — they propagate
+    through masked tails exactly as the scan's initial carry does).
+    Returns (d_z [T,B,4H], c_new [T,B,H] for the d_po reduction, d_h0,
+    d_c0)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -421,7 +454,20 @@ def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, d_hfin, d_cfin):
     H = H4 // 4
     rev = lambda t: (T - 1 - t, 0, 0)
     kernel = functools.partial(_lstm_bwd_kernel, hidden=H)
-    d_z, d_h0, d_c0 = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, B, H4), rev)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H4), jnp.float32)]
+    if want_cn:  # c_new residual only feeds d_po — skip it for zero peeps
+        out_specs.append(pl.BlockSpec((1, B, H), rev))
+        out_shape.append(jax.ShapeDtypeStruct((T, B, H), jnp.float32))
+    out_specs += [
+        pl.BlockSpec((B, H), lambda t: (0, 0)),
+        pl.BlockSpec((B, H), lambda t: (0, 0)),
+    ]
+    out_shape += [
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+        jax.ShapeDtypeStruct((B, H), jnp.float32),
+    ]
+    outs = pl.pallas_call(
         kernel,
         grid=(T,),
         in_specs=[
@@ -430,26 +476,27 @@ def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, d_hfin, d_cfin):
             pl.BlockSpec((1, B, H4), rev),
             pl.BlockSpec((1, B, H), rev),
             pl.BlockSpec((H4, H), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
+            pl.BlockSpec((1, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, B, H4), rev),
-            pl.BlockSpec((B, H), lambda t: (0, 0)),
-            pl.BlockSpec((B, H), lambda t: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, B, H4), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-            jax.ShapeDtypeStruct((B, H), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
         ],
         interpret=_interpret(),
-    )(dout_tb, m_tb[..., None], z_tb, cp_tb, w_t, d_hfin, d_cfin)
-    return d_z, d_h0, d_c0
+    )(dout_tb, m_tb[..., None], z_tb, cp_tb, w_t, pi, pf, po,
+      d_hfin, d_cfin)
+    if want_cn:
+        d_z, cn, d_h0, d_c0 = outs
+    else:
+        d_z, d_h0, d_c0 = outs
+        cn = None
+    return d_z, cn, d_h0, d_c0
 
 
 def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
@@ -493,6 +540,7 @@ def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
 
 
 def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
+    """TIME-MAJOR twin of _lstm_bwd_pallas_raw for the GRU."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

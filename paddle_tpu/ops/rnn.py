@@ -39,17 +39,13 @@ __all__ = [
 ]
 
 
-def _use_pallas_rnn(batch, hidden, h0, c0, peep_i, peep_f, peep_o, act,
-                    gate_act, state_act, reverse) -> bool:
-    """Fused Pallas time-loop kernel is used on TPU for the default cell
-    (no peepholes/boot state/custom activations/reverse — those take the
-    general lax.scan path) and only for tile-aligned shapes: the kernel
-    slices gate blocks out of [B, gates*H], so H must fill whole 128-lane
-    tiles and B whole 8-sublane tiles or Mosaic rejects the lowering."""
-    if any(p is not None for p in (h0, c0, peep_i, peep_f, peep_o)) or reverse:
-        return False
-    if (act, gate_act, state_act) != ("tanh", "sigmoid", "tanh"):
-        return False
+def _use_pallas_rnn(batch, hidden) -> bool:
+    """Fused Pallas time-loop kernels run on TPU for the default-activation
+    cell (callers enforce acts; peepholes are supported in-kernel; boot
+    state and reverse ride flip/flag upstream) and only for tile-aligned
+    shapes: the kernels slice gate blocks out of [B, gates*H], so H must
+    fill whole 128-lane tiles and B whole 8-sublane tiles or Mosaic rejects
+    the lowering."""
     if hidden % 128 != 0 or batch % 8 != 0:
         return False
     # the fused kernel's per-step working set ([B, gates*H] blocks + carry)
@@ -150,21 +146,27 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = (x + b.astype(x.dtype)) if w_x is None else linear(x, w_x, b)
-    if (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh") and not any(
-            p is not None for p in (peep_i, peep_f, peep_o)):
-        # default cell: fused-backward sequence op (hand-written VJP batches
-        # d_w_h after the reverse scan; Pallas forward when the gate allows
-        # — see ops/rnn_fused.py).  reverse rides a flip: identical to
+    if (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh"):
+        # default cell (peepholes included — zeros degenerate exactly):
+        # fused-backward sequence op (hand-written VJP batches d_w_h after
+        # the reverse loop; Pallas fwd+bwd kernels when the gate allows —
+        # see ops/rnn_fused.py).  reverse rides a flip: identical to
         # scan_rnn(reverse=True) including mask hold/zero semantics.
         from paddle_tpu.ops.rnn_fused import lstm_sequence_fused
 
         allow_pallas = h0 is None and c0 is None
         h0a = jnp.zeros((B, H), xp.dtype) if h0 is None else h0
         c0a = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
+        has_peeps = any(p is not None for p in (peep_i, peep_f, peep_o))
+        zp = jnp.zeros((H,), xp.dtype)
+        pi = zp if peep_i is None else peep_i
+        pf = zp if peep_f is None else peep_f
+        po = zp if peep_o is None else peep_o
         xp_r = jnp.flip(xp, 1) if reverse else xp
         m_r = jnp.flip(mask, 1) if reverse else mask
         h_seq, h_fin, c_fin = lstm_sequence_fused(xp_r, m_r, w_h, h0a, c0a,
-                                                  allow_pallas)
+                                                  pi, pf, po, allow_pallas,
+                                                  has_peeps)
         if reverse:
             h_seq = jnp.flip(h_seq, 1)
         return h_seq, (h_fin, c_fin)

@@ -45,13 +45,15 @@ __all__ = ["gru_sequence_fused", "lstm_sequence_fused"]
 
 
 def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
-    """Backward Pallas gate: same tile/VMEM constraints as the forward
-    (_use_pallas_rnn), evaluated without the boot-state checks (residuals
-    already encode them)."""
+    """Backward Pallas gate: forward tile constraints PLUS a tighter VMEM
+    cap — the reverse kernel's per-step working set (z + d_z [B,gates*H]
+    blocks, the transposed weight, two carry scratches) is larger than the
+    forward's, and B*H = 384*512 (the forward's measured ceiling) OOMs
+    scoped VMEM by 1.6M on v5e.  256*512 compiles; shapes between fall back
+    to the vectorized reverse scan."""
     from paddle_tpu.ops.rnn import _use_pallas_rnn
 
-    return _use_pallas_rnn(batch, hidden, None, None, None, None, None,
-                           "tanh", "sigmoid", "tanh", False)
+    return _use_pallas_rnn(batch, hidden) and batch * hidden <= 256 * 512
 
 
 # ---------------------------------------------------------------------------
@@ -61,7 +63,7 @@ def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
 
 def _gru_fwd_scan(xp, mask, w_h, h0):
     """Masked forward scan; xp [B,T,3H], mask [B,T] -> (h_seq [B,T,H],
-    h_fin, z_tb [T,B,3H] pre-activations, hprev_tb [T,B,H]).
+    h_fin, z [B,T,3H] pre-activations, hprev [B,T,H]).
     Mirrors scan_rnn(gru_step) numerics (bf16 matmul operands in linear)."""
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
@@ -80,6 +82,9 @@ def _gru_fwd_scan(xp, mask, w_h, h0):
         return h_out, (h_out * m_t[:, None].astype(h_out.dtype), z, h)
 
     h_fin, (outs, z_tb, hprev_tb) = lax.scan(step, h0, (xp_tb, m_tb))
+    # residuals leave TIME-major [T,B,*] — one fixed layout contract with
+    # the backward regardless of which path produced them (the kernels are
+    # time-major too: Mosaic wants the last two block dims tile-aligned)
     return jnp.moveaxis(outs, 0, 1), h_fin, z_tb, hprev_tb
 
 
@@ -102,8 +107,7 @@ def _gru_core_fwd(xp, mask, w_h, h0, allow_pallas, *, residuals=True):
 
         B, T, H3 = xp.shape
         H = H3 // 3
-        if _use_pallas_rnn(B, H, None, None, None, None, None,
-                           "tanh", "sigmoid", "tanh", False):
+        if _use_pallas_rnn(B, H):
             from paddle_tpu.ops.pallas_kernels import _gru_pallas_raw
 
             xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
@@ -111,8 +115,8 @@ def _gru_core_fwd(xp, mask, w_h, h0, allow_pallas, *, residuals=True):
             outs = _gru_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
                                    residuals=residuals)
             h_tb, h_fin = outs[0], outs[1]
-            z_tb, hprev_tb = (outs[2], outs[3]) if residuals else (None, None)
-            return jnp.moveaxis(h_tb, 0, 1), h_fin, z_tb, hprev_tb
+            z_r, hprev_r = (outs[2], outs[3]) if residuals else (None, None)
+            return jnp.moveaxis(h_tb, 0, 1), h_fin, z_r, hprev_r
     out = _gru_fwd_scan(xp, mask, w_h, h0)
     return out if residuals else (out[0], out[1], None, None)
 
@@ -127,29 +131,28 @@ def _gru_seq_fwd(xp, mask, w_h, h0, allow_pallas):
 
 
 def _gru_seq_bwd(allow_pallas, res, ct):
-    mask, w_h, z_tb, hprev_tb, (xp_s, h0_s) = res
+    mask, w_h, z_r, hprev_r, (xp_s, h0_s) = res
     xp_dtype, h0_dtype = xp_s.dtype, h0_s.dtype
     d_hseq, d_hfin = ct
-    T, B, H3 = z_tb.shape
-    H = H3 // 3
+    H = w_h.shape[0]
+    B = mask.shape[0]
     f32 = jnp.float32
     w_f = w_h.astype(f32)
 
-    m_tb = jnp.moveaxis(mask, 1, 0)
-    d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
-    hp_f = hprev_tb.astype(f32)
-
-    T_, B = m_tb.shape
+    hp_f = hprev_r.astype(f32)                   # residuals are [T,B,*]
     if allow_pallas and _bwd_pallas_ok(B, H):
         from paddle_tpu.ops.pallas_kernels import _gru_bwd_pallas_raw
 
         d_xp_tb, d_h0 = _gru_bwd_pallas_raw(
-            d_out_tb, m_tb.astype(f32), z_tb.astype(f32), hp_f,
-            w_f.T.copy(), d_hfin.astype(f32))
+            jnp.moveaxis(d_hseq, 1, 0).astype(f32),
+            jnp.moveaxis(mask, 1, 0).astype(f32),
+            z_r.astype(f32), hp_f, w_f.T.copy(), d_hfin.astype(f32))
     else:
+        m_tb = jnp.moveaxis(mask, 1, 0)
+        d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
         # gates recomputed from the SAVED pre-activations, vectorized over
         # all timesteps at once (pure elementwise — XLA fuses; no replay)
-        z_f = z_tb.astype(f32)
+        z_f = z_r.astype(f32)
         ru = jax.nn.sigmoid(z_f[..., : 2 * H])
         r = ru[..., :H]
         u = ru[..., H:]
@@ -177,8 +180,9 @@ def _gru_seq_bwd(allow_pallas, res, ct):
             rev_step, d_hfin.astype(f32),
             (d_out_tb, m_tb, r, u, cand, hp_f), reverse=True)
 
-    # batched weight gradient: zr part against h_prev, cand part against r*h
-    rh = jax.nn.sigmoid(z_tb[..., :H].astype(f32)) * hp_f
+    # shared tail — batched weight gradient: zr part against h_prev, cand
+    # part against r*h (ONE copy for both reverse-loop implementations)
+    rh = jax.nn.sigmoid(z_r[..., :H].astype(f32)) * hp_f
     d_w_gates = jnp.einsum("tbh,tbz->hz", hp_f, d_xp_tb[..., : 2 * H])
     d_w_cand = jnp.einsum("tbh,tbz->hz", rh, d_xp_tb[..., 2 * H:])
     d_wh = jnp.concatenate([d_w_gates, d_w_cand], axis=1).astype(w_h.dtype)
@@ -194,9 +198,11 @@ gru_sequence_fused.defvjp(_gru_seq_fwd, _gru_seq_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _lstm_fwd_scan(xp, mask, w_h, h0, c0):
-    """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step)
-    -> (h_seq, h_fin, c_fin, z_tb [T,B,4H], hprev_tb, cprev_tb)."""
+def _lstm_fwd_scan(xp, mask, w_h, h0, c0, pi, pf, po):
+    """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step),
+    pi/pf/po [H] peephole ("check") vectors (zeros = plain cell)
+    -> (h_seq, h_fin, c_fin, z [B,T,4H] PRE-peephole, hprev,
+    cprev)."""
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
@@ -205,11 +211,11 @@ def _lstm_fwd_scan(xp, mask, w_h, h0, c0):
         h, c = carry
         xp_t, m_t = inp
         z = xp_t + linear(h, w_h)
-        i = jax.nn.sigmoid(z[..., :H])
-        f = jax.nn.sigmoid(z[..., H: 2 * H])
-        o = jax.nn.sigmoid(z[..., 2 * H: 3 * H])
+        i = jax.nn.sigmoid(z[..., :H] + pi * c)
+        f = jax.nn.sigmoid(z[..., H: 2 * H] + pf * c)
         g = jnp.tanh(z[..., 3 * H:])
         c_new = f * c + i * g
+        o = jax.nn.sigmoid(z[..., 2 * H: 3 * H] + po * c_new)
         h_new = o * jnp.tanh(c_new)
         keep = (m_t > 0)[:, None]
         h_out = jnp.where(keep, h_new, h)
@@ -219,95 +225,113 @@ def _lstm_fwd_scan(xp, mask, w_h, h0, c0):
 
     (h_fin, c_fin), (outs, z_tb, hprev_tb, cprev_tb) = lax.scan(
         step, (h0, c0), (xp_tb, m_tb))
-    return jnp.moveaxis(outs, 0, 1), h_fin, c_fin, z_tb, hprev_tb, cprev_tb
+    # residuals leave TIME-major (layout contract with the backward)
+    return (jnp.moveaxis(outs, 0, 1), h_fin, c_fin,
+            z_tb, hprev_tb, cprev_tb)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5,))
-def lstm_sequence_fused(xp, mask, w_h, h0, c0, allow_pallas=False):
+@partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def lstm_sequence_fused(xp, mask, w_h, h0, c0, pi, pf, po,
+                        allow_pallas=False, has_peepholes=True):
+    """pi/pf/po: [H] peephole vectors (pass zeros for the plain cell — the
+    math degenerates exactly).  ``has_peepholes`` (static) lets the
+    backward skip the c_new residual stream and the d_peep reductions when
+    the caller statically knows the peepholes are zeros."""
     # primal-only call (inference): residual-free variant — see GRU twin
-    h_seq, h_fin, c_fin = _lstm_core_fwd(xp, mask, w_h, h0, c0,
+    h_seq, h_fin, c_fin = _lstm_core_fwd(xp, mask, w_h, h0, c0, pi, pf, po,
                                          allow_pallas, residuals=False)[:3]
     return h_seq, h_fin, c_fin
 
 
-def _lstm_core_fwd(xp, mask, w_h, h0, c0, allow_pallas, *, residuals=True):
+def _lstm_core_fwd(xp, mask, w_h, h0, c0, pi, pf, po, allow_pallas, *,
+                   residuals=True):
     if allow_pallas:
         from paddle_tpu.ops.rnn import _use_pallas_rnn
 
         B, T, H4 = xp.shape
         H = H4 // 4
-        if _use_pallas_rnn(B, H, None, None, None, None, None,
-                           "tanh", "sigmoid", "tanh", False):
+        if _use_pallas_rnn(B, H):
             from paddle_tpu.ops.pallas_kernels import _lstm_pallas_raw
 
             xp_tb = jnp.moveaxis(xp.astype(jnp.float32), 1, 0)
             m_tb = jnp.moveaxis(mask.astype(jnp.float32), 1, 0)
             outs = _lstm_pallas_raw(xp_tb, m_tb, w_h.astype(jnp.float32),
+                                    pi.astype(jnp.float32),
+                                    pf.astype(jnp.float32),
+                                    po.astype(jnp.float32),
                                     residuals=residuals)
             h_tb, h_fin, c_fin = outs[0], outs[1], outs[2]
-            z_tb, hprev_tb, cprev_tb = (
+            z_r, hprev_r, cprev_r = (
                 (outs[3], outs[4], outs[5]) if residuals
                 else (None, None, None))
             return (jnp.moveaxis(h_tb, 0, 1), h_fin, c_fin,
-                    z_tb, hprev_tb, cprev_tb)
-    out = _lstm_fwd_scan(xp, mask, w_h, h0, c0)
+                    z_r, hprev_r, cprev_r)
+    out = _lstm_fwd_scan(xp, mask, w_h, h0, c0, pi, pf, po)
     return out if residuals else (out[0], out[1], out[2], None, None, None)
 
 
-def _lstm_seq_fwd(xp, mask, w_h, h0, c0, allow_pallas):
+def _lstm_seq_fwd(xp, mask, w_h, h0, c0, pi, pf, po, allow_pallas,
+                  has_peepholes):
     h_seq, h_fin, c_fin, z_tb, hprev_tb, cprev_tb = _lstm_core_fwd(
-        xp, mask, w_h, h0, c0, allow_pallas)
+        xp, mask, w_h, h0, c0, pi, pf, po, allow_pallas)
     meta = (jnp.zeros((0,), xp.dtype), jnp.zeros((0,), h0.dtype),
             jnp.zeros((0,), c0.dtype))  # dtype sentinels (see GRU fwd)
     return ((h_seq, h_fin, c_fin),
-            (mask, w_h, z_tb, hprev_tb, cprev_tb, meta))
+            (mask, w_h, pi, pf, po, z_tb, hprev_tb, cprev_tb, meta))
 
 
-def _lstm_seq_bwd(allow_pallas, res, ct):
-    mask, w_h, z_tb, hprev_tb, cprev_tb, (xp_s, h0_s, c0_s) = res
+def _lstm_seq_bwd(allow_pallas, has_peepholes, res, ct):
+    mask, w_h, pi, pf, po, z_r, hprev_r, cprev_r, meta = res
+    xp_s, h0_s, c0_s = meta
     xp_dt, h0_dt, c0_dt = xp_s.dtype, h0_s.dtype, c0_s.dtype
     d_hseq, d_hfin, d_cfin = ct
-    T, B, H4 = z_tb.shape
-    H = H4 // 4
+    H = w_h.shape[0]
+    B = mask.shape[0]
     f32 = jnp.float32
     w_f = w_h.astype(f32)
+    pi_f, pf_f, po_f = (p.astype(f32) for p in (pi, pf, po))
 
-    m_tb = jnp.moveaxis(mask, 1, 0)
-    d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
-
-    T_, B = m_tb.shape
+    cp_f = cprev_r.astype(f32)                   # residuals are [T,B,*]
     if allow_pallas and _bwd_pallas_ok(B, H):
         from paddle_tpu.ops.pallas_kernels import _lstm_bwd_pallas_raw
 
-        d_z_tb, d_h0, d_c0 = _lstm_bwd_pallas_raw(
-            d_out_tb, m_tb.astype(f32), z_tb.astype(f32),
-            cprev_tb.astype(f32), w_f.T.copy(),
-            d_hfin.astype(f32), d_cfin.astype(f32))
+        d_z_tb, cn_tb, d_h0, d_c0 = _lstm_bwd_pallas_raw(
+            jnp.moveaxis(d_hseq, 1, 0).astype(f32),
+            jnp.moveaxis(mask, 1, 0).astype(f32),
+            z_r.astype(f32), cp_f, w_f.T.copy(),
+            pi_f[None], pf_f[None], po_f[None],
+            d_hfin.astype(f32), d_cfin.astype(f32),
+            want_cn=has_peepholes)
     else:
+        m_tb = jnp.moveaxis(mask, 1, 0)
+        d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
         # gate math vectorized over every timestep from the saved z/c_prev —
         # the reverse scan below is left with elementwise chain math plus
-        # the single unavoidable carry matmul d_z @ w^T
-        z = z_tb.astype(f32)
-        cp = cprev_tb.astype(f32)
-        i = jax.nn.sigmoid(z[..., :H])
-        f = jax.nn.sigmoid(z[..., H: 2 * H])
-        o = jax.nn.sigmoid(z[..., 2 * H: 3 * H])
+        # the single unavoidable carry matmul d_z @ w^T.  z is PRE-peephole;
+        # peephole ("check") terms: i,f see c_prev, o sees c_new
+        # (hl_lstm_ops.cuh), so d_c picks up pi/pf feedthrough and d_o
+        # feeds c_new.
+        z = z_r.astype(f32)
+        i = jax.nn.sigmoid(z[..., :H] + pi_f * cp_f)
+        f = jax.nn.sigmoid(z[..., H: 2 * H] + pf_f * cp_f)
         g = jnp.tanh(z[..., 3 * H:])
-        tc = jnp.tanh(f * cp + i * g)
+        cn_tb = f * cp_f + i * g
+        o = jax.nn.sigmoid(z[..., 2 * H: 3 * H] + po_f * cn_tb)
+        tc = jnp.tanh(cn_tb)
 
         def rev_step(carry, inp):
             d_h, d_c = carry
             d_out_t, m_t, i_t, f_t, o_t, g_t, tc_t, cp_t = inp
             mcol = (m_t > 0)[:, None].astype(f32)
             d_hnew = mcol * (d_out_t + d_h)
-            d_cnew = mcol * d_c + d_hnew * o_t * (1.0 - tc_t * tc_t)
-            d_f = d_cnew * cp_t
-            d_i = d_cnew * g_t
-            d_g = d_cnew * i_t
-            d_cp = d_cnew * f_t
-            d_z = jnp.concatenate([
-                d_i * i_t * (1 - i_t), d_f * f_t * (1 - f_t),
-                d_hnew * tc_t * o_t * (1 - o_t), d_g * (1 - g_t * g_t)], -1)
+            d_zo = d_hnew * tc_t * o_t * (1 - o_t)
+            d_cnew = (mcol * d_c + d_hnew * o_t * (1.0 - tc_t * tc_t)
+                      + d_zo * po_f)
+            d_zi = d_cnew * g_t * i_t * (1 - i_t)
+            d_zf = d_cnew * cp_t * f_t * (1 - f_t)
+            d_zg = d_cnew * i_t * (1 - g_t * g_t)
+            d_cp = d_cnew * f_t + d_zi * pi_f + d_zf * pf_f
+            d_z = jnp.concatenate([d_zi, d_zf, d_zo, d_zg], -1)
             d_hp = d_z @ w_f.T
             d_h_out = (1.0 - mcol) * d_h + d_hp
             d_c_out = (1.0 - mcol) * d_c + d_cp
@@ -315,12 +339,25 @@ def _lstm_seq_bwd(allow_pallas, res, ct):
 
         (d_h0, d_c0), d_z_tb = lax.scan(
             rev_step, (d_hfin.astype(f32), d_cfin.astype(f32)),
-            (d_out_tb, m_tb, i, f, o, g, tc, cp), reverse=True)
+            (d_out_tb, m_tb, i, f, o, g, tc, cp_f), reverse=True)
 
+    # shared tail (ONE copy for both reverse-loop implementations)
+    if has_peepholes:
+        # peephole gradients: one batched reduction each, outside the loop
+        d_pi = jnp.einsum("tbh,tbh->h", d_z_tb[..., :H], cp_f).astype(pi.dtype)
+        d_pf = jnp.einsum("tbh,tbh->h",
+                          d_z_tb[..., H: 2 * H], cp_f).astype(pf.dtype)
+        d_po = jnp.einsum("tbh,tbh->h",
+                          d_z_tb[..., 2 * H: 3 * H], cn_tb).astype(po.dtype)
+    else:
+        d_pi = jnp.zeros_like(pi)
+        d_pf = jnp.zeros_like(pf)
+        d_po = jnp.zeros_like(po)
     d_wh = jnp.einsum("tbh,tbz->hz",
-                      hprev_tb.astype(f32), d_z_tb).astype(w_h.dtype)
+                      hprev_r.astype(f32), d_z_tb).astype(w_h.dtype)
     d_xp = jnp.moveaxis(d_z_tb, 0, 1).astype(xp_dt)
-    return d_xp, None, d_wh, d_h0.astype(h0_dt), d_c0.astype(c0_dt)
+    return (d_xp, None, d_wh, d_h0.astype(h0_dt), d_c0.astype(c0_dt),
+            d_pi, d_pf, d_po)
 
 
 lstm_sequence_fused.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)

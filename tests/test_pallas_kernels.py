@@ -125,9 +125,13 @@ class TestBackwardKernels:
         ct_h = jnp.asarray(rng.randn(B, H).astype(np.float32))
         ct_c = jnp.asarray(rng.randn(B, H).astype(np.float32))
 
+        pi = jnp.asarray(rng.randn(H).astype(np.float32) * 0.3)
+        pf = jnp.asarray(rng.randn(H).astype(np.float32) * 0.3)
+        po = jnp.asarray(rng.randn(H).astype(np.float32) * 0.3)
+
         def obj(fn):
             def f(xp, w_h):
-                h_seq, h_f, c_f = fn(xp, mask, w_h, z, z, True)
+                h_seq, h_f, c_f = fn(xp, mask, w_h, z, z, pi, pf, po, True)
                 return ((h_seq * ct_seq).sum() + (h_f * ct_h).sum()
                         + (c_f * ct_c).sum())
             return f

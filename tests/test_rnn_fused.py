@@ -120,7 +120,9 @@ class TestLstmFused:
         ct_seq = jnp.asarray(rs.randn(B, T, H).astype(np.float32))
 
         (rf, rc), rseq = self._ref(xp, mask, wh, h0, c0)
-        nseq, nf, nc = lstm_sequence_fused(xp, mask, wh, h0, c0, False)
+        zp = jnp.zeros((H,), jnp.float32)
+        nseq, nf, nc = lstm_sequence_fused(xp, mask, wh, h0, c0,
+                                           zp, zp, zp, False)
         np.testing.assert_allclose(np.asarray(rseq), np.asarray(nseq),
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(rf), np.asarray(nf),
@@ -133,11 +135,51 @@ class TestLstmFused:
             return jnp.sum(seq * ct_seq) + jnp.sum(f) + jnp.sum(c)
 
         def loss_new(xp, wh, h0, c0):
-            seq, f, c = lstm_sequence_fused(xp, mask, wh, h0, c0, False)
+            seq, f, c = lstm_sequence_fused(xp, mask, wh, h0, c0,
+                                            zp, zp, zp, False)
             return jnp.sum(seq * ct_seq) + jnp.sum(f) + jnp.sum(c)
 
         g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xp, wh, h0, c0)
         g_new = jax.grad(loss_new, argnums=(0, 1, 2, 3))(xp, wh, h0, c0)
         for name, a, b in zip(("xp", "wh", "h0", "c0"), g_ref, g_new):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+class TestLstmFusedPeepholes:
+    def test_peephole_grads_match_scan_reference(self):
+        """Peephole cell through the fused VJP == scan_rnn(lstm_step) with
+        the same check weights — values and every gradient incl. d_peep."""
+        rs = np.random.RandomState(5)
+        B, T, H = 3, 5, 4
+        xp = jnp.asarray(rs.randn(B, T, 4 * H).astype(np.float32))
+        mask = _mask((5, 3, 1), T)
+        wh = jnp.asarray(0.4 * rs.randn(H, 4 * H).astype(np.float32))
+        pi = jnp.asarray(rs.randn(H).astype(np.float32) * 0.3)
+        pf = jnp.asarray(rs.randn(H).astype(np.float32) * 0.3)
+        po = jnp.asarray(rs.randn(H).astype(np.float32) * 0.3)
+        z = jnp.zeros((B, H), jnp.float32)
+        ct_seq = jnp.asarray(rs.randn(B, T, H).astype(np.float32))
+
+        def ref(xp, wh, pi, pf, po):
+            def step(carry, xp_t):
+                h, c = carry
+                h2, c2 = O.lstm_step(xp_t, h, c, wh, peep_i=pi, peep_f=pf,
+                                     peep_o=po)
+                return (h2, c2), h2
+            (f, c), seq = O.scan_rnn(step, (z, z), xp, mask)
+            return jnp.sum(seq * ct_seq) + jnp.sum(f) + 2.0 * jnp.sum(c)
+
+        def new(xp, wh, pi, pf, po):
+            seq, f, c = lstm_sequence_fused(xp, mask, wh, z, z,
+                                            pi, pf, po, False)
+            return jnp.sum(seq * ct_seq) + jnp.sum(f) + 2.0 * jnp.sum(c)
+
+        np.testing.assert_allclose(
+            float(ref(xp, wh, pi, pf, po)), float(new(xp, wh, pi, pf, po)),
+            rtol=1e-5)
+        g_ref = jax.grad(ref, argnums=(0, 1, 2, 3, 4))(xp, wh, pi, pf, po)
+        g_new = jax.grad(new, argnums=(0, 1, 2, 3, 4))(xp, wh, pi, pf, po)
+        for name, a, b in zip(("xp", "wh", "pi", "pf", "po"), g_ref, g_new):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5, err_msg=name)

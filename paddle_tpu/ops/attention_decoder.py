@@ -12,15 +12,18 @@ at WMT14 bench shapes that is ~45+ MB of accumulator read+write per step,
 ~10x the cost of the forward scan (measured 4.4 ms backward vs 0.45 ms
 forward on v5e).  The custom VJP instead:
 
-- emits the SMALL per-step cotangents (``d_xp`` [B,3D], ``d_ctx`` [B,2H])
-  as stacked scan outputs,
-- reconstructs every big gradient AFTER the scan as one batched MXU
-  contraction each: ``d_enc = einsum('tbs,tbh->bsh', probs, d_ctx)``,
-  ``d_Wx = einsum('tbi,tbo->io', x, d_xp)``, ``d_y = d_xp @ Wx^T``,
-- keeps only genuinely sequential accumulators (``d_enc_proj``, ``d_Wh``,
-  attention weight grads) in the reverse scan.  All scan accumulators are
-  f32: summing T bfloat16 terms drifts for long targets (the cotangent is
-  cast to the primal dtype once, after the scan).
+- precomputes the GRU gates and attention queries for ALL steps as batched
+  MXU matmuls before the reverse scan (they depend only on saved forward
+  values),
+- emits the SMALL per-step cotangents (``d_xp`` [B,3D], ``sum_dpre``
+  [B,A]) as stacked scan outputs,
+- reconstructs every weight gradient AFTER the scan as one batched MXU
+  contraction each (``d_enc``, ``d_Wx``, ``d_Wh``, ``d_attw``, ``d_b``,
+  ``d_y``),
+- keeps only the genuinely unavoidable accumulators (``d_enc_proj`` —
+  nonlinear in t — and the tiny ``d_v``) in the reverse scan.  Scan
+  accumulators are f32: summing T bfloat16 terms drifts for long targets,
+  and a bf16 ``d_enc_proj`` carry A/B-measured slower anyway.
 
 Forward saves (probs [T,B,S], ctx [T,B,2H], states) — O(B·T·(S+2H+D))
 residuals, ~100 MB at bench shapes vs the ~1.3 GB/step-loop accumulator
@@ -98,6 +101,10 @@ def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
     m_tb = jnp.moveaxis(trg_mask, 1, 0)                    # [T,B]
     wx_c = wx[E:]
 
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    rd = compute_dtype()  # residual stream dtype (bf16 under prod policy)
+
     def step(s, inp):
         xp_y_t, m_t = inp
         s_new, (w, ctx, _pre) = _fwd_step(s, xp_y_t, enc, enc_proj, src_mask,
@@ -105,7 +112,7 @@ def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
         keep = (m_t > 0)[:, None]
         s_out = jnp.where(keep, s_new, s)
         out = s_out * m_t[:, None].astype(s_out.dtype)
-        return s_out, (out, w, ctx)
+        return s_out, (out, w, ctx.astype(rd))
 
     _, (outs, probs, ctxs) = lax.scan(step, s0, (xp_y_tb, m_tb))
     states = jnp.moveaxis(outs, 0, 1)                      # [B,T,D]
@@ -152,23 +159,27 @@ def _agd_bwd(res, d_states):
     maskb = (src_mask > 0)
     mask_f = src_mask.astype(f32)
 
+    # ---- GRU gate recompute VECTORIZED over all steps (batched MXU
+    # matmuls; was two matmuls inside every reverse step) ----
+    xp_all = (xp_y_tb + linear(ctxs, wx[E:])).astype(f32)  # [T,B,3D]
+    zr_all = xp_all[..., : 2 * D] + linear(s_prev, wh[:, : 2 * D]).astype(f32)
+    ru_all = jax.nn.sigmoid(zr_all)
+    r_all = ru_all[..., :D]
+    u_all = ru_all[..., D:]
+    cand_all = jnp.tanh(xp_all[..., 2 * D:]
+                        + linear((r_all * s_prev.astype(f32)).astype(
+                            s_prev.dtype), wh[:, 2 * D:]).astype(f32))
+    # the attention query is also state-only: one batched matmul
+    q_all = linear(s_prev, att_w)                          # [T,B,A]
+
     def rev_step(carry, inp):
-        d_s, d_encP, d_attw, d_v, d_wh, d_b = carry
-        d_out_t, m_t, xp_y_t, w_t, ctx_t, sp_t = inp
+        d_s, d_encP, d_v = carry
+        d_out_t, m_t, w_t, sp_t, r, u, cand, q_t = inp
         mcol = (m_t > 0)[:, None].astype(f32)
         d_snew = mcol * (d_out_t + d_s)
-
-        # ---- recompute GRU internals (hoisted y-half recomputed outside
-        # the scan, ctx half recomputed here) ----
-        xp = (xp_y_t + linear(ctx_t, wx[E:])).astype(f32)
         sp = sp_t.astype(f32)
-        zr = xp[..., : 2 * D] + linear(sp_t, wh[:, : 2 * D]).astype(f32)
-        ru = jax.nn.sigmoid(zr)
-        r, u = jnp.split(ru, 2, axis=-1)
-        cand = jnp.tanh(xp[..., 2 * D:]
-                        + linear(r * sp_t, wh[:, 2 * D:]).astype(f32))
 
-        # ---- GRU backward ----
+        # ---- GRU backward (gates precomputed above) ----
         d_u = d_snew * (sp - cand)
         d_cand = d_snew * (1.0 - u)
         d_h = d_snew * u
@@ -179,17 +190,13 @@ def _agd_bwd(res, d_states):
         d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
         d_h = d_h + d_zr @ wh_f[:, : 2 * D].T
         d_xp = jnp.concatenate([d_zr, d_zc], -1)           # [B,3D]
-        d_wh = d_wh + jnp.concatenate(
-            [sp.T @ d_zr, (r * sp).T @ d_zc], axis=1)
-        d_b = d_b + jnp.sum(d_xp, axis=0)
         d_ctx = d_xp @ wx_f[E:].T                          # [B,2H]
 
         # ---- attention backward (attend) ----
         d_w = jnp.einsum("bh,bsh->bs", d_ctx.astype(enc.dtype), enc,
                          preferred_element_type=f32)
-        # recompute softmax chain
-        q = linear(sp_t, att_w)[:, None, :]
-        enc_proj_c, q_c = mxu_cast(enc_proj, q)
+        # recompute softmax chain from the precomputed query
+        enc_proj_c, q_c = mxu_cast(enc_proj, q_t[:, None, :])
         pre = jnp.tanh(enc_proj_c + q_c)                   # [B,S,A] cd
         scores = jnp.einsum("bsa,a->bs", pre, att_v.astype(pre.dtype),
                             preferred_element_type=f32)
@@ -207,36 +214,42 @@ def _agd_bwd(res, d_states):
         pre_f = pre.astype(f32)
         d_pre = (1.0 - pre_f * pre_f) * (d_scores[..., None] * att_v_f)
         # accumulate in f32: summing T bf16 terms loses precision for long
-        # target sequences when the compute dtype is bfloat16 (cast once
-        # after the scan)
+        # targets, and a bf16 accumulator A/B-measured SLOWER anyway
+        # (23.6 vs 22.5 ms at B384 — the per-step down-cast pass costs
+        # more than the narrower carry saves)
         d_encP = d_encP + d_pre
         sum_dpre = jnp.sum(d_pre, axis=1)                  # [B,A]
         d_h = d_h + sum_dpre @ att_w_f.T
-        d_attw = d_attw + sp.T @ sum_dpre
         d_v = d_v + jnp.einsum("bs,bsa->a", d_scores, pre_f)
 
         d_s_out = (1.0 - mcol) * d_s + d_h
-        return (d_s_out, d_encP, d_attw, d_v, d_wh, d_b), (d_xp, d_ctx)
+        return (d_s_out, d_encP, d_v), (d_xp, sum_dpre)
 
     A = enc_proj.shape[-1]
     acc0 = (jnp.zeros((B, D), f32),
             jnp.zeros((B, S, A), f32),
-            jnp.zeros(att_w.shape, f32),
-            jnp.zeros(att_v.shape, f32),
-            jnp.zeros(wh.shape, f32),
-            jnp.zeros(b.shape, f32))
-    (d_s0, d_encP, d_attw, d_v, d_wh, d_b), (d_xp_tb, d_ctx_tb) = lax.scan(
+            jnp.zeros(att_v.shape, f32))
+    (d_s0, d_encP, d_v), (d_xp_tb, sum_dpre_tb) = lax.scan(
         rev_step, acc0,
-        (d_out_tb, m_tb, xp_y_tb, probs, ctxs, s_prev),
+        (d_out_tb, m_tb, probs, s_prev, r_all, u_all, cand_all, q_all),
         reverse=True)
+    d_b = jnp.sum(d_xp_tb, axis=(0, 1))  # bias grad off the stacked output
 
-    # ---- batched post-scan contractions ----
+    # ---- batched post-scan contractions (weight grads were carried
+    # through the scan before — each is now ONE MXU einsum) ----
+    d_ctx_tb = d_xp_tb @ wx_f[E:].T                        # [T,B,2H]
+    sp_f = s_prev.astype(f32)
+    d_wh = jnp.concatenate(
+        [jnp.einsum("tbd,tbz->dz", sp_f, d_xp_tb[..., : 2 * D]),
+         jnp.einsum("tbd,tbz->dz", r_all * sp_f, d_xp_tb[..., 2 * D:])],
+        axis=1)
+    d_attw = jnp.einsum("tbd,tba->da", sp_f, sum_dpre_tb)
     # d_enc: the only use of enc is ctx_t = w_t @ enc
     d_enc = jnp.einsum("tbs,tbh->bsh", probs, d_ctx_tb).astype(enc.dtype)
     # d_wx in two blocks (x = [y, ctx]); identical to the old einsum over
     # the concatenated x
     d_wx_y = jnp.einsum("tbi,tbo->io", y_tb.astype(f32), d_xp_tb)
-    d_wx_c = jnp.einsum("tbi,tbo->io", ctxs, d_xp_tb)
+    d_wx_c = jnp.einsum("tbi,tbo->io", ctxs.astype(f32), d_xp_tb)
     d_wx = jnp.concatenate([d_wx_y, d_wx_c], axis=0)
     d_y = (d_xp_tb @ wx_f[:E].T).astype(y_emb.dtype)       # [T,B,E]
     d_y_emb = jnp.moveaxis(d_y, 0, 1)

@@ -93,9 +93,9 @@ def _lstm_kernel(xp_ref, m_ref, wh_ref, pi_ref, pf_ref, po_ref,
     if save_residuals:
         # backward residuals: pre-activations + held carries stream straight
         # out of the forward, so the backward pass needs NO replay scan
-        zseq_ref[0] = z
-        hprev_ref[0] = h
-        cprev_ref[0] = c
+        zseq_ref[0] = z.astype(zseq_ref.dtype)
+        hprev_ref[0] = h.astype(hprev_ref.dtype)
+        cprev_ref[0] = c.astype(cprev_ref.dtype)
     h_new = jnp.where(keep, h_new, h)
     c_new = jnp.where(keep, c_new, c)
     h_scr[...] = h_new
@@ -140,15 +140,16 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, pi, pf, po, *,
         jax.ShapeDtypeStruct((B, H), jnp.float32),
     ]
     if residuals:
+        rd = compute_dtype()  # bf16 residual streams under the prod policy
         out_specs += [
             pl.BlockSpec((1, B, H4), step),
             pl.BlockSpec((1, B, H), step),
             pl.BlockSpec((1, B, H), step),
         ]
         out_shape += [
-            jax.ShapeDtypeStruct((T, B, H4), jnp.float32),   # z residual
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # h_prev
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # c_prev
+            jax.ShapeDtypeStruct((T, B, H4), rd),            # z residual
+            jax.ShapeDtypeStruct((T, B, H), rd),             # h_prev
+            jax.ShapeDtypeStruct((T, B, H), rd),             # c_prev
         ]
     return pl.pallas_call(
         kernel,
@@ -266,9 +267,9 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
     m = m_ref[0]
     if save_residuals:
         # backward residuals (see _lstm_kernel)
-        zseq_ref[0, :, : 2 * H] = zr
-        zseq_ref[0, :, 2 * H:] = zc
-        hprev_ref[0] = h
+        zseq_ref[0, :, : 2 * H] = zr.astype(zseq_ref.dtype)
+        zseq_ref[0, :, 2 * H:] = zc.astype(zseq_ref.dtype)
+        hprev_ref[0] = h.astype(hprev_ref.dtype)
     h_new = jnp.where(m > 0, h_new, h)
     h_scr[...] = h_new
     hseq_ref[0] = h_new * m
@@ -300,13 +301,14 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
         jax.ShapeDtypeStruct((B, H), jnp.float32),
     ]
     if residuals:
+        rd = compute_dtype()
         out_specs += [
             pl.BlockSpec((1, B, H3), step),
             pl.BlockSpec((1, B, H), step),
         ]
         out_shape += [
-            jax.ShapeDtypeStruct((T, B, H3), jnp.float32),   # z residual
-            jax.ShapeDtypeStruct((T, B, H), jnp.float32),    # h_prev
+            jax.ShapeDtypeStruct((T, B, H3), rd),            # z residual
+            jax.ShapeDtypeStruct((T, B, H), rd),             # h_prev
         ]
     return pl.pallas_call(
         kernel,
@@ -405,8 +407,8 @@ def _lstm_bwd_kernel(dout_ref, m_ref, z_ref, cp_ref, wt_ref, pi_ref,
 
     d_h = dh_scr[...]
     d_c = dc_scr[...]
-    z = z_ref[0]
-    cp = cp_ref[0]
+    z = z_ref[0].astype(jnp.float32)
+    cp = cp_ref[0].astype(jnp.float32)
     pi = pi_ref[0]
     pf = pf_ref[0]
     po = po_ref[0]
@@ -513,8 +515,8 @@ def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
         dh_scr[...] = dhfin_ref[...]
 
     d_c = dh_scr[...]
-    z = z_ref[0]
-    hp = hp_ref[0]
+    z = z_ref[0].astype(jnp.float32)
+    hp = hp_ref[0].astype(jnp.float32)
     r = jax.nn.sigmoid(z[:, :H])
     u = jax.nn.sigmoid(z[:, H: 2 * H])
     cand = jnp.tanh(z[:, 2 * H:])
@@ -570,3 +572,44 @@ def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
         interpret=_interpret(),
     )(dout_tb, m_tb[..., None], z_tb, hp_tb, w_t, d_hfin)
+
+
+# ---------------------------------------------------------------------------
+# Row logsumexp with ONE HBM pass: each grid step loads a full-vocab
+# [row_tile, V] block into VMEM (f32 temporaries included — size the tile
+# accordingly) and reduces it there, where XLA's fused max + exp-sum
+# otherwise reads the [N, V] logits buffer twice (~737 MB of bf16 per pass
+# at WMT14 bench shapes).  NOTE: A/B-measured SLOWER than the XLA two-pass
+# on v5e (see losses._lse_pallas_ok) — kept as a recorded losing A/B with
+# its interpret-mode equivalence test.
+# ---------------------------------------------------------------------------
+
+
+def _lse_kernel(x_ref, lse_ref):
+    x = x_ref[...].astype(jnp.float32)           # [TN, V] — full row in VMEM
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse_ref[...] = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1,
+                                       keepdims=True))
+
+
+def logsumexp_rows_pallas(x, *, row_tile: int = 64):
+    """x [N, V] -> lse [N] f32 with ONE HBM pass over x: each grid step
+    loads a [row_tile, V] block (the full vocab row — V need not be
+    lane-aligned when the block spans the whole axis) and reduces it in
+    VMEM.  Caller gates: N % row_tile == 0 and row_tile*V*itemsize within
+    VMEM incl. the f32 exp temporaries (~12 MB at bf16 row_tile=64, V=30k)."""
+    from jax.experimental import pallas as pl
+
+    N, V = x.shape
+    row_tile = min(row_tile, N)
+    if N % row_tile:
+        raise ValueError(f"N={N} not divisible by row_tile={row_tile}")
+    out = pl.pallas_call(
+        _lse_kernel,
+        grid=(N // row_tile,),
+        in_specs=[pl.BlockSpec((row_tile, V), lambda n: (n, 0))],
+        out_specs=pl.BlockSpec((row_tile, 1), lambda n: (n, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        interpret=_interpret(),
+    )(x)
+    return out[:, 0]

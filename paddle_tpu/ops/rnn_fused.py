@@ -45,15 +45,21 @@ __all__ = ["gru_sequence_fused", "lstm_sequence_fused"]
 
 
 def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
-    """Backward Pallas gate: forward tile constraints PLUS a tighter VMEM
-    cap — the reverse kernel's per-step working set (z + d_z [B,gates*H]
-    blocks, the transposed weight, two carry scratches) is larger than the
-    forward's, and B*H = 384*512 (the forward's measured ceiling) OOMs
-    scoped VMEM by 1.6M on v5e.  256*512 compiles; shapes between fall back
-    to the vectorized reverse scan."""
+    """Backward Pallas gate: forward tile constraints PLUS a VMEM cap that
+    depends on the residual stream dtype.  The reverse kernel's per-step
+    working set (z + d_z [B,gates*H] blocks, the transposed weight, two
+    carry scratches) is larger than the forward's: with f32 residuals,
+    B*H = 384*512 (the forward's measured ceiling) OOMs scoped VMEM by
+    1.6M on v5e and 256*512 is the cap.  Under the bfloat16 compute policy
+    the z/h_prev/c_prev streams halve, which buys back enough VMEM that
+    384*512 (the WMT14 encoder shape) compiles and runs — hence the
+    dtype-dependent cap."""
     from paddle_tpu.ops.rnn import _use_pallas_rnn
 
-    return _use_pallas_rnn(batch, hidden) and batch * hidden <= 256 * 512
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    cap = 384 * 512 if compute_dtype() == jnp.bfloat16 else 256 * 512
+    return _use_pallas_rnn(batch, hidden) and batch * hidden <= cap
 
 
 # ---------------------------------------------------------------------------
@@ -63,8 +69,16 @@ def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
 
 def _gru_fwd_scan(xp, mask, w_h, h0):
     """Masked forward scan; xp [B,T,3H], mask [B,T] -> (h_seq [B,T,H],
-    h_fin, z [B,T,3H] pre-activations, hprev [B,T,H]).
-    Mirrors scan_rnn(gru_step) numerics (bf16 matmul operands in linear)."""
+    h_fin, z [T,B,3H] pre-activations, hprev [T,B,H]).
+    Mirrors scan_rnn(gru_step) numerics (bf16 matmul operands in linear).
+    Residuals are stored in the COMPUTE dtype (bf16 under the production
+    policy, f32 in tests): they exist only to recompute gates in the
+    backward, and halving their HBM stream is worth the rounding —
+    gradients become approximate at bf16's 0.4% ULP, standard mixed
+    precision practice."""
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    rd = compute_dtype()
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
@@ -79,7 +93,8 @@ def _gru_fwd_scan(xp, mask, w_h, h0):
         keep = (m_t > 0)[:, None]
         h_out = jnp.where(keep, h_new, h)
         z = jnp.concatenate([zr, zc], -1)
-        return h_out, (h_out * m_t[:, None].astype(h_out.dtype), z, h)
+        return h_out, (h_out * m_t[:, None].astype(h_out.dtype),
+                       z.astype(rd), h.astype(rd))
 
     h_fin, (outs, z_tb, hprev_tb) = lax.scan(step, h0, (xp_tb, m_tb))
     # residuals leave TIME-major [T,B,*] — one fixed layout contract with
@@ -143,10 +158,12 @@ def _gru_seq_bwd(allow_pallas, res, ct):
     if allow_pallas and _bwd_pallas_ok(B, H):
         from paddle_tpu.ops.pallas_kernels import _gru_bwd_pallas_raw
 
+        # residual streams enter the kernel in their STORED dtype (bf16
+        # under the prod policy) — casting happens per-block in VMEM
         d_xp_tb, d_h0 = _gru_bwd_pallas_raw(
             jnp.moveaxis(d_hseq, 1, 0).astype(f32),
             jnp.moveaxis(mask, 1, 0).astype(f32),
-            z_r.astype(f32), hp_f, w_f.T.copy(), d_hfin.astype(f32))
+            z_r, hprev_r, w_f.T.copy(), d_hfin.astype(f32))
     else:
         m_tb = jnp.moveaxis(mask, 1, 0)
         d_out_tb = jnp.moveaxis(d_hseq, 1, 0).astype(f32)
@@ -201,8 +218,11 @@ gru_sequence_fused.defvjp(_gru_seq_fwd, _gru_seq_bwd)
 def _lstm_fwd_scan(xp, mask, w_h, h0, c0, pi, pf, po):
     """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step),
     pi/pf/po [H] peephole ("check") vectors (zeros = plain cell)
-    -> (h_seq, h_fin, c_fin, z [B,T,4H] PRE-peephole, hprev,
-    cprev)."""
+    -> (h_seq, h_fin, c_fin, z [T,B,4H] PRE-peephole, hprev, cprev) —
+    residuals in the compute dtype (see _gru_fwd_scan)."""
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    rd = compute_dtype()
     H = w_h.shape[0]
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
@@ -221,7 +241,8 @@ def _lstm_fwd_scan(xp, mask, w_h, h0, c0, pi, pf, po):
         h_out = jnp.where(keep, h_new, h)
         c_out = jnp.where(keep, c_new, c)
         return ((h_out, c_out),
-                (h_out * m_t[:, None].astype(h_out.dtype), z, h, c))
+                (h_out * m_t[:, None].astype(h_out.dtype),
+                 z.astype(rd), h.astype(rd), c.astype(rd)))
 
     (h_fin, c_fin), (outs, z_tb, hprev_tb, cprev_tb) = lax.scan(
         step, (h0, c0), (xp_tb, m_tb))
@@ -295,10 +316,11 @@ def _lstm_seq_bwd(allow_pallas, has_peepholes, res, ct):
     if allow_pallas and _bwd_pallas_ok(B, H):
         from paddle_tpu.ops.pallas_kernels import _lstm_bwd_pallas_raw
 
+        # residual streams enter in their STORED dtype (see GRU twin)
         d_z_tb, cn_tb, d_h0, d_c0 = _lstm_bwd_pallas_raw(
             jnp.moveaxis(d_hseq, 1, 0).astype(f32),
             jnp.moveaxis(mask, 1, 0).astype(f32),
-            z_r.astype(f32), cp_f, w_f.T.copy(),
+            z_r, cprev_r, w_f.T.copy(),
             pi_f[None], pf_f[None], po_f[None],
             d_hfin.astype(f32), d_cfin.astype(f32),
             want_cn=has_peepholes)

@@ -11,6 +11,8 @@ import argparse
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
 import paddle_tpu.data as data
@@ -28,6 +30,9 @@ def main(argv=None):
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--depth", type=int, default=8)
     ap.add_argument("--out", default="/tmp/paddle_tpu_zoo_resnet.bundle")
+    ap.add_argument("--aot-out", default="",
+                    help="also export a framework-free AOT artifact "
+                         "(StableHLO + embedded weights; jax-only loader)")
     args = ap.parse_args(argv)
 
     nn.reset_naming()
@@ -49,6 +54,13 @@ def main(argv=None):
                 meta={"task": "cifar10", "depth": args.depth,
                       "feature_layer": "gap"})  # pre-logits global avg pool
     print("published", args.out)
+    if args.aot_out:
+        from paddle_tpu.config import export_aot
+
+        example = {"pixel": np.zeros((args.batch_size, 32, 32, 3),
+                                     np.float32)}
+        export_aot(args.out, args.aot_out, example, outputs=["logits"])
+        print("published AOT artifact", args.aot_out)
 
 
 if __name__ == "__main__":

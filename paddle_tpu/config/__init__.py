@@ -20,6 +20,7 @@ _EXPORTS = {
     "parse_protostr": "config_parser",
     "protostr": "config_parser",
     "InferenceModel": "deploy",
+    "export_aot": "deploy",
     "load_inference_model": "deploy",
     "merge_model": "deploy",
     "configurable": "capture",

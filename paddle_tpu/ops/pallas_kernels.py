@@ -580,8 +580,10 @@ def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
 # accordingly) and reduces it there, where XLA's fused max + exp-sum
 # otherwise reads the [N, V] logits buffer twice (~737 MB of bf16 per pass
 # at WMT14 bench shapes).  NOTE: A/B-measured SLOWER than the XLA two-pass
-# on v5e (see losses._lse_pallas_ok) — kept as a recorded losing A/B with
-# its interpret-mode equivalence test.
+# on v5e (see losses._USE_PALLAS_LSE_READOUT) — kept as a recorded losing
+# A/B with its interpret-mode equivalence test.  Rows must divide into the
+# tile (logsumexp_rows_pallas raises otherwise) — anyone re-running the
+# A/B at new shapes must re-check that gate.
 # ---------------------------------------------------------------------------
 
 

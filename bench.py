@@ -315,7 +315,17 @@ def bench_resnet_cifar(rtt, peak):
 
 def bench_smallnet(rtt, peak, batch_size=64):
     """Published SmallNet (CIFAR-quick) rows: 10.463 ms/batch at bs=64,
-    63.039 at bs=512 on 1x K40m (reference: benchmark/README.md:52-58)."""
+    63.039 at bs=512 on 1x K40m (reference: benchmark/README.md:52-58).
+
+    MFU floor analysis (v5e, r4): this 1999-shape net is structurally
+    lane-starved on the MXU — its convs contract K=75 (5x5x3), K=800->N=32
+    and K=800->N=64, i.e. tile utilization ~15-50% per conv against the
+    128x128 systolic array, weighted-average ceiling ~25%.  Marginal-batch
+    profiling (b64 0.254 ms vs b512 1.013 ms) puts the non-scaling launch
+    floor at only ~0.15 ms, so b512's measured ~15-20% MFU sits near that
+    structural ceiling; b64 additionally pays the launch floor (~60% of its
+    0.25 ms step).  No architecture-preserving lever moves this — the
+    channel counts ARE the benchmark."""
     import jax.numpy as jnp
 
     import paddle_tpu.nn as nn
@@ -395,11 +405,23 @@ def bench_alexnet(rtt, peak, batch_size=128):
 def bench_googlenet(rtt, peak, batch_size=128):
     """Published GoogLeNet rows: 613/1149/2348 ms/batch at bs=64/128/256 on
     1x K40m (reference: benchmark/README.md:45-50, googlenet.py — v1, no aux
-    heads, 224x224, 1000 classes)."""
+    heads, 224x224, 1000 classes).  fused_reduce per the recorded A/B
+    (models/image_bench._inception): on for b>=128, off for b64.
+
+    b64 floor analysis (v5e, r4): marginal-batch profiling (b64 ~13.1 ms
+    vs b128 ~19.2 ms) puts the NON-scaling fixed cost at ~7 ms — over half
+    the b64 step — spread across the ~250 conv/pool/concat kernels of the
+    9-module forward+backward (same launch-bound class as the ResNet20
+    floor, commit c0928f5).  The fused-reduce A/B was the remaining
+    structural lever; it wins at b128 and loses at b64 (slice/concat
+    traffic > launch savings), so b64's ~22% MFU is at its floor short of
+    cross-layer kernel fusion."""
     from paddle_tpu.models import googlenet
 
     return _bench_image_net(
-        rtt, peak, build=lambda: googlenet(num_classes=1000),
+        rtt, peak,
+        build=lambda: googlenet(num_classes=1000,
+                                fused_reduce=batch_size >= 128),
         batch_size=batch_size, hw=224, label="googlenet",
         published={64: 613.0, 128: 1149.0, 256: 2348.0})
 

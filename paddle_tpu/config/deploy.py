@@ -223,10 +223,10 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
         return tuple(outs[n].value for n in names)
 
     try:  # portable artifact when this jax supports multi-platform export
-        exported = jexport.export(jax.jit(fn),
-                                  platforms=("cpu", "tpu"))(*flat_example)
-    except TypeError:
-        exported = jexport.export(jax.jit(fn))(*flat_example)
+        exporter = jexport.export(jax.jit(fn), platforms=("cpu", "tpu"))
+    except TypeError:  # older jax.export signature without platforms=
+        exporter = jexport.export(jax.jit(fn))
+    exported = exporter(*flat_example)  # trace ONCE, outside the fallback
     manifest = {
         "magic": _AOT_MAGIC,
         "inputs": [

@@ -32,6 +32,7 @@ __all__ = [
     "sub_seq",
     "seq_reshape",
     "eos_trim",
+    "slice_channels",
 ]
 
 
@@ -387,5 +388,28 @@ def eos_trim(input: LayerOutput, *, eos_id: int = 1,
 
 
 from paddle_tpu.config.capture import wrap_module as _wrap_module
+
+
+
+def slice_channels(input: LayerOutput, start: int, end: int,
+                   name: Optional[str] = None) -> LayerOutput:
+    """Channel/feature sub-range [start, end) of a layer — the
+    slice-projection capability (reference trainer_config_helpers
+    slice_projection; SliceProjection.cpp).  For feature maps the slice is
+    over the channel (last NHWC) axis."""
+    name = name or next_name("slice")
+    if not (0 <= start < end <= input.size):
+        raise ConfigError(
+            f"slice_channels {name!r}: range [{start}, {end}) invalid for "
+            f"input size {input.size}")
+
+    def forward(ctx, params, a: Act) -> Act:
+        return Act(value=a.value[..., start:end], lengths=a.lengths,
+                   mask=a.mask, sub_lengths=a.sub_lengths)
+
+    out = LayerOutput(name, "slice_channels", end - start, [input], forward, [])
+    _inherit_meta(out, input)
+    return out
+
 
 _wrap_module(globals(), __all__)

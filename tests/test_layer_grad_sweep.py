@@ -405,6 +405,13 @@ def case_rotate(rng):
     return nn.rotate(_pre_conv(img)), feed
 
 
+def case_slice_channels(rng):
+    img, feed = _img(rng)
+    c = nn.img_conv(img, filter_size=3, num_filters=6, padding="SAME",
+                    act="linear", name="prec")
+    return nn.slice_channels(c, 1, 4), feed
+
+
 def case_bilinear_interp(rng):
     img, feed = _img(rng)
     return nn.bilinear_interp(_pre_conv(img), out_h=4, out_w=8), feed

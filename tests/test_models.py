@@ -223,3 +223,55 @@ class TestImageBenchNets:
         feed2 = dict(feed, pixel=rs.rand(2, 224, 224, 3).astype(np.float32))
         outs2, _ = topo.apply(params, state, feed2, train=False)
         assert np.abs(lg - np.asarray(outs2[logits.name].value)).max() > 1e-6
+
+
+def test_inception_fused_reduce_equivalence(rng):
+    """fused_reduce merges the three input 1x1 convs into one — with the
+    merged kernel/bias set to the concat of the three, the module must
+    compute the IDENTICAL function (pins the slice-offset wiring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.image_bench import _inception
+
+    spec = (4, 3, 5, 2, 3, 3)  # f1, f3r, f3, f5r, f5, proj
+
+    def build(fused):
+        nn.reset_naming()
+        x = nn.data("pixel", size=8, height=8, width=8)
+        out = _inception(x, *spec, fused_reduce=fused)
+        return nn.Topology(out), out.name
+
+    topo_u, out_u = build(False)
+    topo_f, out_f = build(True)
+    params_u, state_u = topo_u.init(jax.random.PRNGKey(0))
+
+    # creation order: unfused convs = b1, r3, r5, b3, b5, bp;
+    # fused convs = red(=concat of first three), b3, b5, bp
+    def conv_params(params):
+        ws = sorted(k for k in params if k.endswith(".w0"))
+        bs = sorted(k for k in params if k.endswith(".wbias"))
+        return ws, bs
+
+    ws_u, bs_u = conv_params(params_u)
+    params_f, state_f = topo_f.init(jax.random.PRNGKey(1))
+    ws_f, bs_f = conv_params(params_f)
+    assert len(ws_u) == 6 and len(ws_f) == 4
+    merged_w = jnp.concatenate([params_u[ws_u[0]], params_u[ws_u[1]],
+                                params_u[ws_u[2]]], axis=-1)
+    assert params_f[ws_f[0]].shape == merged_w.shape
+    params_f = dict(params_f)
+    params_f[ws_f[0]] = merged_w
+    params_f[bs_f[0]] = jnp.concatenate(
+        [params_u[bs_u[0]], params_u[bs_u[1]], params_u[bs_u[2]]])
+    for fu, un in zip(ws_f[1:], ws_u[3:]):
+        params_f[fu] = params_u[un]
+    for fu, un in zip(bs_f[1:], bs_u[3:]):
+        params_f[fu] = params_u[un]
+
+    feed = {"pixel": rng.randn(2, 8, 8, 8).astype(np.float32)}
+    y_u, _ = topo_u.apply(params_u, state_u, feed, train=False)
+    y_f, _ = topo_f.apply(params_f, state_f, feed, train=False)
+    np.testing.assert_allclose(np.asarray(y_u[out_u].value),
+                               np.asarray(y_f[out_f].value),
+                               rtol=1e-5, atol=1e-6)

@@ -10,3 +10,4 @@ from paddle_tpu.data.reader import (
 )
 from paddle_tpu.data.feeder import DataFeeder, bucket_length
 from paddle_tpu.data import datasets
+from paddle_tpu.data import provider

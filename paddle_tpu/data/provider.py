@@ -1,0 +1,266 @@
+"""PyDataProvider2 ``@provider`` protocol facade.
+
+The reference's primary data interface is a decorated per-file sample
+generator (reference: python/paddle/trainer/PyDataProvider2.py:318
+``provider(input_types, init_hook, cache, should_shuffle, ...)`` consumed by
+paddle/gserver/dataproviders/PyDataProvider2.cpp:195-212).  This module
+reproduces that protocol ON TOP of this framework's reader/feeder stack: the
+decorated function becomes a factory returning a ``DataProvider`` whose
+``.reader()`` plugs into ``data.batch``/``SGDTrainer`` and whose
+``.feeder()`` is the matching ``DataFeeder``.
+
+Supported surface: ``input_types`` as list or dict (dict keys name the data
+layers and let the generator yield dicts), ``init_hook(settings, file_list,
+**kwargs)`` with a free-attribute ``settings`` object, ``should_shuffle`` +
+``pool_size`` (buffered-pool shuffle), ``cache=CacheType.CACHE_PASS_IN_MEM``
+(first pass materialized, later passes replay), ``check`` (light per-slot
+validation, ``check_fail_continue`` to skip bad rows).  ``calc_batch_size``
+and ``can_over_batch_size`` are accepted and recorded but batching here is
+row-based (``data.batch``) — a warning is logged if a custom
+``calc_batch_size`` is supplied.
+"""
+
+from __future__ import annotations
+
+import functools
+import random as _random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu.data import input_types as _it
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data.input_types import InputType
+from paddle_tpu.utils import logger
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = [
+    "provider", "CacheType", "SequenceType", "InputType",
+    "dense_vector", "dense_vector_sequence", "dense_vector_sub_sequence",
+    "integer_value", "integer_value_sequence", "integer_value_sub_sequence",
+    "integer_sequence", "sparse_binary_vector", "sparse_float_vector",
+    "dense_slot", "index_slot", "sparse_non_value_slot", "sparse_value_slot",
+]
+
+
+class SequenceType:
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+# One shared InputType (paddle_tpu/data/input_types.py) serves both the v2
+# data_type facade and this v1 protocol — the reference's v2 types ARE the
+# PyDataProvider2 types, so @provider accepts either module's constructors.
+# The v1-style *_slot constructors below add the seq_type= keyword shape.
+
+_SEQ_CTORS = {
+    "dense": (_it.dense_vector, _it.dense_vector_sequence,
+              _it.dense_vector_sub_sequence),
+    "index": (_it.integer_value, _it.integer_value_sequence,
+              _it.integer_value_sub_sequence),
+}
+
+
+def dense_slot(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return _SEQ_CTORS["dense"][seq_type](dim)
+
+
+def index_slot(value_range, seq_type=SequenceType.NO_SEQUENCE):
+    return _SEQ_CTORS["index"][seq_type](value_range)
+
+
+def sparse_non_value_slot(dim, seq_type=SequenceType.NO_SEQUENCE):
+    if seq_type != SequenceType.NO_SEQUENCE:
+        raise ConfigError("sparse sequence slots are not supported")
+    return _it.sparse_binary_vector(dim)
+
+
+def sparse_value_slot(dim, seq_type=SequenceType.NO_SEQUENCE):
+    if seq_type != SequenceType.NO_SEQUENCE:
+        raise ConfigError("sparse sequence slots are not supported")
+    return _it.sparse_float_vector(dim)
+
+
+dense_vector = dense_slot
+integer_value = index_slot
+sparse_binary_vector = sparse_non_value_slot
+sparse_float_vector = sparse_value_slot
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, seq_type=SequenceType.SEQUENCE)
+
+
+def dense_vector_sub_sequence(dim):
+    return dense_vector(dim, seq_type=SequenceType.SUB_SEQUENCE)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, seq_type=SequenceType.SEQUENCE)
+
+
+def integer_value_sub_sequence(value_range):
+    return integer_value(value_range, seq_type=SequenceType.SUB_SEQUENCE)
+
+
+integer_sequence = integer_value_sequence
+
+
+class _Settings:
+    """Free-attribute settings object handed to init_hook / the generator
+    (the reference's ``settings`` parameter)."""
+
+    def __init__(self, **kw):
+        self.logger = logger
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _check_row(slot_values, input_types, names):
+    for v, it, n in zip(slot_values, input_types, names):
+        if it.kind == "int" and not it.seq:
+            if not (0 <= int(v) < it.dim):
+                raise AssertionError(f"slot {n!r}: index {v} not in "
+                                     f"[0, {it.dim})")
+        elif it.kind == "dense" and not it.seq:
+            if len(v) != it.dim:
+                raise AssertionError(f"slot {n!r}: dense len {len(v)} != "
+                                     f"{it.dim}")
+        elif it.kind == "int" and it.seq:
+            if any(not (0 <= int(x) < it.dim) for x in v):
+                raise AssertionError(f"slot {n!r}: id out of range")
+
+
+class DataProvider:
+    """The object the decorated function produces — reader + feeder pair."""
+
+    def __init__(self, generator, file_list, input_types, names, *,
+                 should_shuffle, pool_size, cache, check,
+                 check_fail_continue, settings):
+        self._generator = generator
+        self.file_list = list(file_list)
+        self.input_types = input_types
+        self.slot_names = names
+        self.settings = settings
+        self.should_shuffle = bool(should_shuffle)
+        self.pool_size = pool_size if pool_size and pool_size > 0 else 2048
+        self.cache = cache
+        self.check = check
+        self.check_fail_continue = check_fail_continue
+        self._cached_rows: Optional[List[tuple]] = None
+
+    # -- rows ----------------------------------------------------------
+
+    def _iter_rows(self):
+        for fname in self.file_list:
+            for item in self._generator(self.settings, fname):
+                if isinstance(item, dict):
+                    row = tuple(item[n] for n in self.slot_names)
+                elif isinstance(item, (list, tuple)):
+                    row = tuple(item)
+                else:
+                    row = (item,)  # SingleSlotWrapper behavior
+                if len(row) != len(self.input_types):
+                    raise ConfigError(
+                        f"provider yielded {len(row)} slots, expected "
+                        f"{len(self.input_types)}")
+                if self.check:
+                    try:
+                        _check_row(row, self.input_types, self.slot_names)
+                    except (AssertionError, TypeError, ValueError) as e:
+                        logger.warning("provider row failed check: %s", e)
+                        if self.check_fail_continue:
+                            continue
+                        raise
+                yield row
+
+    def reader(self) -> Callable:
+        """Reader creator: () -> iterator of slot tuples (data.batch-ready),
+        with the protocol's shuffle/cache semantics applied."""
+
+        def read():
+            if self.cache == CacheType.CACHE_PASS_IN_MEM:
+                if self._cached_rows is None:
+                    self._cached_rows = list(self._iter_rows())
+                rows: Any = self._cached_rows
+                if self.should_shuffle:
+                    rows = list(rows)
+                    _random.shuffle(rows)
+                yield from rows
+                return
+            if self.should_shuffle:
+                # buffered-pool shuffle (the reference's pool_size semantics)
+                pool: List[tuple] = []
+                for row in self._iter_rows():
+                    pool.append(row)
+                    if len(pool) >= self.pool_size:
+                        _random.shuffle(pool)
+                        yield from pool
+                        pool = []
+                _random.shuffle(pool)
+                yield from pool
+                return
+            yield from self._iter_rows()
+
+        return read
+
+    def feeder(self) -> DataFeeder:
+        """DataFeeder matching the declared input types (slot order)."""
+        return DataFeeder({n: it.feeder_kind
+                           for n, it in zip(self.slot_names,
+                                            self.input_types)})
+
+
+def provider(input_types=None, should_shuffle=None, pool_size=-1,
+             min_pool_size=-1, can_over_batch_size=True,
+             calc_batch_size=None, cache=CacheType.NO_CACHE, check=False,
+             check_fail_continue=False, init_hook=None, **outer_kwargs):
+    """Decorator turning ``process(settings, filename) -> yield sample``
+    into a DataProvider factory: ``process(file_list, **kwargs)`` returns a
+    :class:`DataProvider`.  ``input_types`` may also be assigned by
+    ``init_hook`` onto ``settings.input_types`` (the reference allows both)."""
+
+    def wrap(func):
+        @functools.wraps(func)
+        def create(file_list, **kwargs) -> DataProvider:
+            files = ([file_list] if isinstance(file_list, str)
+                     else list(file_list))
+            settings = _Settings(input_types=input_types, **outer_kwargs)
+            if init_hook is not None:
+                init_hook(settings, file_list=files, **kwargs)
+            its = settings.input_types
+            if its is None:
+                raise ConfigError(
+                    "provider: input_types not given (neither in @provider "
+                    "nor set by init_hook on settings)")
+            if isinstance(its, dict):
+                names = list(its.keys())
+                types = [its[n] for n in names]
+            else:
+                types = list(its)
+                names = [f"slot{i}" for i in range(len(types))]
+            settings.input_types = types
+            if calc_batch_size is not None:
+                logger.warning(
+                    "provider: calc_batch_size is recorded but batching in "
+                    "this framework is row-based (data.batch)")
+            shuffle = (should_shuffle if should_shuffle is not None
+                       else kwargs.get("is_train", True))
+            dp = DataProvider(
+                func, files, types, names,
+                should_shuffle=shuffle, pool_size=pool_size, cache=cache,
+                check=check, check_fail_continue=check_fail_continue,
+                settings=settings)
+            dp.calc_batch_size = calc_batch_size
+            dp.can_over_batch_size = can_over_batch_size
+            dp.min_pool_size = min_pool_size
+            return dp
+
+        create.is_data_provider = True  # reference marker attribute
+        return create
+
+    return wrap

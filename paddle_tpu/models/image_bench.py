@@ -62,14 +62,20 @@ def _inception(x, f1, f3r, f3, f5r, f5, proj, *, fused_reduce=False):
         red = nn.img_conv(x, filter_size=1, num_filters=f1 + f3r + f5r,
                           padding=0)
         b1 = nn.slice_channels(red, 0, f1)
-        r3 = nn.slice_channels(red, f1, f1 + f3r)
-        r5 = nn.slice_channels(red, f1 + f3r, f1 + f3r + f5r)
+        b3 = nn.img_conv(nn.slice_channels(red, f1, f1 + f3r),
+                         filter_size=3, num_filters=f3, padding=1)
+        b5 = nn.img_conv(nn.slice_channels(red, f1 + f3r, f1 + f3r + f5r),
+                         filter_size=5, num_filters=f5, padding=2)
     else:
+        # conv creation order (b1, r3, b3, r5, b5, bp) is LOAD-BEARING: the
+        # auto-generated _convN parameter names key checkpoints
         b1 = nn.img_conv(x, filter_size=1, num_filters=f1, padding=0)
-        r3 = nn.img_conv(x, filter_size=1, num_filters=f3r, padding=0)
-        r5 = nn.img_conv(x, filter_size=1, num_filters=f5r, padding=0)
-    b3 = nn.img_conv(r3, filter_size=3, num_filters=f3, padding=1)
-    b5 = nn.img_conv(r5, filter_size=5, num_filters=f5, padding=2)
+        b3 = nn.img_conv(nn.img_conv(x, filter_size=1, num_filters=f3r,
+                                     padding=0),
+                         filter_size=3, num_filters=f3, padding=1)
+        b5 = nn.img_conv(nn.img_conv(x, filter_size=1, num_filters=f5r,
+                                     padding=0),
+                         filter_size=5, num_filters=f5, padding=2)
     bp = nn.img_conv(nn.img_pool(x, pool_size=3, stride=1, padding=1),
                      filter_size=1, num_filters=proj, padding=0)
     return nn.concat([b1, b3, b5, bp])

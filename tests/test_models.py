@@ -246,8 +246,10 @@ def test_inception_fused_reduce_equivalence(rng):
     topo_f, out_f = build(True)
     params_u, state_u = topo_u.init(jax.random.PRNGKey(0))
 
-    # creation order: unfused convs = b1, r3, r5, b3, b5, bp;
-    # fused convs = red(=concat of first three), b3, b5, bp
+    # creation order: unfused convs = b1, r3, b3, r5, b5, bp (checkpoint
+    # name compatibility); fused convs = red(=concat of b1,r3,r5), b3, b5,
+    # bp — so merged slots are unfused indices [0, 1, 3] and the tail maps
+    # [b3, b5, bp] = unfused [2, 4, 5]
     def conv_params(params):
         ws = sorted(k for k in params if k.endswith(".w0"))
         bs = sorted(k for k in params if k.endswith(".wbias"))
@@ -258,15 +260,15 @@ def test_inception_fused_reduce_equivalence(rng):
     ws_f, bs_f = conv_params(params_f)
     assert len(ws_u) == 6 and len(ws_f) == 4
     merged_w = jnp.concatenate([params_u[ws_u[0]], params_u[ws_u[1]],
-                                params_u[ws_u[2]]], axis=-1)
+                                params_u[ws_u[3]]], axis=-1)
     assert params_f[ws_f[0]].shape == merged_w.shape
     params_f = dict(params_f)
     params_f[ws_f[0]] = merged_w
     params_f[bs_f[0]] = jnp.concatenate(
-        [params_u[bs_u[0]], params_u[bs_u[1]], params_u[bs_u[2]]])
-    for fu, un in zip(ws_f[1:], ws_u[3:]):
+        [params_u[bs_u[0]], params_u[bs_u[1]], params_u[bs_u[3]]])
+    for fu, un in zip(ws_f[1:], [ws_u[2], ws_u[4], ws_u[5]]):
         params_f[fu] = params_u[un]
-    for fu, un in zip(bs_f[1:], bs_u[3:]):
+    for fu, un in zip(bs_f[1:], [bs_u[2], bs_u[4], bs_u[5]]):
         params_f[fu] = params_u[un]
 
     feed = {"pixel": rng.randn(2, 8, 8, 8).astype(np.float32)}

@@ -506,27 +506,40 @@ def main() -> None:
     peak = _chip_peak(kind)
     rtt = _calibrate_rtt()
 
-    headline = bench_seq2seq(rtt, peak)
+    def safe(fn, *a, **kw):
+        # one broken row must not blank the WHOLE capture (a single
+        # remote-compile failure once cost an entire bench run)
+        try:
+            return fn(rtt, peak, *a, **kw)
+        except Exception as e:  # noqa: BLE001 — report, keep going
+            import traceback
+
+            traceback.print_exc()
+            return {"metric": f"{fn.__name__}{a}{kw}", "value": None,
+                    "unit": "ERROR", "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}"[:400]}
+
+    headline = safe(bench_seq2seq)
     # full published-baseline matrix (BASELINE.md:13-29): every LSTM row
     # (h1280 stresses VMEM residency), every AlexNet/GoogLeNet/SmallNet
     # batch size the reference's benchmark README reports
     extra = [
-        bench_lstm_textclf(rtt, peak),
-        bench_lstm_textclf(rtt, peak, batch_size=64, hidden=512),
-        bench_lstm_textclf(rtt, peak, batch_size=64, hidden=1280),
-        bench_lstm_textclf(rtt, peak, batch_size=128, hidden=256),
-        bench_lstm_textclf(rtt, peak, batch_size=256, hidden=256),
-        bench_resnet_cifar(rtt, peak),
-        bench_smallnet(rtt, peak),
-        bench_smallnet(rtt, peak, batch_size=512),
-        bench_alexnet(rtt, peak, batch_size=64),
-        bench_alexnet(rtt, peak),
-        bench_alexnet(rtt, peak, batch_size=256),
-        bench_alexnet(rtt, peak, batch_size=512),
-        bench_googlenet(rtt, peak, batch_size=64),
-        bench_googlenet(rtt, peak),
-        bench_googlenet(rtt, peak, batch_size=256),
-        bench_pallas_lstm_ab(rtt, peak),
+        safe(bench_lstm_textclf),
+        safe(bench_lstm_textclf, batch_size=64, hidden=512),
+        safe(bench_lstm_textclf, batch_size=64, hidden=1280),
+        safe(bench_lstm_textclf, batch_size=128, hidden=256),
+        safe(bench_lstm_textclf, batch_size=256, hidden=256),
+        safe(bench_resnet_cifar),
+        safe(bench_smallnet),
+        safe(bench_smallnet, batch_size=512),
+        safe(bench_alexnet, batch_size=64),
+        safe(bench_alexnet),
+        safe(bench_alexnet, batch_size=256),
+        safe(bench_alexnet, batch_size=512),
+        safe(bench_googlenet, batch_size=64),
+        safe(bench_googlenet),
+        safe(bench_googlenet, batch_size=256),
+        safe(bench_pallas_lstm_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

@@ -140,7 +140,9 @@ def _lstm_pallas_raw(xp_tb, mask_tb, w_h, pi, pf, po, *,
         jax.ShapeDtypeStruct((B, H), jnp.float32),
     ]
     if residuals:
-        rd = compute_dtype()  # bf16 residual streams under the prod policy
+        from paddle_tpu.ops.rnn_fused import residual_dtype
+
+        rd = residual_dtype(H)
         out_specs += [
             pl.BlockSpec((1, B, H4), step),
             pl.BlockSpec((1, B, H), step),
@@ -301,7 +303,9 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
         jax.ShapeDtypeStruct((B, H), jnp.float32),
     ]
     if residuals:
-        rd = compute_dtype()
+        from paddle_tpu.ops.rnn_fused import residual_dtype
+
+        rd = residual_dtype(H)
         out_specs += [
             pl.BlockSpec((1, B, H3), step),
             pl.BlockSpec((1, B, H), step),

@@ -44,6 +44,19 @@ from paddle_tpu.ops.matmul import linear
 __all__ = ["gru_sequence_fused", "lstm_sequence_fused"]
 
 
+def residual_dtype(hidden: int):
+    """Dtype of the z/h_prev/c_prev residual streams: bf16 under the prod
+    compute policy for H <= 512 (halves backward HBM traffic and buys back
+    the scoped VMEM that re-enables the Pallas reverse kernel at B384/H512),
+    f32 otherwise — at large H the in-kernel bf16 cast temporaries OVERFLOW
+    scoped VMEM (measured: the h1280 forward kernel jumps from <16M to
+    30.6M and fails to compile with bf16 residuals)."""
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    cd = compute_dtype()
+    return cd if (cd == jnp.bfloat16 and hidden <= 512) else jnp.float32
+
+
 def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
     """Backward Pallas gate: forward tile constraints PLUS a VMEM cap that
     depends on the residual stream dtype.  The reverse kernel's per-step
@@ -56,9 +69,8 @@ def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
     dtype-dependent cap."""
     from paddle_tpu.ops.rnn import _use_pallas_rnn
 
-    from paddle_tpu.ops.numerics import compute_dtype
-
-    cap = 384 * 512 if compute_dtype() == jnp.bfloat16 else 256 * 512
+    cap = (384 * 512 if residual_dtype(hidden) == jnp.bfloat16
+           else 256 * 512)
     return _use_pallas_rnn(batch, hidden) and batch * hidden <= cap
 
 
@@ -71,15 +83,13 @@ def _gru_fwd_scan(xp, mask, w_h, h0):
     """Masked forward scan; xp [B,T,3H], mask [B,T] -> (h_seq [B,T,H],
     h_fin, z [T,B,3H] pre-activations, hprev [T,B,H]).
     Mirrors scan_rnn(gru_step) numerics (bf16 matmul operands in linear).
-    Residuals are stored in the COMPUTE dtype (bf16 under the production
-    policy, f32 in tests): they exist only to recompute gates in the
-    backward, and halving their HBM stream is worth the rounding —
-    gradients become approximate at bf16's 0.4% ULP, standard mixed
-    precision practice."""
-    from paddle_tpu.ops.numerics import compute_dtype
-
-    rd = compute_dtype()
+    Residuals are stored in ``residual_dtype(H)`` (bf16 under the
+    production policy for H <= 512, f32 otherwise and in tests): they
+    exist only to recompute gates in the backward, and halving their HBM
+    stream is worth the rounding — gradients become approximate at bf16's
+    0.4% ULP, standard mixed precision practice."""
     H = w_h.shape[0]
+    rd = residual_dtype(H)
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
 
@@ -219,11 +229,9 @@ def _lstm_fwd_scan(xp, mask, w_h, h0, c0, pi, pf, po):
     """Masked forward scan; xp [B,T,4H] (gate order i,f,o,g as lstm_step),
     pi/pf/po [H] peephole ("check") vectors (zeros = plain cell)
     -> (h_seq, h_fin, c_fin, z [T,B,4H] PRE-peephole, hprev, cprev) —
-    residuals in the compute dtype (see _gru_fwd_scan)."""
-    from paddle_tpu.ops.numerics import compute_dtype
-
-    rd = compute_dtype()
+    residuals in ``residual_dtype(H)`` (see _gru_fwd_scan)."""
     H = w_h.shape[0]
+    rd = residual_dtype(H)
     xp_tb = jnp.moveaxis(xp, 1, 0)
     m_tb = jnp.moveaxis(mask, 1, 0)
 

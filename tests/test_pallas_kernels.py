@@ -199,10 +199,16 @@ class TestFusedCEReadout:
         def fused(states, w, b):
             return _ce_readout_fused(states, w, b, labels, mask)
 
+        from conftest import on_accelerator
+
+        # hardware mode runs the bf16 compute policy: the two formulations
+        # agree only to bf16 rounding there, exactly on the f32 CPU policy
+        rtol, atol = (0.05, 1e-3) if on_accelerator() else (1e-5, 1e-6)
         np.testing.assert_allclose(float(ref(states, w, b)),
-                                   float(fused(states, w, b)), rtol=1e-6)
+                                   float(fused(states, w, b)),
+                                   rtol=max(rtol, 1e-6))
         g_ref = jax.grad(ref, (0, 1, 2))(states, w, b)
         g_new = jax.grad(fused, (0, 1, 2))(states, w, b)
         for name, a, c in zip(("states", "w", "b"), g_ref, g_new):
             np.testing.assert_allclose(np.asarray(a), np.asarray(c),
-                                       rtol=1e-5, atol=1e-6, err_msg=name)
+                                       rtol=rtol, atol=atol, err_msg=name)

@@ -25,9 +25,11 @@ forward on v5e).  The custom VJP instead:
   accumulators are f32: summing T bfloat16 terms drifts for long targets,
   and a bf16 ``d_enc_proj`` carry A/B-measured slower anyway.
 
-Forward saves (probs [T,B,S], ctx [T,B,2H], states) — O(B·T·(S+2H+D))
-residuals, ~100 MB at bench shapes vs the ~1.3 GB/step-loop accumulator
-traffic it removes.
+Forward saves (probs [T,B,S] f32, ctx [T,B,2H] in the compute dtype,
+s_prev [T,B,D] f32 — the carry entering each step, stacked so the backward
+needs no sequential carry-reconstruction scan) — O(B·T·(S+2H+D)) residual
+buffers alongside the primal states output, ~100-125 MB at bench shapes vs
+the ~1.3 GB/step-loop accumulator traffic the restructure removes.
 """
 
 from __future__ import annotations
@@ -112,26 +114,30 @@ def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
         keep = (m_t > 0)[:, None]
         s_out = jnp.where(keep, s_new, s)
         out = s_out * m_t[:, None].astype(s_out.dtype)
-        return s_out, (out, w, ctx.astype(rd))
+        # s (the carry ENTERING the step) is exactly the s_prev the backward
+        # needs — stacking it here deletes the backward's sequential
+        # carry-reconstruction scan
+        return s_out, (out, w, ctx.astype(rd), s)
 
-    _, (outs, probs, ctxs) = lax.scan(step, s0, (xp_y_tb, m_tb))
+    _, (outs, probs, ctxs, s_prev) = lax.scan(step, s0, (xp_y_tb, m_tb))
     states = jnp.moveaxis(outs, 0, 1)                      # [B,T,D]
-    return states, (probs, ctxs)
+    return states, (probs, ctxs, s_prev)
 
 
 def _agd_fwd(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
              att_w, att_v, wx, b, wh):
-    states, (probs, ctxs) = _decoder_fwd_scan(
+    states, (probs, ctxs, s_prev) = _decoder_fwd_scan(
         y_emb, s0, enc, enc_proj, src_mask, trg_mask, att_w, att_v, wx, b, wh)
     res = (y_emb, s0, enc, enc_proj, src_mask, trg_mask,
-           att_w, att_v, wx, b, wh, states, probs, ctxs)
+           att_w, att_v, wx, b, wh, s_prev, probs, ctxs)
     return states, res
 
 
 def _agd_bwd(res, d_states):
     (y_emb, s0, enc, enc_proj, src_mask, trg_mask,
-     att_w, att_v, wx, b, wh, states, probs, ctxs) = res
-    B, T, D = states.shape
+     att_w, att_v, wx, b, wh, s_prev, probs, ctxs) = res
+    B, T = trg_mask.shape
+    D = s0.shape[-1]
     S = enc.shape[1]
     E = y_emb.shape[-1]
     f32 = jnp.float32
@@ -143,15 +149,8 @@ def _agd_bwd(res, d_states):
     # [T,B,3D] f32 residual)
     xp_y_tb = jnp.moveaxis(linear(y_emb, wx[:E], b), 1, 0)
     d_out_tb = jnp.moveaxis(d_states, 1, 0).astype(f32)    # [T,B,D]
-    # s_prev[t]: carry entering step t.  The saved states are the zeroed
-    # outputs (out = carry*m), so at masked steps the HELD carry must be
-    # reconstructed by forward-filling the last live output:
-    def carry_fix(c, om):
-        out_t, m_t = om
-        c_t = jnp.where((m_t > 0)[:, None], out_t, c)
-        return c_t, c_t
-    _, carries = lax.scan(carry_fix, s0, (jnp.moveaxis(states, 1, 0), m_tb))
-    s_prev = jnp.concatenate([s0[None], carries[:-1]], 0)  # [T,B,D]
+    # s_prev [T,B,D] arrives stacked straight from the forward scan (the
+    # carry entering each step) — no reconstruction scan needed
 
     att_w_f, att_v_f = att_w.astype(f32), att_v.astype(f32)
     wx_f, wh_f = wx.astype(f32), wh.astype(f32)

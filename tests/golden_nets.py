@@ -208,6 +208,23 @@ def image_misc_net():
                                          "label": ("label",)}, n_cls=4)
 
 
+def fused_inception_net():
+    """Fused-reduce inception block: merged 1x1 conv + slice_channels —
+    locks the new slice layer's serialization."""
+    img = nn.data("img", size=8, height=8, width=8)
+    red = nn.img_conv(img, filter_size=1, num_filters=8, padding=0,
+                      name="red")
+    b1 = nn.slice_channels(red, 0, 3, name="s1")
+    b3 = nn.img_conv(nn.slice_channels(red, 3, 8, name="s3"),
+                     filter_size=3, num_filters=6, padding=1, name="c3")
+    cat = nn.concat([b1, b3], name="cat")
+    out = nn.fc(cat, 4, act="softmax", name="out")
+    lbl = nn.data("label", size=4, dtype="int32")
+    cost = nn.classification_cost(input=out, label=lbl, name="cost")
+    return nn.Topology(cost), _cls_feed({"img": ("dense", (8, 8, 8)),
+                                         "label": ("label",)}, n_cls=4)
+
+
 def resnet_block_net():
     img = nn.data("img", size=4, height=8, width=8)
     c1 = nn.img_conv(img, filter_size=3, num_filters=4, padding=1,
@@ -353,6 +370,7 @@ GOLDEN_NETS = {
     "nce": nce_net,
     "hsigmoid": hsigmoid_net,
     "image_misc": image_misc_net,
+    "fused_inception": fused_inception_net,
     "resnet_block": resnet_block_net,
     "lstm_group": lstm_group_net,
     "gru_group": gru_group_net,

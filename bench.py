@@ -61,7 +61,13 @@ def _time_chain(one_step, carry, *, iters, rtt, reps=3):
     The chain length ADAPTS: the tunnel round-trip being subtracted is both
     large (>100 ms on a bad day) and jittery, so the chain must dominate it
     or the subtraction underflows (a fast model once timed "0.0 ms/batch").
-    iters doubles until the on-device time is at least 2x the RTT."""
+    iters doubles until the on-device time is at least 2x the RTT.
+
+    Interference guard (VERDICT r4 item 2c): the tunnel host is shared — a
+    contended window shows up as a wide rep spread (AlexNet b512 once
+    published 44-81 ms from one capture).  If max/min across reps exceeds
+    1.5x, the whole rep set is re-measured (up to twice) and the cleanest
+    set — smallest spread — is the one reported."""
     import jax
 
     def make_chain(n):
@@ -86,25 +92,88 @@ def _time_chain(one_step, carry, *, iters, rtt, reps=3):
     except Exception:
         pass
 
-    for attempt in range(8):  # grow the chain until it dominates the RTT
-        chain = make_chain(iters)
-        _, probe = chain(carry)  # compile + first run
-        _fetch(probe)
+    def measure(chain):
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
             _, probe = chain(carry)
             _fetch(probe)
             times.append(time.perf_counter() - t0)
+        return times
+
+    for attempt in range(8):  # grow the chain until it dominates the RTT
+        chain = make_chain(iters)
+        _, probe = chain(carry)  # compile + first run
+        _fetch(probe)
+        times = measure(chain)
         total = float(np.median(times))
         if total - rtt >= max(rtt, 0.02) or attempt == 7:
             break
         iters *= 2
+
+    def spread(ts):
+        return max(ts) / max(min(ts), 1e-12)
+
+    for _ in range(2):  # interference guard: retry contended windows
+        if spread(times) <= 1.5:
+            break
+        retry = measure(chain)
+        if spread(retry) < spread(times):
+            times = retry
+    total = float(np.median(times))
     sec = max(total - rtt, 1e-9) / iters  # iters == the length just timed
     # dispersion across the reps of the final chain (median-of-N harness;
     # VERDICT r3 item 4: every row must carry min/max, not a single sample)
     per_step = sorted(max(t - rtt, 1e-9) / iters for t in times)
     return sec, flops, (per_step[0], per_step[-1])
+
+
+def _jaxpr_flops(fn, carry):
+    """Analytic matmul+conv FLOPs of one step, from walking the jaxpr.
+
+    Fallback for rows where XLA's ``cost_analysis`` returns nothing
+    (VERDICT r4 weak #4: googlenet b128 published ``mfu: null``).  Counts
+    2*M*N*K per dot_general and 2*out_elems*(filter_spatial*Cin/groups) per
+    conv, recursing through pjit/scan/cond/custom-vjp sub-jaxprs (scan
+    bodies multiplied by trip count — the case XLA's counter gets wrong)."""
+    import jax
+
+    def count(jaxpr) -> float:
+        total = 0.0
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "dot_general":
+                (lc, _), _ = eqn.params["dimension_numbers"]
+                lhs = eqn.invars[0].aval
+                k = float(np.prod([lhs.shape[d] for d in lc], dtype=np.float64))
+                out = float(np.prod(eqn.outvars[0].aval.shape, dtype=np.float64))
+                total += 2.0 * out * k
+            elif name == "conv_general_dilated":
+                dn = eqn.params["dimension_numbers"]
+                rhs = eqn.invars[1].aval
+                # rhs_spec[0]=out-chan dim, [1]=in-chan(per group), rest spatial
+                k = float(np.prod([rhs.shape[d] for d in dn.rhs_spec[1:]],
+                                  dtype=np.float64))
+                out = float(np.prod(eqn.outvars[0].aval.shape, dtype=np.float64))
+                total += 2.0 * out * k
+            elif name == "cond":
+                branches = eqn.params.get("branches", ())
+                if branches:
+                    total += max(count(b.jaxpr) for b in branches)
+            else:
+                mult = float(eqn.params.get("length", 1)) if name == "scan" else 1.0
+                for v in eqn.params.values():
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        total += mult * count(inner)
+                    elif hasattr(v, "eqns"):
+                        total += mult * count(v)
+        return total
+
+    try:
+        return count(jax.make_jaxpr(fn)(carry).jaxpr)
+    except Exception:
+        return None
 
 
 def _calibrate_rtt():
@@ -228,15 +297,75 @@ def bench_seq2seq(rtt, peak):
     mfu = _mfu(sec, analytic, peak)
     return {
         "metric": f"seqToseq_wmt14_words_per_sec_per_chip(B{B},S{S},T{T},512d,vocab30k)",
+        "short": "seq2seq",
         "value": round(words, 1),
         "unit": "words/s",
         "vs_baseline": round(mfu / 0.35, 3) if mfu is not None else None,
         "mfu": mfu,
+        # MFU of the WORST rep window: the >=35% target should hold even in
+        # the most contended capture window, not just the median
+        "mfu_worst": _mfu(hi, analytic, peak),
         "ms_per_batch": round(sec * 1e3, 3),
         "ms_min": round(lo * 1e3, 3),
         "ms_max": round(hi * 1e3, 3),
         "flops_per_step": analytic,
         "flops_xla_counted": flops,
+    }
+
+
+def bench_seq2seq_decode(rtt, peak):
+    """Flagship beam-search generation throughput — the seqToseq gen job
+    (reference: demo/seqToseq gen.sh + --job=test over
+    RecurrentGradientMachine::generateSequence, .cpp:383; SWIG
+    SequenceGenerator PaddleAPI.h:1002).  Beam 3, B=64, the demo shape.
+
+    MFU here is computed against the analytic forward FLOPs of the decode
+    program (encoder + per-step beam decoder + the [B*K, D] x [D, V]
+    readout each step, which dominates); generation has no backward, and
+    each step's matmuls ride B*K=192 rows, so the expected roofline is far
+    below training MFU — the number published is words/s with that
+    context."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import Seq2SeqAttention
+
+    B, S, K, L = 64, 32, 3, 32
+    m = Seq2SeqAttention()
+    params = m.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(3, m.src_vocab, (B, S)).astype(np.int32))
+    src_len = jnp.full((B,), S, jnp.int32)
+
+    def one_step(carry):
+        params, src, src_len = carry
+        toks, scores = m.beam_search(params, src, src_len, beam_size=K,
+                                     max_len=L)
+        return (params, src, src_len), scores.sum()
+
+    sec, flops, (lo, hi) = _time_chain(one_step, (params, src, src_len),
+                                       iters=10, rtt=rtt)
+    words = B * L / sec  # emitted target tokens (best beam) per second
+    E, Hd, Dd, A = m.emb_dim, m.enc_dim, m.dec_dim, m.att_dim
+    V = m.trg_vocab
+    BK = B * K
+    enc_fwd = (2 * B * S * E * 3 * Hd * 2 + 2 * B * S * Hd * 3 * Hd * 2
+               + B * S * 2 * Hd * A * 2)
+    step_fwd = (BK * Dd * A * 2 + BK * S * A * 2 + BK * S * 2 * Hd * 2
+                + BK * (E + 2 * Hd) * 3 * Dd * 2 + BK * Dd * 3 * Dd * 2
+                + BK * Dd * V * 2)
+    analytic = enc_fwd + L * step_fwd
+    return {
+        "metric": f"seqToseq_beam{K}_decode_words_per_sec(B{B},S{S},L{L})",
+        "short": "seq2seq_decode",
+        "value": round(words, 1),
+        "unit": "words/s",
+        "vs_baseline": None,  # the reference never published gen throughput
+        "mfu": _mfu(sec, analytic, peak),
+        "ms_per_batch": round(sec * 1e3, 3),
+        "ms_min": round(lo * 1e3, 3),
+        "ms_max": round(hi * 1e3, 3),
+        "flops_per_decode": analytic,
     }
 
 
@@ -273,6 +402,7 @@ def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256):
     base = published.get((B, HID))
     return {
         "metric": f"lstm_textclf_train_ms_per_batch(b{B},h{HID},T100,vocab30k)",
+        "short": f"lstm_b{B}h{HID}",
         "value": round(ms, 3),
         "unit": "ms/batch",
         "vs_baseline": round(base / ms, 3) if base else None,
@@ -301,8 +431,11 @@ def bench_resnet_cifar(rtt, peak):
     }
     one_step, carry = _topology_step(cost, Momentum(learning_rate=0.1), feeds)
     sec, flops, (lo, hi) = _time_chain(one_step, carry, iters=30, rtt=rtt)
+    if flops is None:
+        flops = _jaxpr_flops(one_step, carry)
     return {
         "metric": f"resnet20_cifar10_train_images_per_sec(b{B})",
+        "short": f"resnet20_b{B}",
         "value": round(B / sec, 1),
         "unit": "images/s",
         "vs_baseline": None,
@@ -343,10 +476,13 @@ def bench_smallnet(rtt, peak, batch_size=64):
     }
     one_step, carry = _topology_step(cost, Momentum(learning_rate=0.1), feeds)
     sec, flops, (lo, hi) = _time_chain(one_step, carry, iters=50, rtt=rtt)
+    if flops is None:
+        flops = _jaxpr_flops(one_step, carry)
     ms = sec * 1e3
     base = published.get(B)
     return {
         "metric": f"smallnet_cifar_train_ms_per_batch(b{B})",
+        "short": f"smallnet_b{B}",
         "value": round(ms, 3),
         "unit": "ms/batch",
         "vs_baseline": round(base / ms, 3) if base else None,
@@ -377,10 +513,13 @@ def _bench_image_net(rtt, peak, *, build, batch_size, hw, label, published):
     one_step, carry = _image_net_step(build, batch_size, hw, hw,
                                       Momentum(learning_rate=0.01))
     sec, flops, (lo, hi) = _time_chain(one_step, carry, iters=10, rtt=rtt)
+    if flops is None:  # XLA cost analysis came back empty (r4: googlenet b128)
+        flops = _jaxpr_flops(one_step, carry)
     ms = sec * 1e3
     base = published.get(batch_size)
     return {
         "metric": f"{label}_train_ms_per_batch(b{batch_size},{hw}px,1000cls)",
+        "short": f"{label}_b{batch_size}",
         "value": round(ms, 3),
         "unit": "ms/batch",
         "vs_baseline": round(base / ms, 3) if base else None,
@@ -485,6 +624,7 @@ def bench_pallas_lstm_ab(rtt, peak):
     best = min(x for x in (xla_sec, pallas_sec) if x is not None)
     return {
         "metric": "pallas_lstm_ab_fwd_bwd_ms(b64,h256,T100)",
+        "short": "pallas_lstm_ab",
         "value": round(best * 1e3, 3),
         "unit": "ms",
         "vs_baseline": None,
@@ -524,6 +664,7 @@ def main() -> None:
     # (h1280 stresses VMEM residency), every AlexNet/GoogLeNet/SmallNet
     # batch size the reference's benchmark README reports
     extra = [
+        safe(bench_seq2seq_decode),
         safe(bench_lstm_textclf),
         safe(bench_lstm_textclf, batch_size=64, hidden=512),
         safe(bench_lstm_textclf, batch_size=64, hidden=1280),
@@ -550,6 +691,21 @@ def main() -> None:
     out["peak_flops"] = peak
     out["rtt_ms"] = round(rtt * 1e3, 2)
     out["extra"] = extra
+    # compact ALL-rows summary as the very LAST key: the driver keeps only
+    # ~2000 tail chars of this line, which in r4 ate 7 of 17 rows including
+    # the round's headline achievement (VERDICT r4 item 2a).  Format:
+    # short-name -> [value, mfu, vs_baseline] ("ERROR" for failed rows).
+    summary = {}
+    for row in [headline] + extra[:-1]:
+        key = row.get("short") or row.get("metric", "?")
+        if row.get("unit") == "ERROR":
+            summary[key] = "ERROR"
+        else:
+            summary[key] = [row.get("value"), row.get("mfu"),
+                            row.get("vs_baseline")]
+    summary["seq2seq_worst_window"] = [headline.get("ms_max"),
+                                       headline.get("mfu_worst"), None]
+    out["summary"] = summary
     print(json.dumps(out))
 
 

@@ -43,6 +43,13 @@ _DICT_CACHE: dict = {}
 
 
 def _cached(key, build):
+    """Per-process cache for parsed dicts/meta.  Any path element of the key
+    is augmented with (mtime, size) so replacing a dataset file in-process
+    invalidates stale entries (ADVICE r4: the cache once keyed on path only)."""
+    key = tuple(
+        (k, os.path.getmtime(k), os.path.getsize(k))
+        if isinstance(k, str) and os.path.isfile(k) else k
+        for k in (key if isinstance(key, tuple) else (key,)))
     if key not in _DICT_CACHE:
         _DICT_CACHE[key] = build()
     return _DICT_CACHE[key]
@@ -189,16 +196,27 @@ def wmt14(split: str = "train", *, dict_size: int = 30000, n: int | None = None)
     return synth_reader
 
 
-def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3706,
+def movielens(split: str = "train", *, n_users: int = 6040, n_movies: int = 3952,
               n: int | None = None) -> Callable:
     """Yields (user_id, movie_id, rating float 1-5) — recommendation shapes
     with 0-based ids.  Real data: $PADDLE_TPU_DATA_HOME/movielens/ml-1m.zip
     (reference movielens.py:60-160; the reference keeps 1-based ids and
-    rescales ratings to 2r-5 — this loader normalizes both)."""
+    rescales ratings to 2r-5 — this loader normalizes both).
+
+    Real rows whose ids exceed the requested ``n_users``/``n_movies`` are
+    FILTERED out (ml-1m movie ids run to 3951 0-based; an out-of-range id
+    would flow into an embedding gather, which XLA clamps silently —
+    corrupted training with no error).  Defaults cover the full ml-1m id
+    space (ML_SCHEMA: 6040 users, 3952 movie-id slots)."""
     z = _real("movielens", "ml-1m.zip")
     if z:
-        return _capped(
-            lambda: formats.iter_movielens(z, split, features=False), n)
+
+        def real_reader():
+            for u, m, r in formats.iter_movielens(z, split, features=False):
+                if u < n_users and m < n_movies:
+                    yield u, m, r
+
+        return _capped(real_reader, n)
 
     def synth_reader():
         n_ = n if n is not None else 4096

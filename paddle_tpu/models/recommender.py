@@ -23,7 +23,8 @@ from paddle_tpu.data.datasets import ML_SCHEMA  # ml-1m cardinalities
 __all__ = ["movielens_net", "movielens_feature_net", "ML_SCHEMA"]
 
 
-def movielens_net(n_users: int = 6040, n_movies: int = 3706, *, emb_dim: int = 64,
+def movielens_net(n_users: int = ML_SCHEMA["n_users"],
+                  n_movies: int = ML_SCHEMA["n_movies"], *, emb_dim: int = 64,
                   hid_dim: int = 64):
     """Two embedding towers -> fc -> dot regression to rating. Returns
     (cost, prediction)."""

@@ -126,12 +126,19 @@ def _ce_readout_fused(states, w, b, labels, mask):
 
 
 def _ce_readout_fwd(states, w, b, labels, mask):
+    import math
+
     from paddle_tpu.ops.pallas_kernels import logsumexp_rows_pallas
 
     B, T, _ = states.shape
     logits = _readout_logits(states, w, b)
     V = logits.shape[-1]
-    lse = logsumexp_rows_pallas(logits.reshape(B * T, V)).reshape(B, T)
+    # the kernel requires N % row_tile == 0; gcd keeps the recorded-A/B
+    # path runnable at ANY B*T (ADVICE r4: row_tile=64 traced-failed when
+    # B*T wasn't a multiple of 64)
+    rt = math.gcd(B * T, 64)
+    lse = logsumexp_rows_pallas(logits.reshape(B * T, V),
+                                row_tile=rt).reshape(B, T)
     lab = jnp.expand_dims(labels.astype(jnp.int32), -1)
     tok = jnp.squeeze(jnp.take_along_axis(logits, lab, axis=-1), -1)
     per_tok = lse - tok.astype(jnp.float32)

@@ -46,6 +46,32 @@ from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
 __all__ = ["attention_gru_decoder"]
 
 
+def _attn_pallas_block(B, S, D, A, H2):
+    """Batch-block size for the VMEM-resident Pallas decoder kernels
+    (ops/pallas_kernels.py: attn_dec_fwd_pallas / attn_dec_bwd_pallas), or
+    None to use the XLA scan path.  Gates: flag + TPU backend + lane/tile
+    alignment (the kernels slice [Bb, S, A]/[Bb, gates*D] blocks) + the
+    resident working set (enc, enc_proj, the backward's d_enc_proj
+    accumulator and its d_pre temporary, all per block) must fit the raised
+    VMEM budget."""
+    import jax as _jax
+
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not FLAGS.use_pallas_attention:
+        return None
+    if _jax.default_backend() not in ("tpu", "axon"):
+        return None
+    if D % 128 or A % 128 or H2 % 128 or S % 8:
+        return None
+    for bb in (128, 96, 64, 32, 16, 8):
+        # f32 worst case: enc_proj + enc resident, plus 2x [Bb,S,A] f32
+        # (accumulator + d_pre temp) in the backward
+        if B % bb == 0 and bb * S * (12 * A + 4 * H2) <= 48 * 1024 * 1024:
+            return bb
+    return None
+
+
 def _fwd_step(s, xp_y_t, enc, enc_proj, src_mask, att_w, att_v, wx_c, wh):
     """One decoder step; mirrors additive_attention_scores/attend/gru_step
     numerics (bf16 matmul operands, f32 accumulation).  ``xp_y_t`` is the
@@ -106,6 +132,21 @@ def _decoder_fwd_scan(y_emb, s0, enc, enc_proj, src_mask, trg_mask,
     from paddle_tpu.ops.numerics import compute_dtype
 
     rd = compute_dtype()  # residual stream dtype (bf16 under prod policy)
+
+    B, T = trg_mask.shape
+    bb = _attn_pallas_block(B, enc.shape[1], s0.shape[-1],
+                            enc_proj.shape[-1], enc.shape[2])
+    if bb is not None:
+        from paddle_tpu.ops.pallas_kernels import attn_dec_fwd_pallas
+
+        f32 = jnp.float32
+        enc_c, encP_c, attw_c, attv_c, wxc_c, wh_c = mxu_cast(
+            enc, enc_proj, att_w, att_v, wx_c, wh)
+        outs, probs, ctxs, s_prev = attn_dec_fwd_pallas(
+            xp_y_tb.astype(f32), m_tb.astype(f32), s0.astype(f32),
+            enc_c, encP_c, src_mask.astype(f32),
+            attw_c, attv_c, wxc_c, wh_c, block_b=bb)
+        return jnp.moveaxis(outs, 0, 1), (probs, ctxs, s_prev)
 
     def step(s, inp):
         xp_y_t, m_t = inp
@@ -225,13 +266,24 @@ def _agd_bwd(res, d_states):
         return (d_s_out, d_encP, d_v), (d_xp, sum_dpre)
 
     A = enc_proj.shape[-1]
-    acc0 = (jnp.zeros((B, D), f32),
-            jnp.zeros((B, S, A), f32),
-            jnp.zeros(att_v.shape, f32))
-    (d_s0, d_encP, d_v), (d_xp_tb, sum_dpre_tb) = lax.scan(
-        rev_step, acc0,
-        (d_out_tb, m_tb, probs, s_prev, r_all, u_all, cand_all, q_all),
-        reverse=True)
+    bb = _attn_pallas_block(B, S, D, A, enc.shape[2])
+    if bb is not None:
+        from paddle_tpu.ops.pallas_kernels import attn_dec_bwd_pallas
+
+        enc_c, encP_c, attv_c = mxu_cast(enc, enc_proj, att_v)
+        d_xp_tb, sum_dpre_tb, d_encP, d_v, d_s0 = attn_dec_bwd_pallas(
+            d_out_tb, m_tb.astype(f32), s_prev.astype(f32),
+            r_all, u_all, cand_all, q_all,
+            enc_c, encP_c, src_mask.astype(f32),
+            att_w_f, attv_c, att_v_f, wh_f, wx_f[E:], block_b=bb)
+    else:
+        acc0 = (jnp.zeros((B, D), f32),
+                jnp.zeros((B, S, A), f32),
+                jnp.zeros(att_v.shape, f32))
+        (d_s0, d_encP, d_v), (d_xp_tb, sum_dpre_tb) = lax.scan(
+            rev_step, acc0,
+            (d_out_tb, m_tb, probs, s_prev, r_all, u_all, cand_all, q_all),
+            reverse=True)
     d_b = jnp.sum(d_xp_tb, axis=(0, 1))  # bias grad off the stacked output
 
     # ---- batched post-scan contractions (weight grads were carried

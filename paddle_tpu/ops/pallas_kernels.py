@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pallas_available", "lstm_forward_pallas", "gru_forward_pallas"]
+__all__ = ["pallas_available", "lstm_forward_pallas", "gru_forward_pallas",
+           "attn_dec_fwd_pallas", "attn_dec_bwd_pallas"]
 
 
 def pallas_available() -> bool:
@@ -619,3 +620,303 @@ def logsumexp_rows_pallas(x, *, row_tile: int = 64):
         interpret=_interpret(),
     )(x)
     return out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Attention GRU decoder time-loop kernels — the flagship's structural
+# bottleneck (VERDICT r4 item 1).  The XLA scan re-reads enc [B,S,2H] and
+# enc_proj [B,S,A] from HBM on EVERY decoder step, and the backward
+# additionally carries the d_enc_proj [B,S,A] f32 cotangent accumulator
+# through HBM each reverse step (~88 MB/step at WMT14 bench shapes,
+# ~2.8 GB per backward).  Here the grid is (batch-blocks, T) with time
+# innermost: enc/enc_proj (and in the backward, the d_enc_proj accumulator
+# block) stay VMEM-RESIDENT across all T steps of a batch block — per-step
+# HBM traffic drops to the small [Bb,*] streams.  Mosaic's default 16 MB
+# scoped-VMEM cap is raised via CompilerParams (v5e has 128 MB physical
+# VMEM); block sizes are gated to fit.
+#
+# Numerics mirror ops/attention_decoder.py exactly: forward follows
+# _fwd_step (compute-dtype MXU operands, f32 accumulation), backward
+# follows _agd_bwd.rev_step (all-f32 with compute-dtype enc/enc_proj
+# reads), so the interpret-mode equivalence tests compare bitwise-same
+# ops on CPU (f32 policy).
+# ---------------------------------------------------------------------------
+
+
+def _attn_dec_fwd_kernel(xp_y_ref, m_ref, s0_ref, encP_ref, enc_ref,
+                         smask_ref, attw_ref, attv_ref, wxc_ref, wh_ref,
+                         out_ref, probs_ref, ctx_ref, sprev_ref,
+                         s_scr, *, mxu_dtype):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        s_scr[...] = s0_ref[...]
+
+    s = s_scr[...]                                   # [Bb, D] f32
+    f32 = jnp.float32
+    # --- additive_attention_scores (mirrors _fwd_step) ---
+    q = jnp.dot(s.astype(mxu_dtype), attw_ref[...],
+                preferred_element_type=f32)          # [Bb, A]
+    encP = encP_ref[...]                             # [Bb, S, A] cd
+    pre = jnp.tanh(encP + q[:, None, :].astype(encP.dtype))
+    # score reduction on the VPU: Mosaic supports neither the
+    # [Bb,S,A]->[Bb*S,A] matvec route's output fold nor batched matvecs
+    scores = jnp.sum((pre * attv_ref[...][None]).astype(f32), axis=-1)
+    # --- attend ---
+    smask = smask_ref[...]                           # [Bb, S] f32
+    neg = jnp.finfo(f32).min
+    z = jnp.where(smask > 0, scores, neg)
+    w0 = jax.nn.softmax(z, axis=-1)
+    w1 = w0 * smask
+    n = jnp.maximum(jnp.sum(w1, axis=-1, keepdims=True), 1e-9)
+    w = w1 / n                                       # [Bb, S] f32
+    # batched matvec ctx[b] = w[b] @ enc[b] as a VPU broadcast-multiply +
+    # S-reduction: Mosaic lowers neither the [Bb,S]->[Bb,1,S] shape cast
+    # nor a dot_general with no lhs non-contracting dims
+    # (minor-dim insert must happen on the f32 array — Mosaic only supports
+    # non-no-op minor-dim insertion for 32-bit types)
+    ctx = jnp.sum((w[:, :, None].astype(mxu_dtype)
+                   * enc_ref[...]).astype(f32), axis=1)     # [Bb, 2H]
+    # --- input projection + gru_step ---
+    D = s.shape[-1]
+    xp = xp_y_ref[0] + jnp.dot(ctx.astype(mxu_dtype), wxc_ref[...],
+                               preferred_element_type=f32)      # [Bb, 3D]
+    zr = xp[:, : 2 * D] + jnp.dot(s.astype(mxu_dtype), wh_ref[:, : 2 * D],
+                                  preferred_element_type=f32)
+    r = jax.nn.sigmoid(zr[:, :D])
+    u = jax.nn.sigmoid(zr[:, D:])
+    cand = jnp.tanh(xp[:, 2 * D:]
+                    + jnp.dot((r * s).astype(mxu_dtype), wh_ref[:, 2 * D:],
+                              preferred_element_type=f32))
+    s_new = u * s + (1.0 - u) * cand
+    m = m_ref[0]                                     # [Bb, 1]
+    s_out = jnp.where(m > 0, s_new, s)
+    s_scr[...] = s_out
+    out_ref[0] = s_out * m
+    probs_ref[0] = w
+    ctx_ref[0] = ctx.astype(ctx_ref.dtype)
+    sprev_ref[0] = s
+
+
+def attn_dec_fwd_pallas(xp_y_tb, m_tb, s0, enc, enc_proj, src_mask,
+                        att_w, att_v, wx_c, wh, *, block_b):
+    """TIME-MAJOR forward: xp_y [T,B,3D] f32 (teacher-forced half of the
+    input projection, bias included), m [T,B] f32, s0 [B,D] f32; enc/
+    enc_proj/att_w/att_v/wx_c/wh pre-cast to the compute dtype by the
+    caller.  Returns (states [T,B,D] f32, probs [T,B,S] f32, ctx [T,B,2H]
+    enc.dtype, s_prev [T,B,D] f32) — identical layout/semantics to
+    attention_decoder._decoder_fwd_scan's stacked scan outputs."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    T, B, D3 = xp_y_tb.shape
+    D = D3 // 3
+    S, H2 = enc.shape[1], enc.shape[2]
+    A = enc_proj.shape[2]
+    nB = B // block_b
+    Bb = block_b
+    kernel = functools.partial(_attn_dec_fwd_kernel,
+                               mxu_dtype=compute_dtype())
+    step = lambda b, t: (t, b, 0)
+    blk = lambda b, t: (b, 0, 0)
+    blk2 = lambda b, t: (b, 0)
+    const = lambda b, t: (0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(nB, T),
+        in_specs=[
+            pl.BlockSpec((1, Bb, D3), step),         # xp_y
+            pl.BlockSpec((1, Bb, 1), step),          # mask col
+            pl.BlockSpec((Bb, D), blk2),             # s0
+            pl.BlockSpec((Bb, S, A), blk),           # enc_proj (resident)
+            pl.BlockSpec((Bb, S, H2), blk),          # enc (resident)
+            pl.BlockSpec((Bb, S), blk2),             # src_mask
+            pl.BlockSpec((D, A), const),             # att_w
+            pl.BlockSpec((1, A), const),             # att_v row
+            pl.BlockSpec((H2, D3), const),           # wx_c
+            pl.BlockSpec((D, D3), const),            # wh
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Bb, D), step),
+            pl.BlockSpec((1, Bb, S), step),
+            pl.BlockSpec((1, Bb, H2), step),
+            pl.BlockSpec((1, Bb, D), step),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, D), jnp.float32),   # states (masked)
+            jax.ShapeDtypeStruct((T, B, S), jnp.float32),   # attention probs
+            jax.ShapeDtypeStruct((T, B, H2), enc.dtype),    # ctx residual
+            jax.ShapeDtypeStruct((T, B, D), jnp.float32),   # s_prev residual
+        ],
+        scratch_shapes=[pltpu.VMEM((Bb, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(xp_y_tb, m_tb[..., None], s0, enc_proj, enc, src_mask,
+      att_w, att_v.reshape(1, A), wx_c, wh)
+
+
+def _attn_dec_bwd_kernel(dout_ref, m_ref, sp_ref, r_ref, u_ref, cand_ref,
+                         q_ref, encP_ref, enc_ref, smask_ref,
+                         attwT_ref, attv_ref, attvf_ref,
+                         whTzr_ref, whTc_ref, wxcT_ref,
+                         dxp_ref, sumdpre_ref, dencP_ref, dv_ref, ds0_ref,
+                         ds_scr, *, mxu_dtype):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    T = pl.num_programs(1)
+    f32 = jnp.float32
+
+    @pl.when(t == 0)  # first grid step == LAST timestep: zero cotangent seed
+    def _init():
+        ds_scr[...] = jnp.zeros_like(ds_scr)
+        dencP_ref[...] = jnp.zeros_like(dencP_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    d_s = ds_scr[...]                                # [Bb, D]
+    m = m_ref[0]                                     # [Bb, 1]
+    mcol = (m > 0).astype(f32)
+    d_snew = mcol * (dout_ref[0] + d_s)
+    sp = sp_ref[0]                                   # [Bb, D] f32
+    r = r_ref[0]
+    u = u_ref[0]
+    cand = cand_ref[0]
+
+    # ---- GRU backward (gates precomputed outside, streamed in) ----
+    d_u = d_snew * (sp - cand)
+    d_cand = d_snew * (1.0 - u)
+    d_h = d_snew * u
+    d_zc = d_cand * (1.0 - cand * cand)
+    d_rh = jnp.dot(d_zc, whTc_ref[...], preferred_element_type=f32)
+    d_r = d_rh * sp
+    d_h = d_h + d_rh * r
+    d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
+    d_h = d_h + jnp.dot(d_zr, whTzr_ref[...], preferred_element_type=f32)
+    d_xp = jnp.concatenate([d_zr, d_zc], -1)         # [Bb, 3D]
+    d_ctx = jnp.dot(d_xp, wxcT_ref[...], preferred_element_type=f32)
+
+    # ---- attention backward (mirrors _agd_bwd.rev_step) ----
+    enc = enc_ref[...]                               # [Bb, S, 2H] cd
+    # batched matvec d_w[b,s] = d_ctx[b] . enc[b,s] on the VPU (see the
+    # forward kernel's ctx note)
+    d_w = jnp.sum((d_ctx[:, None, :].astype(enc.dtype) * enc).astype(f32),
+                  axis=-1)                           # [Bb, S]
+    encP = encP_ref[...]
+    q = q_ref[0]                                     # [Bb, A] f32
+    pre = jnp.tanh(encP + q[:, None, :].astype(encP.dtype))
+    scores = jnp.sum((pre * attv_ref[...][None]).astype(f32), axis=-1)
+    smask = smask_ref[...]
+    maskb = smask > 0
+    neg = jnp.finfo(f32).min
+    z = jnp.where(maskb, scores, neg)
+    w0 = jax.nn.softmax(z, axis=-1)
+    w1 = w0 * smask
+    n = jnp.maximum(jnp.sum(w1, axis=-1, keepdims=True), 1e-9)
+    d_w1 = d_w / n
+    d_n = -jnp.sum(d_w * w1, axis=-1, keepdims=True) / (n * n)
+    d_w1 = d_w1 + d_n * (jnp.sum(w1, -1, keepdims=True) > 1e-9).astype(f32)
+    d_w0 = d_w1 * smask
+    d_z = w0 * (d_w0 - jnp.sum(w0 * d_w0, axis=-1, keepdims=True))
+    d_scores = jnp.where(maskb, d_z, 0.0)
+    pre_f = pre.astype(f32)
+    d_pre = (1.0 - pre_f * pre_f) * (d_scores[..., None] * attvf_ref[0])
+    dencP_ref[...] += d_pre                          # VMEM-resident accum
+    sum_dpre = jnp.sum(d_pre, axis=1)                # [Bb, A]
+    d_h = d_h + jnp.dot(sum_dpre, attwT_ref[...], preferred_element_type=f32)
+    # d_v block is [1, 8, A] (8 sublane rows purely for Mosaic tiling; only
+    # row 0 carries data — the wrapper sums row 0 over blocks).  VPU
+    # broadcast-reduce: Mosaic can't fold [Bb,S] into lanes for a matvec.
+    dv_ref[0, 0:1, :] += jnp.sum(d_scores[:, :, None] * pre_f,
+                                 axis=(0, 1))[None, :]
+
+    ds_scr[...] = (1.0 - mcol) * d_s + d_h
+    dxp_ref[0] = d_xp
+    sumdpre_ref[0] = sum_dpre
+
+    @pl.when(t == T - 1)  # last grid step == timestep 0
+    def _fin():
+        ds0_ref[...] = ds_scr[...]
+
+
+def attn_dec_bwd_pallas(dout_tb, m_tb, sp_tb, r_tb, u_tb, cand_tb, q_tb,
+                        enc, enc_proj, src_mask,
+                        att_w_f, att_v_cd, att_v_f, wh_f, wx_c_f, *,
+                        block_b):
+    """TIME-MAJOR reverse pass.  dout/sp/r/u/cand [T,B,D] f32, q [T,B,A]
+    f32, m [T,B] f32; enc/enc_proj compute dtype; *_f weights f32.
+    Returns (d_xp [T,B,3D] f32, sum_dpre [T,B,A] f32, d_encP [B,S,A] f32,
+    d_v [A] f32, d_s0 [B,D] f32) — the exact quantities _agd_bwd's reverse
+    scan produces; every weight gradient is reconstructed outside from
+    these (one batched MXU contraction each)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    T, B, D = dout_tb.shape
+    S, H2 = enc.shape[1], enc.shape[2]
+    A = enc_proj.shape[2]
+    nB = B // block_b
+    Bb = block_b
+    kernel = functools.partial(_attn_dec_bwd_kernel,
+                               mxu_dtype=compute_dtype())
+    rev = lambda b, t: (T - 1 - t, b, 0)
+    blk = lambda b, t: (b, 0, 0)
+    blk2 = lambda b, t: (b, 0)
+    const = lambda b, t: (0, 0)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nB, T),
+        in_specs=[
+            pl.BlockSpec((1, Bb, D), rev),           # d_out
+            pl.BlockSpec((1, Bb, 1), rev),           # mask col
+            pl.BlockSpec((1, Bb, D), rev),           # s_prev
+            pl.BlockSpec((1, Bb, D), rev),           # r
+            pl.BlockSpec((1, Bb, D), rev),           # u
+            pl.BlockSpec((1, Bb, D), rev),           # cand
+            pl.BlockSpec((1, Bb, A), rev),           # q
+            pl.BlockSpec((Bb, S, A), blk),           # enc_proj (resident)
+            pl.BlockSpec((Bb, S, H2), blk),          # enc (resident)
+            pl.BlockSpec((Bb, S), blk2),             # src_mask
+            pl.BlockSpec((A, D), const),             # att_w^T f32
+            pl.BlockSpec((1, A), const),             # att_v cd row
+            pl.BlockSpec((1, A), const),             # att_v f32 row
+            pl.BlockSpec((2 * D, D), const),         # wh[:, :2D]^T f32
+            pl.BlockSpec((D, D), const),             # wh[:, 2D:]^T f32
+            pl.BlockSpec((3 * D, H2), const),        # wx_c^T f32
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Bb, 3 * D), rev),
+            pl.BlockSpec((1, Bb, A), rev),
+            pl.BlockSpec((Bb, S, A), blk),           # d_encP (resident accum)
+            pl.BlockSpec((1, 8, A), blk),            # d_v per block (row 0)
+            pl.BlockSpec((Bb, D), blk2),             # d_s0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, 3 * D), jnp.float32),
+            jax.ShapeDtypeStruct((T, B, A), jnp.float32),
+            jax.ShapeDtypeStruct((B, S, A), jnp.float32),
+            jax.ShapeDtypeStruct((nB, 8, A), jnp.float32),
+            jax.ShapeDtypeStruct((B, D), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((Bb, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(dout_tb, m_tb[..., None], sp_tb, r_tb, u_tb, cand_tb, q_tb,
+      enc_proj, enc, src_mask,
+      jnp.transpose(att_w_f), att_v_cd.reshape(1, A),
+      att_v_f.reshape(1, A),
+      jnp.transpose(wh_f[:, : 2 * D]), jnp.transpose(wh_f[:, 2 * D:]),
+      jnp.transpose(wx_c_f))
+    d_xp_tb, sum_dpre_tb, d_encP, d_v_blocks, d_s0 = outs
+    return (d_xp_tb, sum_dpre_tb, d_encP,
+            jnp.sum(d_v_blocks[:, 0, :], axis=0), d_s0)

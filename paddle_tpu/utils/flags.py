@@ -192,6 +192,9 @@ define_flag("max_gen_length", 100, "max generated sequence length")
 # row in BENCH_r*.json as informational; the seq2seq headline is decisive.
 # Gate: ops/rnn.py:_use_pallas_rnn; non-tile-aligned shapes always use scan.
 define_flag("use_pallas_rnn", True, "use fused Pallas LSTM/GRU time-loop kernels on TPU")
+# Gate: ops/attention_decoder.py:_attn_pallas_block (VMEM-resident decoder)
+define_flag("use_pallas_attention", True,
+            "use the VMEM-resident Pallas attention-decoder kernels on TPU")
 
 # Numeric traps — the feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)
 # analog (reference: paddle/trainer/TrainerMain.cpp:49 installs FP traps for

@@ -40,9 +40,9 @@ def feeder_kind_for_layer(layer) -> str:
         return t.feeder_kind
     spec = layer.data_spec or {}
     if spec.get("sparse") == "binary":
-        return "sparse_ids"
+        return "sparse_ids_seq" if spec.get("is_seq") else "sparse_ids"
     if spec.get("sparse") == "float":
-        return "sparse_pairs"
+        return "sparse_pairs_seq" if spec.get("is_seq") else "sparse_pairs"
     is_int = spec.get("dtype") == "int32"
     if spec.get("nested"):
         return "ids_nested" if is_int else "dense_nested"
@@ -85,6 +85,8 @@ class DataFeeder:
                 feed[name] = self._pad_seq(col, kind)
             elif kind in ("sparse_ids", "sparse_pairs"):
                 feed[name] = self._pad_sparse(col, kind)
+            elif kind in ("sparse_ids_seq", "sparse_pairs_seq"):
+                feed[name] = self._pad_sparse_seq(col, kind)
             elif kind in ("ids_nested", "dense_nested"):
                 feed[name] = self._pad_nested(col, kind)
             else:
@@ -150,6 +152,41 @@ class DataFeeder:
                 ids[i, j] = idx
                 weights[i, j] = w
         return ids, weights, nnz
+
+    def _pad_sparse_seq(self, col: List, kind: str):
+        """Sparse *sequence* rows (one sparse bag per timestep, the
+        reference's sparse_*_vector_sequence input types) -> padded
+        (ids [B,T,N], nnz [B,T], lengths [B]) for 'sparse_ids_seq', with an
+        extra weights [B,T,N] slot before nnz for 'sparse_pairs_seq'.  T and
+        N are bucketed like sequence lengths."""
+        lengths = np.asarray([len(s) for s in col], np.int32)
+        T = max(int(lengths.max()) if len(lengths) else 1, 1)
+        n_max = max((len(bag) for row in col for bag in row), default=1)
+        if self.max_len:
+            T = min(T, self.max_len)
+            lengths = np.minimum(lengths, self.max_len)
+            n_max = min(max(n_max, 1), self.max_len)
+        T = bucket_length(T, self.buckets)
+        N = bucket_length(max(n_max, 1), self.buckets)
+        B = len(col)
+        ids = np.zeros((B, T, N), np.int32)
+        nnz = np.zeros((B, T), np.int32)
+        if kind == "sparse_ids_seq":
+            for i, row in enumerate(col):
+                for t, bag in enumerate(list(row)[: lengths[i]]):
+                    bag = list(bag)[:N]
+                    ids[i, t, : len(bag)] = bag
+                    nnz[i, t] = len(bag)
+            return ids, nnz, lengths
+        weights = np.zeros((B, T, N), self.dtype)
+        for i, row in enumerate(col):
+            for t, bag in enumerate(list(row)[: lengths[i]]):
+                bag = list(bag)[:N]
+                for j, (idx, w) in enumerate(bag):
+                    ids[i, t, j] = idx
+                    weights[i, t, j] = w
+                nnz[i, t] = len(bag)
+        return ids, weights, nnz, lengths
 
     def _pad_seq(self, col: List, kind: str) -> Tuple[np.ndarray, np.ndarray]:
         lengths = np.asarray([len(s) for s in col], np.int32)

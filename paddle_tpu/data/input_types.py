@@ -17,6 +17,8 @@ __all__ = [
     "dense_vector_sub_sequence",
     "sparse_binary_vector",
     "sparse_float_vector",
+    "sparse_binary_vector_sequence",
+    "sparse_float_vector_sequence",
 ]
 
 
@@ -35,9 +37,9 @@ class InputType:
         if self.kind == "int":
             return "ids_seq" if self.seq else "int"
         if self.kind == "sparse_binary":
-            return "sparse_ids"
+            return "sparse_ids_seq" if self.seq else "sparse_ids"
         if self.kind == "sparse_float":
-            return "sparse_pairs"
+            return "sparse_pairs_seq" if self.seq else "sparse_pairs"
         return "dense_seq" if self.seq else "dense"
 
 
@@ -77,3 +79,16 @@ def sparse_float_vector(dim: int) -> InputType:
     """Rows are (id, weight) pair lists; fed as padded COO
     (ids, weights, nnz)."""
     return InputType(dim, False, "sparse_float")
+
+
+def sparse_binary_vector_sequence(dim: int) -> InputType:
+    """Rows are sequences of id lists (one bag per timestep); fed as
+    (ids [B,T,N], nnz [B,T], lengths [B]) — the reference's
+    sparse_binary_vector_sequence (PyDataProvider2.py:75-145)."""
+    return InputType(dim, True, "sparse_binary")
+
+
+def sparse_float_vector_sequence(dim: int) -> InputType:
+    """Rows are sequences of (id, weight) pair lists; fed as
+    (ids [B,T,N], weights [B,T,N], nnz [B,T], lengths [B])."""
+    return InputType(dim, True, "sparse_float")

@@ -14,10 +14,12 @@ layers and let the generator yield dicts), ``init_hook(settings, file_list,
 **kwargs)`` with a free-attribute ``settings`` object, ``should_shuffle`` +
 ``pool_size`` (buffered-pool shuffle), ``cache=CacheType.CACHE_PASS_IN_MEM``
 (first pass materialized, later passes replay), ``check`` (light per-slot
-validation, ``check_fail_continue`` to skip bad rows).  ``calc_batch_size``
-and ``can_over_batch_size`` are accepted and recorded but batching here is
-row-based (``data.batch``) — a warning is logged if a custom
-``calc_batch_size`` is supplied.
+validation, ``check_fail_continue`` to skip bad rows), ``calc_batch_size`` +
+``can_over_batch_size`` (cost-based batch assembly via
+``DataProvider.batch_reader`` — the PyDataProvider2.cpp:565-586 semantics),
+and sparse SEQUENCE slots (``sparse_*_vector_sequence`` / ``seq_type=
+SequenceType.SEQUENCE``).  Sparse SUB-sequence slots are the one un-mapped
+corner (no repo layer consumes nested sparse; the ctors raise).
 """
 
 from __future__ import annotations
@@ -37,6 +39,7 @@ __all__ = [
     "dense_vector", "dense_vector_sequence", "dense_vector_sub_sequence",
     "integer_value", "integer_value_sequence", "integer_value_sub_sequence",
     "integer_sequence", "sparse_binary_vector", "sparse_float_vector",
+    "sparse_binary_vector_sequence", "sparse_float_vector_sequence",
     "dense_slot", "index_slot", "sparse_non_value_slot", "sparse_value_slot",
 ]
 
@@ -74,14 +77,21 @@ def index_slot(value_range, seq_type=SequenceType.NO_SEQUENCE):
 
 
 def sparse_non_value_slot(dim, seq_type=SequenceType.NO_SEQUENCE):
-    if seq_type != SequenceType.NO_SEQUENCE:
-        raise ConfigError("sparse sequence slots are not supported")
+    if seq_type == SequenceType.SUB_SEQUENCE:
+        raise ConfigError("sparse sub-sequence slots are not supported "
+                          "(reference PyDataProvider2.py:75-145 defines "
+                          "them; no repo layer consumes nested sparse)")
+    if seq_type == SequenceType.SEQUENCE:
+        return _it.sparse_binary_vector_sequence(dim)
     return _it.sparse_binary_vector(dim)
 
 
 def sparse_value_slot(dim, seq_type=SequenceType.NO_SEQUENCE):
-    if seq_type != SequenceType.NO_SEQUENCE:
-        raise ConfigError("sparse sequence slots are not supported")
+    if seq_type == SequenceType.SUB_SEQUENCE:
+        raise ConfigError("sparse sub-sequence slots are not supported "
+                          "(see sparse_non_value_slot)")
+    if seq_type == SequenceType.SEQUENCE:
+        return _it.sparse_float_vector_sequence(dim)
     return _it.sparse_float_vector(dim)
 
 
@@ -89,6 +99,8 @@ dense_vector = dense_slot
 integer_value = index_slot
 sparse_binary_vector = sparse_non_value_slot
 sparse_float_vector = sparse_value_slot
+sparse_binary_vector_sequence = _it.sparse_binary_vector_sequence
+sparse_float_vector_sequence = _it.sparse_float_vector_sequence
 
 
 def dense_vector_sequence(dim):
@@ -151,6 +163,8 @@ class DataProvider:
         self.cache = cache
         self.check = check
         self.check_fail_continue = check_fail_continue
+        self.calc_batch_size: Optional[Callable] = None
+        self.can_over_batch_size = True
         self._cached_rows: Optional[List[tuple]] = None
 
     # -- rows ----------------------------------------------------------
@@ -214,6 +228,38 @@ class DataProvider:
                            for n, it in zip(self.slot_names,
                                             self.input_types)})
 
+    def batch_reader(self, batch_size: int, *, drop_last: bool = False):
+        """Reader creator yielding BATCHES (lists of rows) assembled by
+        sample cost — the reference's calc_batch_size semantics
+        (PyDataProvider2.cpp:565-586): each row contributes
+        ``calc_batch_size(row)`` units (1 when unset), a batch closes once
+        the accumulated units reach ``batch_size``, and with
+        ``can_over_batch_size=False`` a row that would overshoot is
+        deferred to the next batch instead of included.  A single row
+        costing more than ``batch_size`` still forms its own batch (the
+        reference would otherwise stall the pool)."""
+
+        def read():
+            buf: List[tuple] = []
+            bsize = 0
+            for row in self.reader()():
+                cost = (int(self.calc_batch_size(row))
+                        if self.calc_batch_size else 1)
+                if (buf and not self.can_over_batch_size
+                        and bsize + cost > batch_size):
+                    yield buf
+                    buf, bsize = [row], cost
+                else:
+                    buf.append(row)
+                    bsize += cost
+                if bsize >= batch_size:
+                    yield buf
+                    buf, bsize = [], 0
+            if buf and not drop_last:
+                yield buf
+
+        return read
+
 
 def provider(input_types=None, should_shuffle=None, pool_size=-1,
              min_pool_size=-1, can_over_batch_size=True,
@@ -245,9 +291,12 @@ def provider(input_types=None, should_shuffle=None, pool_size=-1,
                 names = [f"slot{i}" for i in range(len(types))]
             settings.input_types = types
             if calc_batch_size is not None:
-                logger.warning(
-                    "provider: calc_batch_size is recorded but batching in "
-                    "this framework is row-based (data.batch)")
+                # cost-based assembly lives in DataProvider.batch_reader;
+                # the plain data.batch(dp.reader(), n) path counts rows
+                logger.info(
+                    "provider: calc_batch_size supplied — batch via "
+                    "dp.batch_reader(size) to honor it (data.batch is "
+                    "row-based)")
             shuffle = (should_shuffle if should_shuffle is not None
                        else kwargs.get("is_train", True))
             dp = DataProvider(

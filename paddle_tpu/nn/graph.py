@@ -412,6 +412,28 @@ def _coerce_feed(layer: LayerOutput, feed: Dict[str, Any]) -> Act:
         raise ConfigError(f"missing feed for data layer {layer.name!r}")
     v = feed[layer.name]
     sparse = (layer.data_spec or {}).get("sparse")
+    if sparse and (layer.data_spec or {}).get("is_seq") and not isinstance(v, Act):
+        # sparse SEQUENCE slots (one bag per timestep): (ids [B,T,N],
+        # nnz [B,T], lengths [B]) for binary, + weights [B,T,N] before nnz
+        # for float — reference sparse_*_vector_sequence
+        # (python/paddle/trainer/PyDataProvider2.py:75-145)
+        if not isinstance(v, tuple) or len(v) not in (3, 4):
+            raise ConfigError(
+                f"sparse sequence data layer {layer.name!r} expects "
+                f"(ids, nnz, lengths) or (ids, weights, nnz, lengths), got "
+                f"{type(v).__name__} of len "
+                f"{len(v) if isinstance(v, tuple) else '?'}")
+        ids = jnp.asarray(v[0])
+        nnz = jnp.asarray(v[-2])
+        lengths = jnp.asarray(v[-1])
+        valid = (jnp.arange(ids.shape[-1])[None, None, :]
+                 < nnz[:, :, None]).astype(jnp.float32)
+        weights = jnp.asarray(v[1]) if len(v) == 4 else valid
+        from paddle_tpu.ops.sequence import mask_from_lengths
+
+        return Act(value=ids, lengths=lengths,
+                   mask=mask_from_lengths(lengths, ids.shape[1]),
+                   state={"weights": weights, "nnz_mask": valid})
     if sparse and not isinstance(v, Act):
         # padded COO rows: (ids, nnz) for binary, (ids, weights, nnz) for float
         if not isinstance(v, tuple) or len(v) not in (2, 3):

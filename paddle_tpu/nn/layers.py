@@ -194,9 +194,12 @@ def fc(input: Union[LayerOutput, Sequence[LayerOutput]], size: int, *,
         for spec, a, sparse in zip(specs[: len(inputs)], acts, sparse_kinds):
             if sparse:
                 # bag-of-features input: gather rows + weighted sum, the
-                # hl_sparse csr_mul_dense analog (ops/sparse.py)
-                y = O.sparse_gather_matmul(a.value, a.state["weights"],
-                                           a.mask, params[spec.name])
+                # hl_sparse csr_mul_dense analog (ops/sparse.py).  Sparse
+                # SEQUENCES carry the per-slot validity in state (Act.mask
+                # is the [B,T] sequence mask there)
+                y = O.sparse_gather_matmul(
+                    a.value, a.state["weights"],
+                    a.state.get("nnz_mask", a.mask), params[spec.name])
                 out = y if out is None else out + y
                 continue
             v = a.value

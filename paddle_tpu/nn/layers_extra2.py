@@ -389,8 +389,11 @@ def _selective_fc_sparse_input(inputs, select, size, *, act, name, param_attr,
         y = None
         for spec, a, sparse in zip(wspecs, acts[:-1], sparse_kinds):
             if sparse:
-                z = O.sparse_gather_matmul(a.value, a.state["weights"], a.mask,
-                                           params[spec.name])
+                # sparse sequences carry per-slot validity in state
+                # (Act.mask is the [B,T] sequence mask there) — see fc
+                z = O.sparse_gather_matmul(
+                    a.value, a.state["weights"],
+                    a.state.get("nnz_mask", a.mask), params[spec.name])
             else:
                 z = O.linear(a.value, params[spec.name])
             y = z if y is None else y + z

@@ -45,16 +45,17 @@ __all__ = [
 
 
 def sparse_gather_matmul(ids, weights, mask, w, b=None):
-    """Padded-sparse [B, N] × dense [V, D] -> [B, D].
+    """Padded-sparse [..., N] × dense [V, D] -> [..., D].
 
     ``out[b] = sum_n weights[b,n] * w[ids[b,n]]`` over valid n — the
     hl_sparse csr_mul_dense analog.  Invalid (padding) slots must be
-    masked: their ids may be arbitrary in-range values.
+    masked: their ids may be arbitrary in-range values.  Leading dims are
+    free: sparse sequences pass ids [B, T, N] and get [B, T, D].
     """
-    rows = jnp.take(w, ids, axis=0)                      # [B, N, D]
+    rows = jnp.take(w, ids, axis=0)                      # [..., N, D]
     coef = (weights * mask).astype(rows.dtype)
     rows, coef = mxu_cast(rows, coef)
-    out = jnp.einsum("bnd,bn->bd", rows, coef).astype(acc_dtype())
+    out = jnp.einsum("...nd,...n->...d", rows, coef).astype(acc_dtype())
     if b is not None:
         out = out + b.astype(out.dtype)
     return out

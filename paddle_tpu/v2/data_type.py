@@ -10,7 +10,9 @@ from paddle_tpu.data.input_types import (  # noqa: F401
     integer_value_sequence,
     integer_value_sub_sequence,
     sparse_binary_vector,
+    sparse_binary_vector_sequence,
     sparse_float_vector,
+    sparse_float_vector_sequence,
 )
 
 __all__ = [
@@ -22,5 +24,7 @@ __all__ = [
     "integer_value_sub_sequence",
     "dense_vector_sub_sequence",
     "sparse_binary_vector",
+    "sparse_binary_vector_sequence",
     "sparse_float_vector",
+    "sparse_float_vector_sequence",
 ]

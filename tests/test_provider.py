@@ -134,3 +134,110 @@ def test_shuffle_pool_and_check(tmp_path):
 
     with pytest.raises(ConfigError):
         missing([f])
+
+
+def test_calc_batch_size_cost_based_batching(tmp_path):
+    """Reference PyDataProvider2.cpp:565-586 semantics: rows contribute
+    calc_batch_size(row) units; can_over_batch_size picks include-vs-defer
+    for the overshooting row."""
+    f = _write_file(tmp_path, "cb.txt", ["x"])
+    costs = {0: 3, 1: 3, 2: 5, 3: 2, 4: 7, 5: 1}
+
+    def make(can_over):
+        @provider(input_types=[integer_value(10)], should_shuffle=False,
+                  calc_batch_size=lambda row: costs[row[0]],
+                  can_over_batch_size=can_over)
+        def process(settings, filename):
+            yield from range(6)
+
+        return process([f])
+
+    # budget 6, can_over: 0(3)+1(3)=6 -> close; 2(5)+3(2)=7 > 6 but
+    # included -> close; 4(7) alone overshoots -> close; 5(1) tail
+    over = [[r[0] for r in b] for b in make(True).batch_reader(6)()]
+    assert over == [[0, 1], [2, 3], [4], [5]]
+    # no-over: 2(5)+3(2) overshoots -> 3 deferred; 3(2)+4(7) overshoots ->
+    # 4 deferred into its own (oversized-single) batch
+    no_over = [[r[0] for r in b] for b in make(False).batch_reader(6)()]
+    assert no_over == [[0, 1], [2], [3], [4], [5]]
+    # without calc_batch_size batch_reader degrades to row counting
+    dp = make(True)
+    dp.calc_batch_size = None
+    assert [len(b) for b in dp.batch_reader(4)()] == [4, 2]
+
+
+def test_sparse_sequence_slots_train(tmp_path):
+    """sparse_binary_vector_sequence end-to-end: provider -> feeder ->
+    fc-over-sparse-sequence == fc over the densified per-step input."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.data.provider import (SequenceType,
+                                          sparse_non_value_slot)
+
+    f = _write_file(tmp_path, "ss.txt", ["x"])
+    DIM = 12
+    seqs = [[[1, 3], [2], [5, 7, 9]],
+            [[0], [11, 4]]]
+
+    @provider(input_types=[sparse_non_value_slot(
+        DIM, seq_type=SequenceType.SEQUENCE), integer_value(2)],
+        should_shuffle=False)
+    def process(settings, filename):
+        for i, s in enumerate(seqs):
+            yield s, i % 2
+
+    dp = process([f])
+    assert dp.feeder().types["slot0"] == "sparse_ids_seq"
+    batch = list(dp.reader()())
+    feed = dp.feeder()(batch)
+    ids, nnz, lengths = feed["slot0"]
+    assert ids.shape[0] == 2 and nnz.shape == ids.shape[:2]
+    assert list(lengths) == [3, 2]
+
+    nn.reset_naming()
+    bags = nn.data("slot0", size=DIM, is_seq=True, sparse="binary",
+                   dtype="int32")
+    label = nn.data("label", size=1, dtype="int32")
+    h = nn.fc(bags, 6, act="relu")
+    pool = nn.pooling(h, pooling_type="max")
+    cost = nn.classification_cost(nn.fc(pool, 2, act="linear"), label)
+    tr = SGDTrainer(cost, Adam(learning_rate=0.1), seed=0)
+    loss = float(tr.train_batch({"slot0": feed["slot0"],
+                                 "label": np.asarray([[0], [1]])}))
+    assert np.isfinite(loss)
+
+    # value check: fc output over the sparse seq == fc over densified input
+    from paddle_tpu.nn import Topology
+    import jax
+
+    nn.reset_naming()
+    bags2 = nn.data("slot0", size=DIM, is_seq=True, sparse="binary",
+                    dtype="int32")
+    out = nn.fc(bags2, 6, act="linear", bias_attr=False, name="probe")
+    topo = Topology(out)
+    params, state = topo.init(jax.random.PRNGKey(0))
+    outs, _ = topo.apply(params, state, {"slot0": feed["slot0"]})
+    y = np.asarray(outs["probe"].value)
+    w = np.asarray(params["_probe.w0"])
+    dense = np.zeros((2, ids.shape[1], DIM), np.float32)
+    for b, row in enumerate(seqs):
+        for t, bag in enumerate(row):
+            for j in bag:
+                dense[b, t, j] = 1.0
+    want = dense @ w
+    # padded timesteps are masked to zero by the fc's sequence handling
+    for b, L in enumerate([3, 2]):
+        np.testing.assert_allclose(y[b, :L], want[b, :L], rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(y[b, L:], 0.0, atol=1e-6)
+
+
+def test_sparse_sub_sequence_slot_raises():
+    from paddle_tpu.data.provider import (SequenceType,
+                                          sparse_non_value_slot,
+                                          sparse_value_slot)
+
+    with pytest.raises(ConfigError):
+        sparse_non_value_slot(8, seq_type=SequenceType.SUB_SEQUENCE)
+    with pytest.raises(ConfigError):
+        sparse_value_slot(8, seq_type=SequenceType.SUB_SEQUENCE)

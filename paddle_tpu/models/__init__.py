@@ -1,5 +1,6 @@
 from paddle_tpu.models.vision import lenet5, smallnet, resnet_cifar, vgg_cifar
-from paddle_tpu.models.text import stacked_lstm_net, convolution_net, lstm_benchmark_net
+from paddle_tpu.models.text import (stacked_lstm_net, stacked_lstm_pp_net,
+                                    convolution_net, lstm_benchmark_net)
 from paddle_tpu.models.seq2seq import Seq2SeqAttention
 from paddle_tpu.models.recommender import movielens_net, movielens_feature_net
 from paddle_tpu.models.image_bench import alexnet, googlenet

@@ -39,6 +39,35 @@ def stacked_lstm_net(vocab_size: int, *, emb_dim: int = 128, hid_dim: int = 512,
     return cost, logits
 
 
+def stacked_lstm_pp_net(vocab_size: int, *, emb_dim: int = 128,
+                        hid_dim: int = 512, n_stages: int = 4,
+                        num_classes: int = 2):
+    """Pipeline-partitionable stacked LSTM text classifier: ``n_stages``
+    IDENTICAL [fc -> lstmemory] blocks, each tagged ``pp:<k>`` so
+    ``SGDTrainer(cost, mesh=mesh, pipeline=dict(n_microbatches=M))`` runs
+    them as GPipe stages (parallel/pipeline_dsl.py).
+
+    Differs from ``stacked_lstm_net`` (the demo/sentiment config) in two
+    deliberate ways required by stage homogeneity: blocks are uniform
+    direction (no ``reverse`` alternation — an invisible-flag difference
+    stages must not have) and each block consumes only the previous LSTM's
+    output (single seam activation).  Returns (cost, logits)."""
+    from paddle_tpu.parallel.pipeline_dsl import pp_stage
+
+    words = nn.data("words", size=vocab_size, is_seq=True, dtype="int32")
+    label = nn.data("label", size=1, dtype="int32")
+    emb = nn.embedding(words, emb_dim, name="emb")
+    x = nn.fc(emb, hid_dim, act="linear", name="stem")
+    for k in range(n_stages):
+        f = pp_stage(nn.fc(x, hid_dim, act="linear", name=f"pp{k}_fc"), k)
+        x = pp_stage(nn.lstmemory(f, hid_dim, act="relu",
+                                  name=f"pp{k}_lstm"), k)
+    pool = nn.pooling(x, pooling_type="max", name="pool")
+    logits = nn.fc(pool, num_classes, act="linear", name="logits")
+    cost = nn.classification_cost(logits, label, name="cost")
+    return cost, logits
+
+
 def convolution_net(vocab_size: int, *, emb_dim: int = 128, hid_dim: int = 256,
                     context_len: int = 3, num_classes: int = 2):
     """Sequence conv + max-pool text classifier (sequence_conv_pool analog)."""

@@ -22,9 +22,16 @@ idiomatic jax.sharding feature instead.  The TPU-first design:
   dim sharded over ``data`` while weights shard over ``stage``.
 
 Constraints (by construction of the single-program schedule): all stages
-share one ``stage_fn`` with equal input/output activation shape (the
+share one ``stage_fn`` with equal input/output activation STRUCTURE (the
 canonical homogeneous-block pipeline — transformer blocks, residual MLPs,
 stacked RNN cells), and the microbatch count must divide the batch.
+Activations may be arbitrary pytrees (every leaf with a leading batch dim)
+— a sequence stage passes (value, mask, lengths) through the ppermute hops
+as one tree.  The fill/drain ticks additionally require stage_fn's VJP to
+be finite on a real microbatch (the carry is seeded with microbatch 0, not
+zeros — see _gpipe_local).  ``parallel/pipeline_dsl.py`` drives this from
+the ``nn`` DSL: ``device_pin`` stage tags partition a Topology into
+head -> homogeneous stages -> tail.
 """
 
 from __future__ import annotations
@@ -61,46 +68,68 @@ def _gpipe_local(stage_fn, w_stacked_local, x_mb, *, axis: str):
     """shard_map body: run the fill/drain schedule on this device's stage.
 
     ``w_stacked_local``: stage-stacked weights AFTER sharding — leading dim 1
-    (this stage's slice).  ``x_mb``: [M, mb, ...] microbatches (every stage
-    receives them; only stage 0 reads them).  Returns [M, mb, ...] outputs,
-    psum-replicated over the stage axis."""
+    (this stage's slice).  ``x_mb``: pytree of [M, mb, ...] microbatch
+    leaves (every stage receives them; only stage 0 reads them).  Returns
+    the same tree with [M, mb, ...] outputs, psum-replicated over the
+    stage axis."""
+    tmap = jax.tree_util.tree_map
     S = lax.axis_size(axis)
     sid = lax.axis_index(axis)
-    w_local = jax.tree_util.tree_map(lambda a: a[0], w_stacked_local)
-    M = x_mb.shape[0]
+    w_local = tmap(lambda a: a[0], w_stacked_local)
+    M = jax.tree_util.tree_leaves(x_mb)[0].shape[0]
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def tick(prev, t):
         # stage 0 ingests microbatch t (clamped: ticks >= M feed a dummy
         # whose products drain past the last stage unrecorded); later
         # stages consume what ppermute delivered last tick
-        x_in = jnp.where(sid == 0, x_mb[jnp.clip(t, 0, M - 1)], prev)
+        i = jnp.clip(t, 0, M - 1)
+        x_in = tmap(lambda full, p: jnp.where(sid == 0, full[i], p),
+                    x_mb, prev)
         y = stage_fn(w_local, x_in)
-        return lax.ppermute(y, axis, perm), y
+        return tmap(lambda a: lax.ppermute(a, axis, perm), y), y
 
-    _, ys = lax.scan(tick, jnp.zeros_like(x_mb[0]), jnp.arange(M + S - 1))
+    # seed the carry with a REAL microbatch, not zeros: fill/drain ticks run
+    # stage_fn (and, under grad, its VJP) on the carry with their output
+    # cotangents masked to zero — but a derivative singular at 0 (sqrt,
+    # x/||x||) makes inf intermediates and inf*0 = NaN would leak into the
+    # weight grads accumulated over all ticks (ADVICE r4)
+    _, ys = lax.scan(tick, tmap(lambda a: a[0], x_mb), jnp.arange(M + S - 1))
     # the last stage produced microbatch j at tick j + S - 1; replicate its
     # outputs across the stage axis (mask + psum — everyone else holds
     # intermediate activations, zeroed out here)
-    outs = jnp.where(sid == S - 1, ys[S - 1:], jnp.zeros_like(ys[S - 1:]))
-    return lax.psum(outs, axis)
+    return tmap(
+        lambda a: lax.psum(
+            jnp.where(sid == S - 1, a[S - 1:], jnp.zeros_like(a[S - 1:])),
+            axis),
+        ys)
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
-                   stacked_params, x: jax.Array, *, mesh: Mesh,
+def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
+                   stacked_params, x: Any, *, mesh: Mesh,
                    n_microbatches: int, stage_axis: str = "stage",
-                   data_axis: Optional[str] = None) -> jax.Array:
-    """Run ``x`` [B, ...] through the S-stage pipeline; returns [B, ...].
+                   data_axis: Optional[str] = None) -> Any:
+    """Run ``x`` (array or pytree whose leaves all lead with [B, ...])
+    through the S-stage pipeline; returns the stage output tree with [B]
+    leading each leaf.
 
     ``stage_fn(stage_params, x_mb) -> y_mb`` is one stage's forward on a
-    microbatch (equal in/out shapes).  ``stacked_params`` leaves carry the
-    leading [S] stage dim (see ``stack_stage_params``).  With ``data_axis``
-    the microbatch batch dim additionally shards over that mesh axis
-    (dp x pp).  Fully differentiable — wrap in jax.grad for training."""
-    B = x.shape[0]
+    microbatch (equal in/out STRUCTURE across stages).  ``stacked_params``
+    leaves carry the leading [S] stage dim (see ``stack_stage_params``).
+    With ``data_axis`` the microbatch batch dim additionally shards over
+    that mesh axis (dp x pp).  Fully differentiable — wrap in jax.grad for
+    training."""
+    tmap = jax.tree_util.tree_map
+    x_leaves = jax.tree_util.tree_leaves(x)
+    B = x_leaves[0].shape[0]
     M = n_microbatches
     if B % M:
         raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    for leaf in x_leaves:
+        if leaf.shape[0] != B:
+            raise ValueError(
+                f"every activation leaf must lead with the batch dim {B}; "
+                f"got shape {leaf.shape}")
     S = mesh.shape[stage_axis]
     leaves = jax.tree_util.tree_leaves(stacked_params)
     if leaves and leaves[0].shape[0] != S:
@@ -109,7 +138,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         raise ValueError(
             f"stacked_params carry {leaves[0].shape[0]} stages but mesh axis "
             f"{stage_axis!r} has {S} devices; they must be equal")
-    x_mb = x.reshape(M, B // M, *x.shape[1:])
+    x_mb = tmap(lambda a: a.reshape(M, B // M, *a.shape[1:]), x)
     mb_spec = P(None, data_axis) if data_axis else P()
     fn = functools.partial(_gpipe_local, stage_fn, axis=stage_axis)
     mapped = jax.shard_map(
@@ -119,7 +148,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         check_vma=False,
     )
     y_mb = mapped(stacked_params, x_mb)
-    return y_mb.reshape(B, *y_mb.shape[2:])
+    return tmap(lambda a: a.reshape(B, *a.shape[2:]), y_mb)
 
 
 def make_pipeline_train_step(

@@ -50,6 +50,7 @@ class SGDTrainer:
         averager: Optional[ParameterAverager] = None,
         device_specs: Optional[Dict[str, Any]] = None,
         sharding_rules=None,
+        pipeline: Optional[Dict[str, Any]] = None,
     ) -> None:
         # several costs train jointly (MultiNetwork analog,
         # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
@@ -61,7 +62,18 @@ class SGDTrainer:
             raise ValueError("cost_weights must match the number of costs")
         self.cost_name = costs[0].name
         self.extra_names = [e.name for e in extra_outputs]
-        self.topology = Topology([*costs, *extra_outputs])
+        if pipeline is not None:
+            # pp:<k> device_pin tags become GPipe stages over
+            # mesh[pipeline['stage_axis']] (parallel/pipeline_dsl.py);
+            # pipeline = dict(n_microbatches=..., stage_axis=..., data_axis=...)
+            from paddle_tpu.parallel.pipeline_dsl import PipelinedTopology
+
+            if mesh is None:
+                raise ValueError("pipeline training requires a mesh")
+            self.topology = PipelinedTopology([*costs, *extra_outputs],
+                                              mesh=mesh, **pipeline)
+        else:
+            self.topology = Topology([*costs, *extra_outputs])
         self.optimizer = optimizer or SGD(learning_rate=0.01)
         self.mesh = mesh
         self.data_axis = data_axis
@@ -171,8 +183,17 @@ class SGDTrainer:
 
         if self.sharding_rules is None:
             repl = NamedSharding(self.mesh, P())
-            return {k: repl for k in self.params}
-        return self.sharding_rules.shardings(self.mesh, self.params)
+            sh = {k: repl for k in self.params}
+        else:
+            sh = self.sharding_rules.shardings(self.mesh, self.params)
+        # pipeline-stacked stage params live sharded over the stage axis
+        # (each device holds exactly its stage's slice)
+        stage_names = getattr(self.topology, "stage_param_names", None)
+        if stage_names:
+            axis = self.topology.stage_axis
+            for name in stage_names:
+                sh[name] = NamedSharding(self.mesh, P(axis))
+        return sh
 
     def _place_sharded(self) -> None:
         """Place params at their rule shardings and every optimizer slot at

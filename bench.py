@@ -341,6 +341,10 @@ def bench_seq2seq_decode(rtt, peak):
         params, src, src_len = carry
         toks, scores = m.beam_search(params, src, src_len, beam_size=K,
                                      max_len=L)
+        # feed the decode back into the next iteration's source ids so XLA
+        # cannot hoist the loop-invariant decode out of the timing loop
+        # (it once did: a "0.012 ms" decode)
+        src = (src + toks[:, 0, :S]) % (m.src_vocab - 3) + 3
         return (params, src, src_len), scores.sum()
 
     sec, flops, (lo, hi) = _time_chain(one_step, (params, src, src_len),

@@ -1,0 +1,228 @@
+// Python-free inference host over the XLA CPU PJRT client.
+//
+// The reference deploys with a pure-C process over its C++ engine
+// (paddle/capi/gradient_machine.h:27-59).  The TPU-native analog: training
+// exports the jitted inference function (weights embedded as constants) as
+// an HloModuleProto bundle (paddle_tpu/config/deploy.py:export_aot_hlo),
+// and THIS host — no Python, no jax, no paddle_tpu — compiles and runs it
+// through the PJRT CPU client that ships inside libtensorflow_cc.
+//
+// Bundle layout (a directory):
+//   model.hlo.pb   serialized xla.HloModuleProto (flat signature)
+//   io.txt         one line per input:  in <f32|i32> <d0>x<d1>x...
+//                  (outputs need no declaration; the host emits whatever
+//                   the executable returns)
+//   in<i>.bin      raw little-endian input buffers, row-major
+// The host writes out<i>.bin next to them and prints one line per output:
+//   out<i> <dtype> <dims> <bytes>
+//
+// Build (the only dependency is the tensorflow wheel's bundled XLA;
+// paddle_tpu.config.deploy.build_aot_host runs exactly this):
+//   g++ -O2 -std=c++17 -DNDEBUG -D_GLIBCXX_USE_CXX11_ABI=1 \
+//       csrc/aot_host.cc -Icsrc/shim -I$TF/include \
+//       -I$TF/include/external/highwayhash \
+//       -I$TF/include/external/farmhash_archive/src \
+//       -L$TF -l:libtensorflow_cc.so.2 -l:libtensorflow_framework.so.2 \
+//       -Wl,-rpath,$TF -o aot_host
+// -DNDEBUG is LOAD-BEARING: the wheel's absl is a release build, and
+// absl's SwissTable layout differs between debug and NDEBUG — mixing our
+// inlined header code with the library's (an ODR violation) corrupts
+// every hash table and crashes at the first insert.
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/hlo/builder/xla_computation.h"
+#include "xla/pjrt/pjrt_client.h"
+#include "xla/pjrt/pjrt_executable.h"
+#include "xla/pjrt/plugin/xla_cpu/cpu_client_options.h"
+#include "xla/pjrt/plugin/xla_cpu/xla_cpu_pjrt_client.h"
+#include "xla/primitive_util.h"
+#include "xla/service/hlo.pb.h"
+#include "xla/xla_data.pb.h"
+
+namespace {
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path.c_str());
+    exit(2);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+struct InputSpec {
+  xla::PrimitiveType type;
+  std::vector<int64_t> dims;
+};
+
+xla::PrimitiveType ParseDtype(const std::string& s) {
+  if (s == "f32") return xla::F32;
+  if (s == "i32") return xla::S32;
+  if (s == "f64") return xla::F64;
+  if (s == "i64") return xla::S64;
+  fprintf(stderr, "unsupported dtype %s\n", s.c_str());
+  exit(2);
+}
+
+const char* DtypeName(xla::PrimitiveType t) {
+  switch (t) {
+    case xla::F32: return "f32";
+    case xla::S32: return "i32";
+    case xla::F64: return "f64";
+    case xla::S64: return "i64";
+    case xla::PRED: return "pred";
+    default: return "other";
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <bundle_dir>\n", argv[0]);
+    return 2;
+  }
+  const std::string dir = argv[1];
+
+  // ---- parse io.txt ------------------------------------------------------
+  std::vector<InputSpec> inputs;
+  {
+    std::ifstream io(dir + "/io.txt");
+    if (!io) {
+      fprintf(stderr, "cannot open %s/io.txt\n", dir.c_str());
+      return 2;
+    }
+    std::string kind, dtype, dims;
+    while (io >> kind >> dtype >> dims) {
+      if (kind != "in") continue;
+      InputSpec spec;
+      spec.type = ParseDtype(dtype);
+      if (dims != "scalar") {
+        std::stringstream ds(dims);
+        std::string d;
+        while (std::getline(ds, d, 'x')) spec.dims.push_back(std::stoll(d));
+      }
+      inputs.push_back(std::move(spec));
+    }
+  }
+
+  // ---- deserialize the module and build the executable -------------------
+  xla::HloModuleProto proto;
+  if (!proto.ParseFromString(ReadFile(dir + "/model.hlo.pb"))) {
+    fprintf(stderr, "model.hlo.pb does not parse as HloModuleProto\n");
+    return 2;
+  }
+  xla::XlaComputation computation(proto);
+
+  xla::CpuClientOptions copts;
+  copts.cpu_device_count = 1;
+  // inline dispatch: a single-shot host has nothing to overlap, and it
+  // keeps execution on the calling thread
+  copts.asynchronous = false;
+  auto client_or = xla::GetXlaPjrtCpuClient(std::move(copts));
+  if (!client_or.ok()) {
+    fprintf(stderr, "GetXlaPjrtCpuClient: %s\n",
+            client_or.status().ToString().c_str());
+    return 3;
+  }
+  std::unique_ptr<xla::PjRtClient> client = std::move(client_or).value();
+
+  xla::CompileOptions compile_opts;
+  auto exec_or = client->CompileAndLoad(computation, compile_opts);
+  if (!exec_or.ok()) {
+    fprintf(stderr, "CompileAndLoad: %s\n",
+            exec_or.status().ToString().c_str());
+    return 3;
+  }
+  auto executable = std::move(exec_or).value();
+
+  // ---- inputs -> device buffers ------------------------------------------
+  xla::PjRtDevice* device = client->addressable_devices()[0];
+  auto mem_or = device->default_memory_space();
+  if (!mem_or.ok()) {
+    fprintf(stderr, "default_memory_space: %s\n",
+            mem_or.status().ToString().c_str());
+    return 3;
+  }
+  std::vector<std::string> raw(inputs.size());
+  std::vector<std::unique_ptr<xla::PjRtBuffer>> buffers;
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    raw[i] = ReadFile(dir + "/in" + std::to_string(i) + ".bin");
+    size_t want = xla::primitive_util::ByteWidth(inputs[i].type);
+    for (int64_t d : inputs[i].dims) want *= static_cast<size_t>(d);
+    if (raw[i].size() != want) {
+      fprintf(stderr,
+              "in%zu.bin holds %zu bytes but io.txt declares %zu — wrong "
+              "dtype, shape, or a truncated file\n",
+              i, raw[i].size(), want);
+      return 2;
+    }
+    auto buf_or = client->BufferFromHostBuffer(
+        raw[i].data(), inputs[i].type, inputs[i].dims,
+        /*byte_strides=*/std::nullopt,
+        xla::PjRtClient::HostBufferSemantics::kImmutableUntilTransferCompletes,
+        /*on_done_with_host_buffer=*/nullptr, mem_or.value(),
+        /*device_layout=*/nullptr);
+    if (!buf_or.ok()) {
+      fprintf(stderr, "BufferFromHostBuffer(%zu): %s\n", i,
+              buf_or.status().ToString().c_str());
+      return 3;
+    }
+    buffers.push_back(std::move(buf_or).value());
+  }
+
+  // ---- execute ------------------------------------------------------------
+  std::vector<xla::PjRtBuffer*> arg_ptrs;
+  for (auto& b : buffers) arg_ptrs.push_back(b.get());
+  xla::ExecuteOptions eopts;
+  auto results_or = executable->Execute({arg_ptrs}, eopts);
+  if (!results_or.ok()) {
+    fprintf(stderr, "Execute: %s\n", results_or.status().ToString().c_str());
+    return 3;
+  }
+  auto& results = results_or.value()[0];
+
+  // ---- outputs -> raw files ----------------------------------------------
+  // Read back through AcquireExternalReference + memcpy: on the CPU client
+  // "device" memory IS host memory, so this is a zero-copy view — no
+  // Literal allocation/relayout needed for row-major outputs.
+  for (size_t i = 0; i < results.size(); ++i) {
+    xla::PjRtBuffer* buf = results[i].get();
+    auto size_or = buf->GetOnDeviceSizeInBytes();
+    auto ref_or = buf->AcquireExternalReference();
+    if (!size_or.ok() || !ref_or.ok()) {
+      fprintf(stderr, "output %zu readback: %s %s\n", i,
+              size_or.status().ToString().c_str(),
+              ref_or.status().ToString().c_str());
+      return 3;
+    }
+    const size_t nbytes = static_cast<size_t>(size_or.value());
+    const void* p = ref_or.value()->OpaqueDeviceMemoryDataPointer();
+    std::ofstream out(dir + "/out" + std::to_string(i) + ".bin",
+                      std::ios::binary);
+    out.write(reinterpret_cast<const char*>(p),
+              static_cast<std::streamsize>(nbytes));
+    out.close();
+    std::string dims;
+    for (int64_t d : buf->dimensions()) {
+      if (!dims.empty()) dims += "x";
+      dims += std::to_string(d);
+    }
+    if (dims.empty()) dims = "scalar";
+    printf("out%zu %s %s %zu\n", i, DtypeName(buf->element_type()),
+           dims.c_str(), nbytes);
+  }
+  return 0;
+}

@@ -1,0 +1,17 @@
+// SHIM for the Python-free aot_host build ONLY (csrc/aot_host.cc).
+//
+// The tensorflow wheel ships MLIR headers but not LLVM's support headers,
+// so the real BuiltinOps.h cannot be included.  xla/pjrt/pjrt_client.h
+// needs mlir::ModuleOp solely as a by-value parameter of two inline
+// virtual overloads the host never calls (their bodies return
+// UnimplementedError without touching the value), so a minimal complete
+// type satisfies the compiler; the emitted weak vtable thunks have the
+// same mangled names and equivalent behavior as the library's.
+#ifndef PADDLE_TPU_CSRC_SHIM_MLIR_BUILTIN_OPS_H_
+#define PADDLE_TPU_CSRC_SHIM_MLIR_BUILTIN_OPS_H_
+
+namespace mlir {
+class ModuleOp {};
+}  // namespace mlir
+
+#endif  // PADDLE_TPU_CSRC_SHIM_MLIR_BUILTIN_OPS_H_

@@ -21,6 +21,7 @@ _EXPORTS = {
     "protostr": "config_parser",
     "InferenceModel": "deploy",
     "export_aot": "deploy",
+    "export_aot_hlo": "deploy",
     "load_inference_model": "deploy",
     "merge_model": "deploy",
     "configurable": "capture",

@@ -28,7 +28,7 @@ from paddle_tpu.nn.graph import Topology
 from paddle_tpu.proto import model_config_pb2 as pb
 
 __all__ = ["merge_model", "InferenceModel", "load_inference_model",
-           "export_aot"]
+           "export_aot", "export_aot_hlo"]
 
 _MAGIC = "paddle_tpu.bundle.v1"
 
@@ -200,27 +200,7 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
 
     m = (load_inference_model(bundle_or_model)
          if isinstance(bundle_or_model, str) else bundle_or_model)
-    names = list(outputs) if outputs else list(m.output_names)
-    keys = sorted(example_feed)
-    spec: List[tuple] = []
-    flat_example: List[Any] = []
-    for k in keys:
-        v = example_feed[k]
-        parts = v if isinstance(v, tuple) else (v,)
-        spec.append((k, len(parts)))
-        flat_example.extend(jnp.asarray(p) for p in parts)
-
-    topology, params, state = m.topology, m.params, m.state
-
-    def fn(*flat):
-        feed: Dict[str, Any] = {}
-        i = 0
-        for key, n in spec:
-            feed[key] = flat[i] if n == 1 else tuple(flat[i: i + n])
-            i += n
-        outs, _ = topology.apply(params, state, feed, train=False,
-                                 outputs=names)
-        return tuple(outs[n].value for n in names)
+    names, spec, flat_example, fn = _flat_signature(m, example_feed, outputs)
 
     try:  # portable artifact when this jax supports multi-platform export
         exporter = jexport.export(jax.jit(fn), platforms=("cpu", "tpu"))
@@ -243,3 +223,192 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
         z.writestr("manifest.json", json.dumps(manifest, indent=1))
         z.writestr("fn.stablehlo", exported.serialize())
     return out_path
+
+
+_HLO_DTYPES = {"float32": "f32", "int32": "i32", "float64": "f64",
+               "int64": "i64"}
+
+
+def _flat_signature(m, example_feed: Dict[str, Any],
+                    outputs: Optional[Sequence[str]]):
+    """Shared AOT flattening: sorted feed keys, sequence tuples flattened
+    to parts, and a flat-argument closure over the trained model — ONE
+    definition so the StableHLO and HLO-proto artifact signatures can
+    never drift."""
+    names = list(outputs) if outputs else list(m.output_names)
+    keys = sorted(example_feed)
+    spec: List[tuple] = []
+    flat_example: List[Any] = []
+    for k in keys:
+        v = example_feed[k]
+        parts = v if isinstance(v, tuple) else (v,)
+        spec.append((k, len(parts)))
+        flat_example.extend(jnp.asarray(p) for p in parts)
+
+    topology, params, state = m.topology, m.params, m.state
+
+    def fn(*flat):
+        feed: Dict[str, Any] = {}
+        i = 0
+        for key, n in spec:
+            feed[key] = flat[i] if n == 1 else tuple(flat[i: i + n])
+            i += n
+        outs, _ = topology.apply(params, state, feed, train=False,
+                                 outputs=names)
+        return tuple(outs[n].value for n in names)
+
+    return names, spec, flat_example, fn
+
+
+class _unrolled_scans:
+    """Trace-time ``lax.scan`` unrolling for AOT export: an inference
+    artifact has static shapes, so a Python loop over the static trip
+    count produces a straight-line (control-flow-free) module — useful for
+    consumers that prefer or require loop-free HLO.  Patches
+    ``jax.lax.scan`` for the duration of the export trace only."""
+
+    def __enter__(self):
+        from jax import lax as jlax
+
+        self._orig = jlax.scan
+
+        def scan(f, init, xs=None, length=None, reverse=False, **_kw):
+            import jax as _jax
+
+            leaves = _jax.tree_util.tree_leaves(xs)
+            n = int(length) if xs is None or not leaves else leaves[0].shape[0]
+            order = range(n - 1, -1, -1) if reverse else range(n)
+            carry, ys = init, []
+            for i in order:
+                x_i = (None if xs is None else
+                       _jax.tree_util.tree_map(lambda a: a[i], xs))
+                carry, y = f(carry, x_i)
+                ys.append(y)
+            if reverse:
+                ys.reverse()
+            stacked = _jax.tree_util.tree_map(
+                lambda *a: jnp.stack(a), *ys) if ys else None
+            return carry, stacked
+
+        jlax.scan = scan
+        return self
+
+    def __exit__(self, *exc):
+        from jax import lax as jlax
+
+        jlax.scan = self._orig
+        return False
+
+
+def export_aot_hlo(bundle_or_model, out_dir: str, example_feed: Dict[str, Any],
+                   *, outputs: Optional[Sequence[str]] = None,
+                   unroll_scans: bool = False) -> str:
+    """Serialize an inference bundle for the PYTHON-FREE C++ host
+    (csrc/aot_host.cc): an HloModuleProto with the trained weights embedded
+    as constants, plus a flat-signature ``io.txt``.  The target process
+    runs NO Python at all — it links the PJRT CPU client bundled in
+    libtensorflow_cc and feeds raw row-major buffers:
+
+        aot_host <out_dir>       # reads in<i>.bin, writes out<i>.bin
+
+    This completes the reference's C-deployment story
+    (paddle/capi/gradient_machine.h:27-59): where ``export_aot`` removes
+    the framework dependency (artifact runs with jax alone), this removes
+    the Python process entirely.  Shapes are fixed by ``example_feed``
+    exactly as in ``export_aot``.  Returns ``out_dir``.
+    """
+    m = (load_inference_model(bundle_or_model)
+         if isinstance(bundle_or_model, str) else bundle_or_model)
+    names, spec, flat_example, fn = _flat_signature(m, example_feed, outputs)
+
+    # validate dtypes BEFORE the (expensive) lowering so an unsupported
+    # feed never leaves a partial bundle on disk
+    lines = []
+    for a in flat_example:
+        a = np.asarray(a)
+        dt = _HLO_DTYPES.get(str(a.dtype))
+        if dt is None:
+            raise ValueError(f"export_aot_hlo: unsupported input dtype "
+                             f"{a.dtype}")
+        dims = "x".join(str(d) for d in a.shape) or "scalar"
+        lines.append(f"in {dt} {dims}")
+
+    if unroll_scans:
+        with _unrolled_scans():
+            ir = jax.jit(fn).lower(*flat_example).compiler_ir(dialect="hlo")
+    else:
+        ir = jax.jit(fn).lower(*flat_example).compiler_ir(dialect="hlo")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "model.hlo.pb"), "wb") as f:
+        f.write(ir.as_serialized_hlo_module_proto())
+    manifest = {
+        "inputs": [{"name": k, "parts": n} for k, n in spec],
+        "outputs": names,
+    }
+    with open(os.path.join(out_dir, "io.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return out_dir
+
+
+def build_aot_host(*, force: bool = False, strict: bool = False
+                   ) -> Optional[str]:
+    """Compile csrc/aot_host.cc (the Python-free PJRT-CPU inference host)
+    against the tensorflow wheel's bundled XLA; returns the binary path or
+    None when the toolchain/wheel is unavailable.  Cached next to the
+    native dataio library, rebuilt when the source is newer.  With
+    ``strict=True`` a COMPILE failure raises (with the compiler's stderr)
+    instead of returning None — so CI can distinguish "wheel absent"
+    (None) from "host code broken" (raise)."""
+    import importlib.util
+    import subprocess
+
+    spec = importlib.util.find_spec("tensorflow")
+    if spec is None or not spec.submodule_search_locations:
+        return None
+    tf_dir = list(spec.submodule_search_locations)[0]
+    if not os.path.exists(os.path.join(tf_dir, "libtensorflow_cc.so.2")):
+        return None
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    src = os.path.join(root, "csrc", "aot_host.cc")
+    out_dir = os.path.join(root, "paddle_tpu", "_native")
+    os.makedirs(out_dir, exist_ok=True)
+    binary = os.path.join(out_dir, "aot_host")
+    if not os.path.exists(src):
+        # installed without the csrc/ tree: a stale cached binary is still
+        # usable, but there is nothing to (re)build
+        return binary if os.path.exists(binary) else None
+    if (not force and os.path.exists(binary)
+            and os.path.getmtime(binary) >= os.path.getmtime(src)):
+        return binary
+    inc = os.path.join(tf_dir, "include")
+    cmd = [
+        # -DNDEBUG is load-bearing: the wheel's absl is a release build and
+        # the SwissTable layout differs under debug (see csrc/aot_host.cc)
+        "g++", "-O2", "-std=c++17", "-w", "-DNDEBUG",
+        "-D_GLIBCXX_USE_CXX11_ABI=1",
+        src,
+        "-I", os.path.join(root, "csrc", "shim"),
+        "-I", inc,
+        "-I", os.path.join(inc, "external", "highwayhash"),
+        "-I", os.path.join(inc, "external", "farmhash_archive", "src"),
+        "-L", tf_dir,
+        "-l:libtensorflow_cc.so.2", "-l:libtensorflow_framework.so.2",
+        f"-Wl,-rpath,{tf_dir}",
+        "-o", binary,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=600)
+    except subprocess.CalledProcessError as e:
+        if strict:
+            raise RuntimeError(
+                f"aot_host compile failed:\n{e.stderr.decode()[-4000:]}"
+            ) from e
+        return None
+    except Exception:
+        if strict:
+            raise
+        return None
+    return binary

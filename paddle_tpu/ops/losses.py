@@ -15,6 +15,8 @@ flat-sequence costs (no padding there by construction).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -175,18 +177,117 @@ def _ce_readout_bwd(res, d):
 _ce_readout_fused.defvjp(_ce_readout_fwd, _ce_readout_bwd)
 
 
+def _tiled_ce_cfg(B, T, D, V):
+    """Vocab-tiled Pallas CE gate: (row_block, v_tile) or None for the XLA
+    path.  Needs a TPU backend, lane-aligned D, a sublane-aligned row block
+    dividing B*T, and the backward's VMEM-resident working set (full-N
+    d_states accumulator + states + double-buffered logits/d_l tiles +
+    lane-padded per-row vectors) must fit the raised scoped-VMEM budget —
+    larger shapes fall back to the XLA path instead of failing at compile.
+    V itself only sets tile padding (handled in the wrapper)."""
+    import jax as _jax
+
+    from paddle_tpu.ops.numerics import compute_dtype
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not FLAGS.use_pallas_ce:
+        return None
+    if _jax.default_backend() not in ("tpu", "axon"):
+        return None
+    if D % 128:
+        return None
+    N = B * T
+    rb = next((r for r in (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+               if N % r == 0), None)
+    if rb is None:
+        return None
+    vt = 512
+    cd = jnp.dtype(compute_dtype()).itemsize
+    # calibrated against the measured ~102 MB at N=12288, D=512, cd=2
+    est = N * (D * (4 + cd) + vt * (2 * cd + 4) + 3 * 512)
+    if est > 108 * 1024 * 1024:
+        return None
+    return rb, vt
+
+
+@functools.lru_cache(maxsize=None)
+def _tiled_ce_fn(rb, vt, V, sdt, wdt, bdt):
+    """custom_vjp instance for one static (row_block, v_tile, V, dtypes)
+    configuration of the vocab-tiled Pallas CE (kernels in
+    ops/pallas_kernels.py: ce_readout_fwd/bwd_pallas)."""
+    from paddle_tpu.ops.pallas_kernels import (ce_readout_bwd_pallas,
+                                               ce_readout_fwd_pallas)
+
+    f32 = jnp.float32
+
+    @jax.custom_vjp
+    def tiled(states, w, b, labels, mask):
+        loss, _ = fwd(states, w, b, labels, mask)
+        return loss
+
+    def fwd(states, w, b, labels, mask):
+        from paddle_tpu.ops.numerics import mxu_cast
+
+        B, T, D = states.shape
+        N = B * T
+        sc, wc = mxu_cast(states.reshape(N, D), w)
+        Vp = -(-V // vt) * vt
+        w_p = jnp.pad(wc, ((0, 0), (0, Vp - V)))
+        # padded vocab columns get bias -1e30: exp underflows to zero so
+        # the statistics and every gradient are exact
+        b_p = jnp.pad(b.astype(f32).reshape(1, V), ((0, 0), (0, Vp - V)),
+                      constant_values=-1e30)
+        lab = labels.astype(jnp.int32).reshape(N, 1)
+        per_tok, lse, logits = ce_readout_fwd_pallas(
+            sc, w_p, b_p, lab, row_block=rb, v_tile=vt)
+        loss = masked_token_mean(per_tok.reshape(B, T), mask)
+        # residual saves the PRIMAL w (free — aliases the input); the padded
+        # compute-dtype copy is re-derived in bwd rather than pinning an
+        # extra [D, Vp] buffer across the fwd->bwd interval
+        return loss, (sc, w, lab, lse, logits, mask)
+
+    def bwd(res, d):
+        from paddle_tpu.ops.numerics import mxu_cast
+
+        sc, w, lab, lse, logits, mask = res
+        w_p = jnp.pad(mxu_cast(w), ((0, 0), (0, logits.shape[1] - V)))
+        N, D = sc.shape
+        B, T = mask.shape
+        mask_f = mask.astype(f32)
+        denom = jnp.maximum(jnp.sum(mask_f), 1.0)
+        scale = (d * mask_f / denom).reshape(N, 1)
+        d_states, d_w_p, d_b_p = ce_readout_bwd_pallas(
+            logits, sc, w_p, lab, lse, scale, v_tile=vt)
+        return (d_states.reshape(B, T, D).astype(sdt),
+                d_w_p[:, :V].astype(wdt),
+                d_b_p[0, :V].astype(bdt), None, None)
+
+    tiled.defvjp(fwd, bwd)
+    return tiled
+
+
 def sequence_softmax_ce_readout(states, w, b, labels, mask):
     """Fused vocab readout + token CE: states [B, T, D] x w [D, V] -> loss.
 
     The O(B*T*V) logits buffer dominates HBM traffic for big-vocab decoders
     (hl_matrix crossEntropy operates on an f32 prob matrix; on TPU a 30k-vocab
-    readout at B=256,T=32 is ~1GB in f32).  Here the logits are materialized
-    ONCE in the bf16 compute dtype straight out of the MXU; on TPU the
-    softmax statistics then come from a one-pass Pallas logsumexp (VMEM
-    full-row blocks) behind a custom VJP, else the max/logsumexp reductions
-    upcast element-wise to f32 inside the fused reduction — both match
-    ``linear`` + ``sequence_cross_entropy`` numerics to bf16 rounding.
+    readout at B=256,T=32 is ~1GB in f32).  On TPU the whole tier runs as
+    the VOCAB-TILED Pallas kernel pair (ops/pallas_kernels.py): forward
+    computes each [rows, v_tile] logits tile on the MXU and folds it into
+    online softmax statistics in VMEM (streaming the tile out once, in
+    bf16, as the backward residual); backward reads each tile once and
+    contracts (softmax - onehot)*scale straight into d_states/d_w — the
+    d_logits buffer never exists in HBM.  Off-TPU (or gated shapes), the
+    logits are materialized once in the compute dtype and XLA's fused
+    reductions produce the statistics — both match ``linear`` +
+    ``sequence_cross_entropy`` numerics to bf16 rounding.
     """
+    cfg = _tiled_ce_cfg(states.shape[0], states.shape[1], states.shape[2],
+                        w.shape[1])
+    if cfg is not None:
+        fn = _tiled_ce_fn(cfg[0], cfg[1], int(w.shape[1]),
+                          str(states.dtype), str(w.dtype), str(b.dtype))
+        return fn(states, w, b, labels, mask)
     if _USE_PALLAS_LSE_READOUT:
         return _ce_readout_fused(states, w, b, labels, mask)
     logits = _readout_logits(states, w, b)

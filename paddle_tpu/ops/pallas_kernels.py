@@ -920,3 +920,179 @@ def attn_dec_bwd_pallas(dout_tb, m_tb, sp_tb, r_tb, u_tb, cand_tb, q_tb,
     d_xp_tb, sum_dpre_tb, d_encP, d_v_blocks, d_s0 = outs
     return (d_xp_tb, sum_dpre_tb, d_encP,
             jnp.sum(d_v_blocks[:, 0, :], axis=0), d_s0)
+
+
+# ---------------------------------------------------------------------------
+# Fused vocab-readout + softmax-CE kernels — the flagship's other
+# bandwidth tier.  The XLA path materializes the [B*T, V] logits (bf16)
+# and, in the backward, the same-shaped d_logits, then re-reads each for
+# the softmax statistics / the two weight contractions: ~2.2 GB of HBM
+# traffic per step at WMT14 bench shapes on top of the matmul FLOPs.
+# Here the vocabulary is tiled:
+#
+# - forward, grid (row-blocks, vocab-tiles) with vocab innermost: each
+#   [Rb, Vt] logits tile is computed on the MXU and consumed IN VMEM by an
+#   online max/sum-exp update (flash-attention-style) + the label-logit
+#   gather; the tile is also streamed out in bf16 as the backward residual
+#   (one write instead of XLA's write + two stat reads).
+# - backward, grid (vocab-tiles,) with the full row dimension resident:
+#   each logits tile is read once, d_l = (softmax - onehot)*scale is formed
+#   in VMEM and immediately contracted into BOTH d_states (resident f32
+#   accumulator) and that tile's d_w column block — d_logits never exists
+#   in HBM.
+#
+# The vocabulary is padded to a lane multiple by the wrapper with bias
+# -1e30 (exp underflows to 0, so the statistics and gradients are exact).
+# ---------------------------------------------------------------------------
+
+
+def _ce_fwd_kernel(s_ref, w_ref, b_ref, lab_ref,
+                   ptok_ref, lse_ref, ltile_ref,
+                   m_scr, s_scr, tok_scr, *, v_tile: int):
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+    v = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(v == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        s_scr[...] = jnp.zeros_like(s_scr)
+        tok_scr[...] = jnp.zeros_like(tok_scr)
+
+    l = jnp.dot(s_ref[...], w_ref[...],
+                preferred_element_type=f32) + b_ref[...]      # [Rb, Vt] f32
+    ltile_ref[...] = l.astype(ltile_ref.dtype)
+    m_old = m_scr[...]
+    m_new = jnp.maximum(m_old, jnp.max(l, axis=-1, keepdims=True))
+    s_scr[...] = (s_scr[...] * jnp.exp(m_old - m_new)
+                  + jnp.sum(jnp.exp(l - m_new), axis=-1, keepdims=True))
+    m_scr[...] = m_new
+    col = jax.lax.broadcasted_iota(jnp.int32, l.shape, 1) + v * v_tile
+    hit = col == lab_ref[...]
+    tok_scr[...] += jnp.sum(jnp.where(hit, l, 0.0), axis=-1, keepdims=True)
+
+    @pl.when(v == nv - 1)
+    def _fin():
+        lse = m_scr[...] + jnp.log(s_scr[...])
+        lse_ref[...] = lse
+        ptok_ref[...] = lse - tok_scr[...]
+
+
+def ce_readout_fwd_pallas(states_c, w_c, b_f, labels, *,
+                          row_block: int, v_tile: int):
+    """states_c [N, D] compute dtype, w_c [D, V'] compute dtype, b_f [1, V']
+    f32 (padded tail at -1e30), labels [N, 1] i32 -> (per_tok [N,1] f32,
+    lse [N,1] f32, logits [N, V'] compute dtype — the backward residual)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, D = states_c.shape
+    Vp = w_c.shape[1]
+    nR, nV = N // row_block, Vp // v_tile
+    Rb, Vt = row_block, v_tile
+    kernel = functools.partial(_ce_fwd_kernel, v_tile=Vt)
+    return pl.pallas_call(
+        kernel,
+        grid=(nR, nV),
+        in_specs=[
+            pl.BlockSpec((Rb, D), lambda r, v: (r, 0)),    # states (resident)
+            pl.BlockSpec((D, Vt), lambda r, v: (0, v)),    # w tile
+            pl.BlockSpec((1, Vt), lambda r, v: (0, v)),    # bias tile
+            pl.BlockSpec((Rb, 1), lambda r, v: (r, 0)),    # labels
+        ],
+        out_specs=[
+            pl.BlockSpec((Rb, 1), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, 1), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, Vt), lambda r, v: (r, v)),   # logits residual
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, Vp), states_c.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(states_c, w_c, b_f, labels)
+
+
+def _ce_bwd_kernel(l_ref, s_ref, w_ref, lab_ref, lse_ref, scale_ref,
+                   ds_ref, dw_ref, db_ref, *, v_tile: int, mxu_dtype):
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+    v = pl.program_id(0)
+
+    @pl.when(v == 0)
+    def _init():
+        ds_ref[...] = jnp.zeros_like(ds_ref)
+
+    l = l_ref[...].astype(f32)                            # [N, Vt]
+    p = jnp.exp(l - lse_ref[...])
+    col = jax.lax.broadcasted_iota(jnp.int32, l.shape, 1) + v * v_tile
+    hit = col == lab_ref[...]
+    d_l = (p - jnp.where(hit, 1.0, 0.0)) * scale_ref[...]
+    db_ref[...] = jnp.sum(d_l, axis=0, keepdims=True)
+    d_lc = d_l.astype(mxu_dtype)
+    # d_states += d_l @ w_tile^T  (accumulates across vocab tiles in VMEM)
+    ds_ref[...] += jax.lax.dot_general(
+        d_lc, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=f32)
+    # d_w tile = states^T @ d_l — contract the row dim
+    dw_ref[...] = jax.lax.dot_general(
+        s_ref[...], d_lc, (((0,), (0,)), ((), ())),
+        preferred_element_type=f32)
+
+
+def ce_readout_bwd_pallas(logits_c, states_c, w_c, labels, lse, scale, *,
+                          v_tile: int):
+    """One pass over the saved bf16 logits: d_l is formed per [N, Vt] tile
+    in VMEM and contracted immediately.  Returns (d_states [N, D] f32,
+    d_w [D, V'] f32, d_b [1, V'] f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from paddle_tpu.ops.numerics import compute_dtype
+
+    N, Vp = logits_c.shape
+    D = states_c.shape[1]
+    nV = Vp // v_tile
+    Vt = v_tile
+    kernel = functools.partial(_ce_bwd_kernel, v_tile=Vt,
+                               mxu_dtype=compute_dtype())
+    return pl.pallas_call(
+        kernel,
+        grid=(nV,),
+        in_specs=[
+            pl.BlockSpec((N, Vt), lambda v: (0, v)),       # logits tile
+            pl.BlockSpec((N, D), lambda v: (0, 0)),        # states (resident)
+            pl.BlockSpec((D, Vt), lambda v: (0, v)),       # w tile
+            pl.BlockSpec((N, 1), lambda v: (0, 0)),        # labels
+            pl.BlockSpec((N, 1), lambda v: (0, 0)),        # lse
+            pl.BlockSpec((N, 1), lambda v: (0, 0)),        # scale
+        ],
+        out_specs=[
+            pl.BlockSpec((N, D), lambda v: (0, 0)),        # d_states resident
+            pl.BlockSpec((D, Vt), lambda v: (0, v)),
+            pl.BlockSpec((1, Vt), lambda v: (0, v)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), jnp.float32),
+            jax.ShapeDtypeStruct((D, Vp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Vp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            # the resident d_states accumulator + states + per-tile
+            # temporaries measure ~102 MB at WMT14 bench shapes
+            vmem_limit_bytes=112 * 1024 * 1024),
+        interpret=_interpret(),
+    )(logits_c, states_c, w_c, labels, lse, scale)

@@ -195,6 +195,9 @@ define_flag("use_pallas_rnn", True, "use fused Pallas LSTM/GRU time-loop kernels
 # Gate: ops/attention_decoder.py:_attn_pallas_block (VMEM-resident decoder)
 define_flag("use_pallas_attention", True,
             "use the VMEM-resident Pallas attention-decoder kernels on TPU")
+# Gate: ops/losses.py:_tiled_ce_cfg (vocab-tiled fused readout+CE)
+define_flag("use_pallas_ce", True,
+            "use the vocab-tiled Pallas softmax-CE readout kernels on TPU")
 
 # Numeric traps — the feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)
 # analog (reference: paddle/trainer/TrainerMain.cpp:49 installs FP traps for

@@ -1,0 +1,85 @@
+"""Vocab-tiled fused readout+CE Pallas kernels (interpret mode on CPU) vs
+the XLA path of ops/losses.sequence_softmax_ce_readout — loss and all three
+gradients, including a vocab that does NOT divide the tile (padding with
+-1e30 bias must keep statistics and gradients exact) and masked rows."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import losses as L
+from paddle_tpu.ops.pallas_kernels import pallas_available
+
+pytestmark = pytest.mark.skipif(not pallas_available(),
+                                reason="pallas unavailable")
+
+
+def _data(rng, B=4, T=6, D=128, V=300, lens=(6, 4, 5, 2)):
+    states = jnp.asarray(rng.randn(B, T, D).astype(np.float32) * 0.3)
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.randn(V).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rng.randint(0, V, (B, T)).astype(np.int32))
+    mask = jnp.asarray((np.arange(T)[None]
+                        < np.asarray(lens)[:, None]).astype(np.float32))
+    return states, w, b, labels, mask
+
+
+@pytest.mark.parametrize("V", [300, 256])  # non-divisible and exact tiles
+def test_tiled_ce_matches_xla_path(monkeypatch, rng, V):
+    states, w, b, labels, mask = _data(rng, V=V)
+
+    def loss(states, w, b):
+        return L.sequence_softmax_ce_readout(states, w, b, labels, mask)
+
+    l_ref, g_ref = jax.value_and_grad(loss, argnums=(0, 1, 2))(states, w, b)
+    monkeypatch.setattr(L, "_tiled_ce_cfg", lambda B, T, D, V: (8, 128))
+    l_t, g_t = jax.value_and_grad(loss, argnums=(0, 1, 2))(states, w, b)
+    np.testing.assert_allclose(float(l_ref), float(l_t), rtol=1e-6)
+    for a, c, nm in zip(g_ref, g_t, ("d_states", "d_w", "d_b")):
+        a = np.asarray(a, np.float64)
+        c = np.asarray(c, np.float64)
+        scale = np.abs(a).max() + 1e-12
+        np.testing.assert_allclose(a / scale, c / scale, atol=2e-6,
+                                   err_msg=nm)
+
+
+def test_tiled_ce_bf16_operands(monkeypatch, rng):
+    """bf16 compute policy (the production path): tiled vs XLA stay within
+    bf16 rounding of each other."""
+    monkeypatch.setenv("PADDLE_TPU_COMPUTE_DTYPE", "bfloat16")
+    from paddle_tpu.utils.flags import FLAGS
+
+    monkeypatch.setattr(FLAGS, "compute_dtype", "bfloat16")
+    states, w, b, labels, mask = _data(rng)
+
+    def loss(states, w, b):
+        return L.sequence_softmax_ce_readout(states, w, b, labels, mask)
+
+    l_ref, g_ref = jax.value_and_grad(loss, argnums=(0, 1, 2))(states, w, b)
+    monkeypatch.setattr(L, "_tiled_ce_cfg", lambda B, T, D, V: (8, 128))
+    l_t, g_t = jax.value_and_grad(loss, argnums=(0, 1, 2))(states, w, b)
+    assert abs(float(l_ref) - float(l_t)) / abs(float(l_ref)) < 2e-2
+    for a, c, nm in zip(g_ref, g_t, ("d_states", "d_w", "d_b")):
+        a = np.asarray(a, np.float64)
+        c = np.asarray(c, np.float64)
+        scale = np.abs(a).max() + 1e-12
+        np.testing.assert_allclose(a / scale, c / scale, atol=3e-2,
+                                   err_msg=nm)
+
+
+def test_gate_rejects_cpu_and_bad_shapes():
+    import jax as _jax
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        assert L._tiled_ce_cfg(4, 8, 128, 300) is None  # CPU backend
+    # lane-misaligned D can never tile
+    from paddle_tpu.utils.flags import FLAGS
+
+    old = FLAGS.use_pallas_ce
+    try:
+        FLAGS.use_pallas_ce = True
+        assert L._tiled_ce_cfg(4, 8, 100, 300) is None or \
+            _jax.default_backend() not in ("tpu", "axon")
+    finally:
+        FLAGS.use_pallas_ce = old

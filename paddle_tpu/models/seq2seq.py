@@ -81,11 +81,13 @@ class Seq2SeqAttention:
         """[B,S] ids -> (enc [B,S,2H], enc_proj [B,S,A], s0 [B,D])."""
         emb = O.embedding_lookup(params["src_emb"], src_ids)
         emb = emb * src_mask[..., None].astype(emb.dtype)
-        h_fw, _ = O.gru_layer(emb, src_mask, params["enc_fw_wx"],
-                              params["enc_fw_wh"], params["enc_fw_b"])
-        h_bw, h_bw_fin = O.gru_layer(emb, src_mask, params["enc_bw_wx"],
-                                     params["enc_bw_wh"], params["enc_bw_b"],
-                                     reverse=True)
+        # both directions in ONE fused time loop where the bidirectional
+        # Pallas kernel applies (ops/rnn.bigru_layer) — the two scans
+        # otherwise serialize on the single core
+        h_fw, h_bw, h_bw_fin = O.bigru_layer(
+            emb, src_mask, params["enc_fw_wx"], params["enc_fw_wh"],
+            params["enc_fw_b"], params["enc_bw_wx"], params["enc_bw_wh"],
+            params["enc_bw_b"])
         enc = jnp.concatenate([h_fw, h_bw], axis=-1)
         enc_proj = O.linear(enc, params["enc_proj_w"], params["enc_proj_b"])
         s0 = jnp.tanh(O.linear(h_bw_fin, params["boot_w"], params["boot_b"]))

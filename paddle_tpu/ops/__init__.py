@@ -47,7 +47,8 @@ from paddle_tpu.ops.conv import (
     maxout,
     global_avg_pool,
 )
-from paddle_tpu.ops.rnn import lstm_step, gru_step, lstm_layer, gru_layer, scan_rnn
+from paddle_tpu.ops.rnn import (lstm_step, gru_step, lstm_layer,
+                               gru_layer, bigru_layer, scan_rnn)
 from paddle_tpu.ops.attention import (
     additive_attention_scores,
     attend,

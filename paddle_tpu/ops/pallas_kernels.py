@@ -238,7 +238,11 @@ lstm_forward_pallas.defvjp(_lstm_fwd, _lstm_bwd)
 
 
 def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
-                hidden: int, mxu_dtype):
+                hidden: int, mxu_dtype, batch_split: int = 0):
+    """``batch_split`` > 0 runs a BIDIRECTIONAL batch: rows [:split] use
+    weight rows [:H] (forward direction) and rows [split:] use rows [H:]
+    (backward direction, its inputs time-flipped by the caller) — both
+    directions advance in ONE sequential time loop instead of two."""
     from jax.experimental import pallas as pl
 
     save_residuals = len(rest) == 3  # (zseq, hprev, h_scr) vs (h_scr,)
@@ -257,14 +261,22 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
     h = h_scr[...]
     H = hidden
     xp = xp_ref[0]                                      # [B, 3H]
-    w = wh_ref[...].astype(mxu_dtype)                   # [H, 3H]
-    hc = h.astype(mxu_dtype)
-    zr = xp[:, : 2 * H] + jnp.dot(hc, w[:, : 2 * H],
-                                  preferred_element_type=jnp.float32)
+    w = wh_ref[...].astype(mxu_dtype)                   # [H or 2H, 3H]
+
+    def rdot(v, lo, hi):
+        vc = v.astype(mxu_dtype)
+        if batch_split:
+            return jnp.concatenate([
+                jnp.dot(vc[:batch_split], w[:H, lo:hi],
+                        preferred_element_type=jnp.float32),
+                jnp.dot(vc[batch_split:], w[H:, lo:hi],
+                        preferred_element_type=jnp.float32)], 0)
+        return jnp.dot(vc, w[:, lo:hi], preferred_element_type=jnp.float32)
+
+    zr = xp[:, : 2 * H] + rdot(h, 0, 2 * H)
     r = jax.nn.sigmoid(zr[:, :H])
     u = jax.nn.sigmoid(zr[:, H:])
-    zc = xp[:, 2 * H :] + jnp.dot((r * h).astype(mxu_dtype), w[:, 2 * H :],
-                                  preferred_element_type=jnp.float32)
+    zc = xp[:, 2 * H :] + rdot(r * h, 2 * H, 3 * H)
     cand = jnp.tanh(zc)
     h_new = u * h + (1.0 - u) * cand
     m = m_ref[0]
@@ -282,9 +294,11 @@ def _gru_kernel(xp_ref, m_ref, wh_ref, hseq_ref, hfin_ref, *rest,
         hfin_ref[...] = h_new
 
 
-def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
+def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True,
+                    batch_split: int = 0):
     """TIME-MAJOR (see _lstm_pallas_raw).  ``residuals=False``: inference
-    variant without the z/h_prev outputs."""
+    variant without the z/h_prev outputs.  ``batch_split``: bidirectional
+    batch with stacked [2H, 3H] weights (see _gru_kernel)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -293,7 +307,8 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
     T, B, H3 = xp_tb.shape
     H = H3 // 3
     kernel = functools.partial(_gru_kernel, hidden=H,
-                               mxu_dtype=compute_dtype())
+                               mxu_dtype=compute_dtype(),
+                               batch_split=batch_split)
     step = lambda t: (t, 0, 0)
     out_specs = [
         pl.BlockSpec((1, B, H), step),
@@ -321,11 +336,15 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True):
         in_specs=[
             pl.BlockSpec((1, B, H3), step),
             pl.BlockSpec((1, B, 1), step),
-            pl.BlockSpec((H, H3), lambda t: (0, 0)),
+            pl.BlockSpec((w_h.shape[0], H3), lambda t: (0, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            # the bidirectional batch doubles the per-step working set past
+            # Mosaic's 16 MB default scoped-VMEM limit
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(xp_tb, mask_tb[..., None], w_h)
 
@@ -507,8 +526,11 @@ def _lstm_bwd_pallas_raw(dout_tb, m_tb, z_tb, cp_tb, w_t, pi, pf, po,
 
 
 def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
-                    dz_ref, dh0_ref, dh_scr, *, hidden: int):
-    """Reverse GRU step — mirrors rnn_fused._gru_seq_bwd.rev_step (f32)."""
+                    dz_ref, dh0_ref, dh_scr, *, hidden: int,
+                    batch_split: int = 0):
+    """Reverse GRU step — mirrors rnn_fused._gru_seq_bwd.rev_step (f32).
+    ``batch_split``: bidirectional batch; w_t carries both directions'
+    transposed weights stacked on the column axis [3H, 2H]."""
     from jax.experimental import pallas as pl
 
     t = pl.program_id(0)
@@ -531,12 +553,20 @@ def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
     d_u = d_hnew * (hp - cand)
     d_zc = d_hnew * (1.0 - u) * (1.0 - cand * cand)
     w_t = wt_ref[...]
-    d_rh = jnp.dot(d_zc, w_t[2 * H:, :], preferred_element_type=jnp.float32)
+
+    def rtdot(v, lo, hi):
+        if batch_split:
+            return jnp.concatenate([
+                jnp.dot(v[:batch_split], w_t[lo:hi, :H],
+                        preferred_element_type=jnp.float32),
+                jnp.dot(v[batch_split:], w_t[lo:hi, H:],
+                        preferred_element_type=jnp.float32)], 0)
+        return jnp.dot(v, w_t[lo:hi, :], preferred_element_type=jnp.float32)
+
+    d_rh = rtdot(d_zc, 2 * H, 3 * H)
     d_r = d_rh * hp
     d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
-    d_hp = (d_hnew * u + d_rh * r
-            + jnp.dot(d_zr, w_t[: 2 * H, :],
-                      preferred_element_type=jnp.float32))
+    d_hp = d_hnew * u + d_rh * r + rtdot(d_zr, 0, 2 * H)
     dh_scr[...] = (1.0 - mcol) * d_c + d_hp
     dz_ref[0, :, : 2 * H] = d_zr
     dz_ref[0, :, 2 * H:] = d_zc
@@ -546,15 +576,18 @@ def _gru_bwd_kernel(dout_ref, m_ref, z_ref, hp_ref, wt_ref, dhfin_ref,
         dh0_ref[...] = dh_scr[...]
 
 
-def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
-    """TIME-MAJOR twin of _lstm_bwd_pallas_raw for the GRU."""
+def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin, *,
+                        batch_split: int = 0):
+    """TIME-MAJOR twin of _lstm_bwd_pallas_raw for the GRU.
+    ``batch_split``: bidirectional batch, w_t stacked [3H, 2H]."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, B, H3 = z_tb.shape
     H = H3 // 3
     rev = lambda t: (T - 1 - t, 0, 0)
-    kernel = functools.partial(_gru_bwd_kernel, hidden=H)
+    kernel = functools.partial(_gru_bwd_kernel, hidden=H,
+                               batch_split=batch_split)
     return pl.pallas_call(
         kernel,
         grid=(T,),
@@ -563,7 +596,7 @@ def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
             pl.BlockSpec((1, B, 1), rev),
             pl.BlockSpec((1, B, H3), rev),
             pl.BlockSpec((1, B, H), rev),
-            pl.BlockSpec((H3, H), lambda t: (0, 0)),
+            pl.BlockSpec((H3, w_t.shape[1]), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
         ],
         out_specs=[
@@ -575,6 +608,8 @@ def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin):
             jax.ShapeDtypeStruct((B, H), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(dout_tb, m_tb[..., None], z_tb, hp_tb, w_t, d_hfin)
 

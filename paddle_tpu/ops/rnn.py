@@ -35,6 +35,7 @@ __all__ = [
     "gru_step",
     "lstm_layer",
     "gru_layer",
+    "bigru_layer",
     "scan_rnn",
 ]
 
@@ -216,3 +217,34 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
 
     h_fin, h_seq = scan_rnn(step, h0, xp, mask, reverse=reverse)
     return h_seq, h_fin
+
+
+def bigru_layer(x, mask, wx_fw, wh_fw, b_fw, wx_bw, wh_bw, b_bw):
+    """Bidirectional GRU over a padded batch — the encoder composition of
+    the seq2seq flagship (reference: seqToseq_net.py's forward + backward
+    grumemory pair) as ONE sequential time loop when the fused Pallas
+    kernel is available (see rnn_fused.bigru_sequence_fused), else two
+    ``gru_layer`` calls.
+
+    Returns (h_fw [B,T,H], h_bw [B,T,H], h_bw_final [B,H]).
+    """
+    from paddle_tpu.ops.rnn_fused import (_use_pallas_bigru,
+                                          bigru_sequence_fused)
+
+    B, T, _ = x.shape
+    H = wh_fw.shape[0]
+    if not _use_pallas_bigru(B, H):
+        h_fw, _ = gru_layer(x, mask, wx_fw, wh_fw, b_fw)
+        h_bw, h_bw_fin = gru_layer(x, mask, wx_bw, wh_bw, b_bw, reverse=True)
+        return h_fw, h_bw, h_bw_fin
+    xp_fw = linear(x, wx_fw, b_fw)
+    xp_bw = linear(x, wx_bw, b_bw)
+    # flip the backward direction whole: padding moves to the FRONT where
+    # the zero carry holds through masked steps (scan_rnn semantics), so a
+    # forward pass over the flip IS the reverse GRU; outputs flip back
+    xp2 = jnp.concatenate([xp_fw, jnp.flip(xp_bw, 1)], 0)
+    mask2 = jnp.concatenate([mask, jnp.flip(mask, 1)], 0)
+    h2, h_fin2 = bigru_sequence_fused(xp2, mask2, wh_fw, wh_bw, B)
+    h_fw = h2[:B]
+    h_bw = jnp.flip(h2[B:], 1)
+    return h_fw, h_bw, h_fin2[B:]

@@ -198,6 +198,10 @@ define_flag("use_pallas_attention", True,
 # Gate: ops/losses.py:_tiled_ce_cfg (vocab-tiled fused readout+CE)
 define_flag("use_pallas_ce", True,
             "use the vocab-tiled Pallas softmax-CE readout kernels on TPU")
+# Gate: ops/rnn_fused.py:_use_pallas_bigru — A/B-measured a TIE on v5e at
+# the WMT14 encoder shape, kept off (see the gate's docstring)
+define_flag("use_pallas_bigru", False,
+            "fuse bidirectional GRU pairs into one Pallas time loop")
 
 # Numeric traps — the feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)
 # analog (reference: paddle/trainer/TrainerMain.cpp:49 installs FP traps for

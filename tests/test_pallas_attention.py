@@ -170,3 +170,35 @@ def test_bf16_operand_policy(force_pallas):
         np.testing.assert_allclose(np.asarray(a, np.float64) / scale,
                                    np.asarray(b, np.float64) / scale,
                                    atol=3e-2, err_msg=nm)
+
+
+def test_gate_rejects_cpu_and_misaligned_shapes(monkeypatch):
+    """The production gate must route CPU backends and non-tile-aligned
+    shapes to the XLA scan path (returning None), never to a kernel that
+    cannot lower."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    monkeypatch.setattr(FLAGS, "use_pallas_attention", True)
+    if jax.default_backend() not in ("tpu", "axon"):
+        assert ad._attn_pallas_block(384, 32, 512, 512, 1024) is None
+    # misaligned dims can never tile, any backend
+    assert ad._attn_pallas_block(384, 32, 500, 512, 1024) is None
+    assert ad._attn_pallas_block(384, 30, 512, 512, 1024) is None
+    # a batch with no sublane-aligned divisor
+    assert ad._attn_pallas_block(7, 32, 512, 512, 1024) is None
+    monkeypatch.setattr(FLAGS, "use_pallas_attention", False)
+    assert ad._attn_pallas_block(384, 32, 512, 512, 1024) is None
+
+
+def test_flag_off_matches_flag_on(monkeypatch):
+    """Flipping use_pallas_attention must not change results (CPU: both
+    sides take the scan; the on-device equivalence is pinned by the
+    A/B-verified kernels + test_aligned_shapes_real_lowering)."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    vals = [make_args()[k] for k in ORDER]
+    monkeypatch.setattr(FLAGS, "use_pallas_attention", False)
+    off = np.asarray(attention_gru_decoder(*vals))
+    monkeypatch.setattr(FLAGS, "use_pallas_attention", True)
+    on = np.asarray(attention_gru_decoder(*vals))
+    np.testing.assert_allclose(off, on, **_tols())

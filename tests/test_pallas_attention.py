@@ -181,7 +181,11 @@ def test_gate_rejects_cpu_and_misaligned_shapes(monkeypatch):
     monkeypatch.setattr(FLAGS, "use_pallas_attention", True)
     if jax.default_backend() not in ("tpu", "axon"):
         assert ad._attn_pallas_block(384, 32, 512, 512, 1024) is None
-    # misaligned dims can never tile, any backend
+    # force the backend probe open so the alignment branches execute on
+    # CPU too (otherwise the backend check short-circuits them)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert ad._attn_pallas_block(384, 32, 512, 512, 1024) == 128
+    # misaligned dims can never tile
     assert ad._attn_pallas_block(384, 32, 500, 512, 1024) is None
     assert ad._attn_pallas_block(384, 30, 512, 512, 1024) is None
     # a batch with no sublane-aligned divisor

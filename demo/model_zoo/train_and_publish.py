@@ -33,6 +33,9 @@ def main(argv=None):
     ap.add_argument("--aot-out", default="",
                     help="also export a framework-free AOT artifact "
                          "(StableHLO + embedded weights; jax-only loader)")
+    ap.add_argument("--aot-hlo-out", default="",
+                    help="also export the PYTHON-FREE C-host bundle "
+                         "(HloModuleProto + io.txt; run with csrc/aot_host)")
     args = ap.parse_args(argv)
 
     nn.reset_naming()
@@ -54,13 +57,20 @@ def main(argv=None):
                 meta={"task": "cifar10", "depth": args.depth,
                       "feature_layer": "gap"})  # pre-logits global avg pool
     print("published", args.out)
+    if args.aot_out or args.aot_hlo_out:
+        example = {"pixel": np.zeros((args.batch_size, 32, 32, 3),
+                                     np.float32)}
     if args.aot_out:
         from paddle_tpu.config import export_aot
 
-        example = {"pixel": np.zeros((args.batch_size, 32, 32, 3),
-                                     np.float32)}
         export_aot(args.out, args.aot_out, example, outputs=["logits"])
         print("published AOT artifact", args.aot_out)
+    if args.aot_hlo_out:
+        from paddle_tpu.config import export_aot_hlo
+
+        export_aot_hlo(args.out, args.aot_hlo_out, example,
+                       outputs=["logits"])
+        print("published C-host bundle", args.aot_hlo_out)
 
 
 if __name__ == "__main__":

@@ -63,11 +63,16 @@ def test_model_zoo_publish_and_consume(monkeypatch, capsys, tmp_path):
     features from it with no model code (reference
     demo/model_zoo/resnet/classify.py --job=classify|extract)."""
     bundle = str(tmp_path / "zoo.bundle")
+    hlo_dir = str(tmp_path / "zoo_hlo")
     pub = os.path.join(ROOT, "demo", "model_zoo", "train_and_publish.py")
     monkeypatch.setattr(sys, "argv", [pub, "--passes", "1", "--n", "64",
-                                      "--batch-size", "16", "--out", bundle])
+                                      "--batch-size", "16", "--out", bundle,
+                                      "--aot-hlo-out", hlo_dir])
     runpy.run_path(pub, run_name="__main__")
     assert os.path.exists(bundle)
+    # the Python-free C-host bundle published alongside (csrc/aot_host.cc)
+    assert os.path.exists(os.path.join(hlo_dir, "model.hlo.pb"))
+    assert os.path.exists(os.path.join(hlo_dir, "io.txt"))
     cls = os.path.join(ROOT, "demo", "model_zoo", "classify.py")
     for job in ("classify", "extract"):
         monkeypatch.setattr(sys, "argv", [cls, "--model", bundle,

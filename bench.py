@@ -135,8 +135,15 @@ def _jaxpr_flops(fn, carry):
     (VERDICT r4 weak #4: googlenet b128 published ``mfu: null``).  Counts
     2*M*N*K per dot_general and 2*out_elems*(filter_spatial*Cin/groups) per
     conv, recursing through pjit/scan/cond/custom-vjp sub-jaxprs (scan
-    bodies multiplied by trip count — the case XLA's counter gets wrong)."""
+    bodies multiplied by trip count — the case XLA's counter gets wrong).
+
+    Sub-jaxpr recursion is the shared ``paddle_tpu.analysis`` walker:
+    per-primitive into the KNOWN key (call_jaxpr/jaxpr/branches) — the old
+    recurse-into-every-param loop double-counted primitives carrying
+    several sub-jaxprs (custom_vjp holds primal + fwd/bwd rules)."""
     import jax
+
+    from paddle_tpu.analysis import eqn_subjaxprs
 
     def count(jaxpr) -> float:
         total = 0.0
@@ -157,17 +164,14 @@ def _jaxpr_flops(fn, carry):
                 out = float(np.prod(eqn.outvars[0].aval.shape, dtype=np.float64))
                 total += 2.0 * out * k
             elif name == "cond":
+                # a cond executes ONE branch: count the worst case, not the
+                # sum (the generic walker yields every branch)
                 branches = eqn.params.get("branches", ())
                 if branches:
                     total += max(count(b.jaxpr) for b in branches)
             else:
-                mult = float(eqn.params.get("length", 1)) if name == "scan" else 1.0
-                for v in eqn.params.values():
-                    inner = getattr(v, "jaxpr", None)
-                    if inner is not None and hasattr(inner, "eqns"):
-                        total += mult * count(inner)
-                    elif hasattr(v, "eqns"):
-                        total += mult * count(v)
+                for inner, mult in eqn_subjaxprs(eqn):
+                    total += mult * count(inner)
         return total
 
     try:

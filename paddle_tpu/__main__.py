@@ -18,6 +18,10 @@ interpreter needed):
 
 Flags shared with the reference's surface: --save_dir, --start_pass,
 --num_passes, --log_period, --checkgrad_eps, --enable_timers, --profile_dir.
+
+``python -m paddle_tpu lint [--config CONF|--path DIR] ...`` runs the
+trace-time lint subsystem (paddle_tpu/analysis, docs/lint.md) instead of a
+trainer job.
 """
 
 from __future__ import annotations
@@ -170,7 +174,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     from paddle_tpu.utils.devices import init
     from paddle_tpu.utils.error import ConfigError
 
-    rest = init(list(sys.argv[1:]) if argv is None else list(argv))
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        # the lint subcommand has its own argparse surface (analysis/cli.py)
+        # and must not run through the flag registry (--config clashes)
+        from paddle_tpu.analysis.cli import run as lint_run
+
+        return lint_run(argv[1:])
+    rest = init(argv)
     if rest:
         raise ConfigError(f"unrecognized arguments: {rest}")
     if FLAGS.job not in JOBS:

@@ -1,0 +1,40 @@
+"""``paddle_tpu.analysis`` — trace-time lint subsystem.
+
+The TPU-stack analog of legacy Paddle's eager ``config_parser.py``
+validation: a jaxpr auditor for compiled topologies/steps (dtype
+promotion, host transfers, constant bloat, unsharded meshes, unaligned
+Pallas tiles), an AST trace-safety linter for Python sources (tracer
+leaks/branches, trace-time impurity, retrace storms), a suppression
+plane, and the ``python -m paddle_tpu lint`` CLI.  See docs/lint.md for
+the check catalog.
+"""
+
+from paddle_tpu.analysis.findings import (Finding, SEVERITIES,
+                                          apply_allowlist, format_findings,
+                                          load_allowlist, severity_at_least)
+from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
+                                            hlo_control_flow, walk_eqns)
+from paddle_tpu.analysis.jaxpr_audit import (JAXPR_CHECKS, audit_fn,
+                                             audit_jaxpr)
+from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
+                                          lint_source)
+
+__all__ = [
+    "Finding",
+    "SEVERITIES",
+    "severity_at_least",
+    "apply_allowlist",
+    "load_allowlist",
+    "format_findings",
+    "eqn_subjaxprs",
+    "walk_eqns",
+    "find_primitives",
+    "hlo_control_flow",
+    "audit_jaxpr",
+    "audit_fn",
+    "JAXPR_CHECKS",
+    "AST_CHECKS",
+    "lint_source",
+    "lint_file",
+    "lint_path",
+]

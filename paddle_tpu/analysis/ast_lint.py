@@ -1,0 +1,385 @@
+"""AST trace-safety linter — Python-level hazards the tracer can't report well.
+
+Scans sources (``paddle_tpu/``, ``demo/``, user configs) for patterns that
+break or silently degrade under ``jax.jit``.  A function is considered a
+*jit context* when it is decorated with ``jax.jit``/``jit``/``pmap`` (also
+via ``functools.partial``) or passed by name to a ``jax.jit(...)`` call in
+the same module; nested ``def``s inside a jit context are traced too and
+inherit it.
+
+Checks (ids, severity):
+
+- ``tracer-leak`` (ERROR): ``float``/``int``/``bool``/``np.asarray``/
+  ``np.array``/np scalar ctors, or ``.item()``/``.tolist()``, applied to a
+  value derived from a jit-context parameter — concretizes a tracer
+  (``ConcretizationTypeError`` at best, a silent constant at worst).
+- ``tracer-branch`` (WARN): ``if``/``while`` on a parameter-derived value
+  inside a jit context (``is None`` tests and ``.shape``/``.ndim``/
+  ``.dtype``/``.size``/``len()`` inspection are static and exempt).
+- ``impure-call`` (WARN): ``time.time``/``datetime.now``/``np.random.*``/
+  ``random.*`` inside a jit context — evaluated ONCE at trace time, frozen
+  into the executable (the Date-impurity class).
+- ``set-iter`` (WARN): iterating a ``set`` inside a jit context —
+  nondeterministic program order across processes (pytree/eqn instability).
+- ``jit-in-loop`` (WARN): constructing ``jax.jit(...)``/``pmap(...)``
+  inside a ``for``/``while`` body anywhere — a fresh jit cache per
+  iteration (the retrace-storm class).
+
+Suppression: ``# tpu-lint: disable=<check>`` on the flagged line, or on the
+``def`` line of an enclosing function (see ``findings``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.findings import Finding, line_suppressions, suppressed
+
+__all__ = ["lint_source", "lint_file", "lint_path", "AST_CHECKS"]
+
+AST_CHECKS = ("tracer-leak", "tracer-branch", "impure-call", "set-iter",
+              "jit-in-loop")
+
+_JIT_NAMES = {"jit", "pmap", "pjit"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_NP_CASTS = {"asarray", "array", "float32", "float64", "int32", "int64",
+             "asanyarray", "ascontiguousarray"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval"}
+_IMPURE = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("random", "random"), ("random", "randint"), ("random", "uniform"),
+    ("random", "choice"), ("random", "shuffle"),
+}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _module_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local alias -> module name for numpy/jax/time/datetime/random."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _is_jit_expr(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """True when ``node`` evaluates to a jit-like transform."""
+    dotted = _dotted(node)
+    if dotted is None:
+        if isinstance(node, ast.Call):
+            # functools.partial(jax.jit, ...) / partial(jit, ...)
+            head = _dotted(node.func) or ""
+            if head.split(".")[-1] == "partial" and node.args:
+                return _is_jit_expr(node.args[0], aliases)
+        return False
+    leaf = dotted.split(".")[-1]
+    if leaf not in _JIT_NAMES:
+        return False
+    root = dotted.split(".")[0]
+    target = aliases.get(root)
+    if target is not None:
+        # import provenance is authoritative: `from numba import jit` is
+        # NOT a jax transform
+        return target == "jax" or target.startswith("jax.")
+    # bare un-imported `jit`/`pmap` (shadowed/local): assume jax's
+    return root in _JIT_NAMES
+
+
+def _jit_context_functions(tree: ast.Module,
+                           aliases: Dict[str, str]) -> List[ast.AST]:
+    """FunctionDefs that are jit contexts: decorated with a jit transform,
+    or referenced by name as the first argument of a jit call."""
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    marked: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def mark(fn: ast.AST) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            marked.append(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d, aliases) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func, aliases):
+            if node.args and isinstance(node.args[0], ast.Name):
+                for fn in defs.get(node.args[0].id, []):
+                    mark(fn)
+    return marked
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+class _TaintedNames(ast.NodeVisitor):
+    """Names used in an expression, skipping static-inspection subtrees
+    (``x.shape`` / ``len(x)`` / ``isinstance(x, ...)`` reads are trace-safe)."""
+
+    def __init__(self) -> None:
+        self.names: Set[str] = set()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return  # x.shape[0] etc. — static under trace
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        head = (_dotted(node.func) or "").split(".")[-1]
+        if head in ("len", "isinstance", "getattr", "hasattr", "type"):
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    v = _TaintedNames()
+    v.visit(node)
+    return v.names
+
+
+def _assign_targets(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for t in ast.walk(node):
+        if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+            out.add(t.id)
+    return out
+
+
+class _JitBodyLinter(ast.NodeVisitor):
+    """Walks one jit-context function body with a simple forward taint set
+    seeded from the parameters."""
+
+    def __init__(self, fn: ast.AST, aliases: Dict[str, str],
+                 filename: str) -> None:
+        self.fn = fn
+        self.aliases = aliases
+        self.filename = filename
+        self.tainted: Set[str] = _param_names(fn)
+        self.findings: List[Finding] = []
+
+    def _emit(self, check: str, severity: str, node: ast.AST,
+              message: str) -> None:
+        self.findings.append(Finding(
+            check=check, severity=severity, message=message,
+            file=self.filename, line=getattr(node, "lineno", None)))
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        return bool(_names_in(node) & self.tainted)
+
+    # -- taint propagation ------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._is_tainted(node.value):
+            self.tainted |= _assign_targets(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self._is_tainted(node.value) or self._is_tainted(node.target):
+            self.tainted |= _assign_targets(node.target)
+        self.generic_visit(node)
+
+    def _taint_for_target(self, node: ast.For) -> None:
+        # `for row in xs:` — the loop variable derives from the iterable
+        if self._is_tainted(node.iter):
+            self.tainted |= _assign_targets(node.target)
+
+    # -- checks -----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func) or ""
+        parts = dotted.split(".")
+        leaf = parts[-1]
+        root = self.aliases.get(parts[0], parts[0]) if parts[0] else ""
+        args_tainted = any(self._is_tainted(a) for a in node.args)
+
+        if leaf in _CAST_BUILTINS and len(parts) == 1 and args_tainted:
+            self._emit("tracer-leak", "ERROR", node,
+                       f"{leaf}() on a traced value inside a jitted "
+                       f"function — concretizes the tracer")
+        elif (leaf in _NP_CASTS and root.startswith("numpy")
+              and args_tainted):
+            self._emit("tracer-leak", "ERROR", node,
+                       f"{dotted}() on a traced value inside a jitted "
+                       f"function — forces a host transfer / trace break")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in ("item", "tolist")
+              and self._is_tainted(node.func.value)):
+            self._emit("tracer-leak", "ERROR", node,
+                       f".{node.func.attr}() on a traced value inside a "
+                       f"jitted function — concretizes the tracer")
+        elif len(parts) >= 2:
+            mod = self.aliases.get(parts[0], parts[0]).split(".")[-1]
+            if (mod, leaf) in _IMPURE or \
+                    (root.startswith("numpy") and parts[-2] == "random"):
+                self._emit("impure-call", "WARN", node,
+                           f"{dotted}() inside a jitted function is "
+                           f"evaluated once at trace time and frozen into "
+                           f"the executable")
+        self.generic_visit(node)
+
+    def _branch(self, node: ast.AST, kind: str) -> None:
+        test = node.test
+        # `x is None` / `x is not None` — static trace-time dispatch
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return
+        if self._is_tainted(test):
+            self._emit("tracer-branch", "WARN", node,
+                       f"`{kind}` on a traced value inside a jitted function "
+                       f"— raises TracerBoolConversionError; use lax.cond/"
+                       f"jnp.where")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._taint_for_target(node)
+        it = node.iter
+        if isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call)
+                and (_dotted(it.func) or "") == "set"):
+            self._emit("set-iter", "WARN", node,
+                       "iterating a set inside a jitted function — "
+                       "nondeterministic eqn/pytree order across processes")
+        self.generic_visit(node)
+
+
+class _JitInLoop(ast.NodeVisitor):
+    """Module-wide: jit construction inside a loop body (retrace storm)."""
+
+    def __init__(self, aliases: Dict[str, str], filename: str) -> None:
+        self.aliases = aliases
+        self.filename = filename
+        self.findings: List[Finding] = []
+        self._loop_depth = 0
+
+    def _loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = _loop
+
+    def visit_FunctionDef(self, node) -> None:
+        # a def inside a loop resets loop context for its body
+        saved, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._loop_depth > 0 and _is_jit_expr(node.func, self.aliases):
+            self.findings.append(Finding(
+                check="jit-in-loop", severity="WARN",
+                file=self.filename, line=node.lineno,
+                message="jax.jit constructed inside a loop body — a fresh "
+                        "compile cache per iteration (retrace storm); hoist "
+                        "it out or cache the jitted callable"))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, filename: str = "<string>",
+                checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one module's source; returns findings after applying
+    ``# tpu-lint: disable=`` suppressions."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [Finding(check="syntax-error", severity="ERROR",
+                        message=f"cannot parse: {e.msg}", file=filename,
+                        line=e.lineno)]
+    aliases = _module_aliases(tree)
+    findings: List[Finding] = []
+
+    jit_fns = _jit_context_functions(tree, aliases)
+    for fn in jit_fns:
+        linter = _JitBodyLinter(fn, aliases, filename)
+        for stmt in fn.body:
+            linter.visit(stmt)
+        findings.extend(linter.findings)
+
+    loop = _JitInLoop(aliases, filename)
+    loop.visit(tree)
+    findings.extend(loop.findings)
+
+    if checks is not None:
+        allowed = set(checks)
+        findings = [f for f in findings if f.check in allowed]
+
+    sup = line_suppressions(source)
+    if sup:
+        ranges: List[Tuple[int, int]] = [
+            (n.lineno, getattr(n, "end_lineno", n.lineno))
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        findings = [f for f in findings
+                    if not suppressed(f.check, f.line, sup, ranges)]
+    return findings
+
+
+def lint_file(path: str,
+              checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), filename=path, checks=checks)
+
+
+def lint_path(path: str,
+              checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint a .py file or every .py file under a directory tree."""
+    if os.path.isfile(path):
+        return lint_file(path, checks=checks)
+    findings: List[Finding] = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git", "_native"))
+        for name in sorted(files):
+            if name.endswith(".py"):
+                findings.extend(lint_file(os.path.join(root, name),
+                                          checks=checks))
+    return findings

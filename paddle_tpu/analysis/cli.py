@@ -1,0 +1,106 @@
+"""``python -m paddle_tpu lint`` — the CLI front of the analysis subsystem.
+
+Usage:
+
+    python -m paddle_tpu lint --path paddle_tpu --format json
+    python -m paddle_tpu lint --config demo/mnist/conf.py --fail-on WARN
+    python -m paddle_tpu lint --config conf.py --allowlist .tpu-lint-allow
+
+``--path DIR`` runs the AST trace-safety linter over the tree;
+``--config CONF.py`` additionally builds the config's trainer and audits
+the closed jaxpr of its train step (the jaxpr auditor).  Both may repeat.
+With neither, the installed ``paddle_tpu`` package itself is linted.
+
+Exit status: 1 when any finding at/above ``--fail-on`` (default ERROR)
+survives suppression, else 0.  ``--fail-on NEVER`` always exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from paddle_tpu.analysis.findings import (Finding, apply_allowlist,
+                                          format_findings, load_allowlist,
+                                          severity_at_least)
+
+__all__ = ["run"]
+
+
+def _audit_config(conf_path: str) -> List[Finding]:
+    """Build the config's trainer and audit its step jaxpr; AST-lint the
+    config source as well (configs are user code running under trace)."""
+    from paddle_tpu.__main__ import _build_trainer, _first_feed, _load_config
+    from paddle_tpu.analysis.ast_lint import lint_file
+
+    findings = lint_file(conf_path)
+    try:
+        conf = _load_config(conf_path)
+        trainer = _build_trainer(conf)
+        feed = _first_feed(conf)
+    except Exception as e:
+        findings.append(Finding(
+            check="config-build", severity="ERROR", file=conf_path,
+            message=f"config failed to build a trainer: "
+                    f"{type(e).__name__}: {e}"))
+        return findings
+    label = os.path.basename(conf_path)
+    try:
+        findings.extend(trainer.audit(feed, label=f"{label}:train_step"))
+    except Exception as e:  # a step that fails to TRACE is itself a finding
+        findings.append(Finding(
+            check="config-build", severity="ERROR", file=conf_path,
+            message=f"train step failed to trace for auditing: "
+                    f"{type(e).__name__}: {e}"))
+    return findings
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu lint",
+        description="Static trace-safety linter + jaxpr auditor "
+                    "(docs/lint.md has the check catalog)")
+    p.add_argument("--config", action="append", default=[], metavar="CONF",
+                   help="audit the train step of this config (repeatable)")
+    p.add_argument("--path", action="append", default=[], metavar="DIR",
+                   help="AST-lint this file/tree (repeatable)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--fail-on", default="ERROR", type=str.upper,
+                   choices=("ERROR", "WARN", "INFO", "NEVER"),
+                   help="exit 1 when findings at/above this severity remain")
+    p.add_argument("--allowlist", metavar="FILE",
+                   help="suppression file: '<check-id> [message substring]' "
+                        "per line")
+    ns = p.parse_args(argv)
+
+    targets = list(ns.path)
+    configs = list(ns.config)
+    if not targets and not configs:
+        targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+    findings: List[Finding] = []
+    from paddle_tpu.analysis.ast_lint import lint_path
+
+    for path in targets:
+        if not os.path.exists(path):
+            findings.append(Finding(check="bad-target", severity="ERROR",
+                                    file=path, message="no such file or "
+                                    "directory"))
+            continue
+        findings.extend(lint_path(path))
+    for conf in configs:
+        findings.extend(_audit_config(conf))
+
+    if ns.allowlist:
+        findings = apply_allowlist(findings, load_allowlist(ns.allowlist))
+
+    print(format_findings(findings, ns.format))
+    if ns.fail_on == "NEVER":
+        return 0
+    return 1 if severity_at_least(findings, ns.fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

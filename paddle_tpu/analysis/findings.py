@@ -1,0 +1,177 @@
+"""Typed lint findings + the suppression plane.
+
+The analog surface: legacy Paddle's ``config_parser.py`` raised eagerly on
+bad model configs before any kernel ran.  On a JAX/XLA stack the failure
+modes worth catching early are different (silent f32 promotion, host
+transfers inside the step, constant bloat, unaligned Pallas tiles, tracer
+leaks) and they are *findings*, not exceptions: a report the CLI/CI can
+gate on, with provenance back to a source line (AST checks) or a jaxpr
+equation path (auditor checks).
+
+Suppression:
+- ``# tpu-lint: disable=<check>[,<check>...]`` (or ``disable=all``) on the
+  flagged line, or on the ``def`` line of the enclosing function to cover
+  its whole body (AST findings only — jaxpr findings have no source line).
+- an allowlist file (one entry per line, ``<check-id> [message substring]``;
+  ``#`` comments) applied to every finding, including auditor ones.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "SEVERITIES",
+    "severity_at_least",
+    "line_suppressions",
+    "load_allowlist",
+    "apply_allowlist",
+    "format_findings",
+]
+
+#: ordered weakest -> strongest
+SEVERITIES = ("INFO", "WARN", "ERROR")
+
+
+def severity_at_least(findings: Iterable["Finding"], level: str) -> List["Finding"]:
+    floor = SEVERITIES.index(level)
+    return [f for f in findings if SEVERITIES.index(f.severity) >= floor]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding.
+
+    ``file``/``line`` carry AST provenance; ``where`` carries jaxpr-eqn
+    provenance (e.g. ``train_step/eqn[12]:scan/eqn[3]:dot_general``).  A
+    finding has exactly one of the two.
+    """
+
+    check: str
+    severity: str  # one of SEVERITIES
+    message: str
+    file: Optional[str] = None
+    line: Optional[int] = None
+    where: Optional[str] = None
+
+    def location(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        return self.where or "<unknown>"
+
+    def to_dict(self) -> Dict:
+        d = {"check": self.check, "severity": self.severity,
+             "message": self.message, "location": self.location()}
+        if self.file is not None:
+            d["file"] = self.file
+            d["line"] = self.line
+        if self.where is not None:
+            d["where"] = self.where
+        return d
+
+    def format(self) -> str:
+        return f"{self.location()}: {self.severity} [{self.check}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Suppression
+# ---------------------------------------------------------------------------
+
+_DIRECTIVE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-,*]+|all)")
+
+
+def line_suppressions(source: str) -> Dict[int, frozenset]:
+    """{1-based line -> frozenset of suppressed check ids ('all' wildcard)}."""
+    out: Dict[int, frozenset] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _DIRECTIVE.search(line)
+        if m:
+            names = frozenset(n.strip() for n in m.group(1).split(",") if n.strip())
+            out[i] = names
+    return out
+
+
+def suppressed(check: str, line: Optional[int],
+               suppressions: Dict[int, frozenset],
+               func_ranges: Sequence[Tuple[int, int]] = ()) -> bool:
+    """True when ``check`` at ``line`` is silenced by a same-line directive
+    or by a directive on the ``def`` line of an enclosing function (the
+    (def_line, end_line) pairs in ``func_ranges``)."""
+
+    def hit(names: frozenset) -> bool:
+        return "all" in names or "*" in names or check in names
+
+    if line is None:
+        return False
+    names = suppressions.get(line)
+    if names and hit(names):
+        return True
+    for def_line, end_line in func_ranges:
+        if def_line <= line <= end_line:
+            names = suppressions.get(def_line)
+            if names and hit(names):
+                return True
+    return False
+
+
+def load_allowlist(path: str) -> List[Tuple[str, str]]:
+    """Parse an allowlist file into (check, message-substring) pairs; an
+    empty substring matches any message for that check."""
+    entries: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 1)
+            entries.append((parts[0], parts[1] if len(parts) > 1 else ""))
+    return entries
+
+
+def apply_allowlist(findings: Iterable[Finding],
+                    entries: Sequence[Tuple[str, str]]) -> List[Finding]:
+    def allowed(f: Finding) -> bool:
+        for check, sub in entries:
+            # substring matches the MESSAGE only — matching the formatted
+            # line would let 'tests' or 'ERROR' accidentally suppress by
+            # path/severity
+            if check in ("all", "*", f.check) and (not sub or sub in f.message):
+                return True
+        return False
+
+    return [f for f in findings if not allowed(f)]
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+
+def _counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    c = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        c[f.severity] += 1
+    return c
+
+
+def format_findings(findings: Sequence[Finding], fmt: str = "text") -> str:
+    """Render findings for the CLI: 'text' (one line per finding + summary)
+    or 'json' (machine-readable, stable keys)."""
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    ranked = sorted(findings,
+                    key=lambda f: (-order[f.severity], f.file or "",
+                                   f.line or 0, f.check))
+    if fmt == "json":
+        return json.dumps({
+            "findings": [f.to_dict() for f in ranked],
+            "counts": _counts(findings),
+        }, indent=1)
+    lines = [f.format() for f in ranked]
+    c = _counts(findings)
+    lines.append(f"{len(findings)} finding(s): {c['ERROR']} error(s), "
+                 f"{c['WARN']} warning(s), {c['INFO']} info")
+    return "\n".join(lines)

@@ -1,0 +1,296 @@
+"""Trace-time jaxpr auditor — static checks over a compiled topology/step.
+
+Legacy Paddle's ``config_parser.py`` validated model configs before any
+kernel ran; the failure modes that actually bite a JAX/XLA port are only
+visible in the traced program.  This auditor walks the closed jaxpr of a
+train step / inference forward (the same traversal ``bench.py``'s FLOPs
+walker uses — ``jaxpr_walk``) and emits typed findings:
+
+================ ======== ====================================================
+check id         severity what it catches
+================ ======== ====================================================
+dtype-promotion  WARN     a dot/conv running wholly in f32 inside a net that
+                          otherwise computes in bf16/f16 (silent promotion —
+                          2x the MXU cycles and HBM traffic)
+host-transfer    ERROR    ``device_put`` of live (non-constant) values or any
+                          ``*_callback`` inside the jitted step — a host
+                          round-trip per step
+constant-bloat   WARN     captured constants > 1 MiB folded into the
+                          executable (a closed-over batch once overflowed the
+                          remote-compile request limit; see bench.py)
+unsharded-op     WARN     a mesh with >1 device but no sharded inputs and no
+                          ``sharding_constraint`` anywhere — the step is
+                          silently replicated
+unaligned-pallas WARN     Pallas ``BlockSpec`` tiles violating the (8, 128)
+-tile                     sublane/lane alignment (partial-dim blocks only —
+                          a block spanning the full array dim is exempt)
+================ ======== ====================================================
+
+Provenance is the jaxpr-eqn path (``label/eqn[4]:scan/eqn[1]:dot_general``).
+Suppression happens at the CLI layer via the allowlist file
+(``findings.apply_allowlist``) — jaxpr findings have no source line for
+``# tpu-lint: disable`` comments to attach to.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.analysis.findings import Finding
+from paddle_tpu.analysis.jaxpr_walk import walk_eqns
+
+__all__ = ["audit_jaxpr", "audit_fn", "JAXPR_CHECKS",
+           "CONSTANT_BLOAT_BYTES"]
+
+#: constants folded into the executable above this size are flagged
+CONSTANT_BLOAT_BYTES = 1 << 20
+
+#: reduced-precision dtypes that mark a net as "low-precision by intent"
+_LOW_PRECISION = ("bfloat16", "float16")
+
+#: matmul-class primitives the MXU executes (dtype-promotion targets)
+_MXU_PRIMS = ("dot_general", "conv_general_dilated")
+
+#: primitives that imply a host round-trip inside the step
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+                   "outside_call")
+
+
+_FLOAT_NAMES = frozenset(
+    ("bfloat16", "float16", "float32", "float64", "float8_e4m3fn",
+     "float8_e5m2"))
+
+
+def _float_dtypes(eqn) -> List[str]:
+    # by NAME, not np.issubdtype: ml_dtypes' bfloat16/float8 are not
+    # subdtypes of np.floating
+    out = []
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        if dt is not None and str(dt) in _FLOAT_NAMES:
+            out.append(str(dt))
+    return out
+
+
+def _shapes(eqn) -> str:
+    dims = []
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if hasattr(aval, "shape"):
+            dims.append("x".join(map(str, aval.shape)) or "scalar")
+    return ", ".join(dims)
+
+
+# ---------------------------------------------------------------------------
+# individual checks — each (closed_jaxpr, label, ctx) -> [Finding]
+# ---------------------------------------------------------------------------
+
+
+def _check_dtype_promotion(closed, label, ctx) -> List[Finding]:
+    mxu = [(eqn, path) for eqn, path in walk_eqns(closed.jaxpr, label)
+           if eqn.primitive.name in _MXU_PRIMS]
+    low = any(any(d in _LOW_PRECISION for d in _float_dtypes(eqn))
+              for eqn, _ in mxu)
+    if not low:
+        return []  # an all-f32 net promotes nothing
+    out = []
+    for eqn, path in mxu:
+        fdts = _float_dtypes(eqn)
+        if fdts and all(d == "float32" for d in fdts):
+            out.append(Finding(
+                check="dtype-promotion", severity="WARN", where=path,
+                message=f"{eqn.primitive.name} ({_shapes(eqn)}) runs in f32 "
+                        f"inside a {'/'.join(sorted({d for e, _ in mxu for d in _float_dtypes(e) if d in _LOW_PRECISION}))} "
+                        f"net — likely silent promotion (2x MXU cycles)"))
+    return out
+
+
+def _check_host_transfer(closed, label, ctx) -> List[Finding]:
+    constvars = set(map(id, closed.jaxpr.constvars))
+    out = []
+    for eqn, path in walk_eqns(closed.jaxpr, label):
+        name = eqn.primitive.name
+        if name == "device_put":
+            # device_put of a captured constant is XLA placing weights —
+            # constant-bloat's domain, not a per-step transfer
+            live = [v for v in eqn.invars
+                    if hasattr(v, "aval") and id(v) not in constvars
+                    and type(v).__name__ != "Literal"]
+            if not live:
+                continue
+            out.append(Finding(
+                check="host-transfer", severity="ERROR", where=path,
+                message=f"device_put of a live value ({_shapes(eqn)}) inside "
+                        f"the jitted step — host<->device round-trip per step"))
+        elif name in _CALLBACK_PRIMS:
+            cb = eqn.params.get("callback")
+            out.append(Finding(
+                check="host-transfer", severity="ERROR", where=path,
+                message=f"{name} ({getattr(cb, '__name__', cb)}) inside the "
+                        f"jitted step — host callback per step"))
+    return out
+
+
+def _check_constant_bloat(closed, label, ctx) -> List[Finding]:
+    out = []
+    for i, const in enumerate(getattr(closed, "consts", ())):
+        nbytes = getattr(const, "nbytes", None)
+        if nbytes is None:
+            try:
+                nbytes = np.asarray(const).nbytes
+            except Exception:
+                continue
+        if nbytes > CONSTANT_BLOAT_BYTES:
+            shape = "x".join(map(str, np.shape(const))) or "scalar"
+            dt = getattr(const, "dtype", "?")
+            out.append(Finding(
+                check="constant-bloat", severity="WARN",
+                where=f"{label}/const[{i}]",
+                message=f"captured constant {shape} {dt} "
+                        f"({nbytes / 2**20:.1f} MiB) folded into the "
+                        f"executable — pass it as an argument instead"))
+    return out
+
+
+def _check_unsharded(closed, label, ctx) -> List[Finding]:
+    mesh = ctx.get("mesh")
+    if mesh is None or int(np.prod(list(mesh.shape.values()))) <= 1:
+        return []
+    if ctx.get("inputs_sharded"):
+        return []  # GSPMD propagates from sharded args; constraints optional
+    sharded_prims = {"sharding_constraint", "psum", "all_gather",
+                     "all_to_all", "ppermute", "reduce_scatter", "pmin",
+                     "pmax", "shard_map"}
+    biggest = None
+    for eqn, path in walk_eqns(closed.jaxpr, label):
+        if eqn.primitive.name in sharded_prims:
+            return []
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", ())
+            if len(shape) >= 2:
+                size = int(np.prod(shape))
+                if biggest is None or size > biggest[0]:
+                    biggest = (size, eqn.primitive.name, shape, path)
+    if biggest is None:
+        return []
+    size, prim, shape, path = biggest
+    ndev = int(np.prod(list(mesh.shape.values())))
+    return [Finding(
+        check="unsharded-op", severity="WARN", where=path,
+        message=f"mesh has {ndev} devices but the step carries no sharding "
+                f"constraints, collectives, or sharded inputs — largest op "
+                f"{prim} {'x'.join(map(str, shape))} runs replicated")]
+
+
+def _block_dims(block_shape) -> List[Optional[int]]:
+    dims: List[Optional[int]] = []
+    for d in block_shape:
+        dims.append(int(d) if isinstance(d, (int, np.integer)) else None)
+    return dims
+
+
+def _check_pallas_tiles(closed, label, ctx) -> List[Finding]:
+    out = []
+    seen = set()  # identical in/out block mappings -> one finding
+    for eqn, path in walk_eqns(closed.jaxpr, label):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        gm = eqn.params.get("grid_mapping")
+        mappings = getattr(gm, "block_mappings", None)
+        if not mappings:
+            continue
+        for bm in mappings:
+            dims = _block_dims(getattr(bm, "block_shape", ()))
+            arr = getattr(getattr(bm, "array_shape_dtype", None), "shape", None)
+            if len(dims) < 2:
+                continue
+            bad = []
+            # (sublane, lane) = last two block dims; a block spanning the
+            # full array dim is exempt (Mosaic pads it), as are unit dims
+            # (broadcast rows / scalar lanes)
+            for off, align, kind in ((1, 128, "lane"), (2, 8, "sublane")):
+                if off > len(dims):
+                    break
+                b = dims[-off]
+                if b is None or b <= 1 or b % align == 0:
+                    continue
+                full = arr is not None and len(arr) >= off and b == arr[-off]
+                if not full:
+                    bad.append(f"{kind} dim {b} % {align} != 0")
+            if bad:
+                shape = "x".join("?" if d is None else str(d) for d in dims)
+                key = (path, shape, tuple(bad))
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Finding(
+                    check="unaligned-pallas-tile", severity="WARN", where=path,
+                    message=f"Pallas BlockSpec tile {shape} violates (8, 128) "
+                            f"alignment: {'; '.join(bad)} — the kernel will "
+                            f"retile per sublane (slow) or fail to lower"))
+    return out
+
+
+JAXPR_CHECKS: Dict[str, Callable] = {
+    "dtype-promotion": _check_dtype_promotion,
+    "host-transfer": _check_host_transfer,
+    "constant-bloat": _check_constant_bloat,
+    "unsharded-op": _check_unsharded,
+    "unaligned-pallas-tile": _check_pallas_tiles,
+}
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def audit_jaxpr(closed, *, label: str = "step", mesh=None,
+                inputs_sharded: bool = False,
+                checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the registered checks over a ClosedJaxpr; returns findings.
+
+    ``mesh``/``inputs_sharded`` feed the unsharded-op check: pass the mesh
+    the step will run under, and whether any argument already carries a
+    non-trivial ``NamedSharding`` (GSPMD then propagates placement without
+    explicit constraints)."""
+    ctx = {"mesh": mesh, "inputs_sharded": inputs_sharded}
+    selected = JAXPR_CHECKS if checks is None else {
+        k: JAXPR_CHECKS[k] for k in checks}
+    out: List[Finding] = []
+    for fn in selected.values():
+        try:
+            out.extend(fn(closed, label, ctx))
+        except Exception as e:  # a broken check must not sink the report
+            out.append(Finding(
+                check="auditor-internal", severity="INFO", where=label,
+                message=f"check {fn.__name__} failed: "
+                        f"{type(e).__name__}: {e}"))
+    return out
+
+
+def _leaf_is_sharded(x) -> bool:
+    sh = getattr(x, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return False
+    return any(s is not None for s in spec)
+
+
+def audit_fn(fn: Callable, *args: Any, label: str = "step", mesh=None,
+             checks: Optional[Sequence[str]] = None,
+             **kwargs: Any) -> List[Finding]:
+    """Trace ``fn(*args, **kwargs)`` to a closed jaxpr and audit it.
+    Sharded arguments (NamedSharding leaves) are detected automatically
+    for the unsharded-op check."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    sharded = any(_leaf_is_sharded(leaf)
+                  for leaf in jax.tree_util.tree_leaves((args, kwargs)))
+    return audit_jaxpr(closed, label=label, mesh=mesh,
+                       inputs_sharded=sharded, checks=checks)

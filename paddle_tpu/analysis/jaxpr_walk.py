@@ -1,0 +1,106 @@
+"""Shared jaxpr traversal — ONE definition of "recurse into sub-jaxprs".
+
+Grown out of ``bench.py``'s FLOPs walker, which recursed into *every*
+jaxpr-valued param of every primitive: primitives carrying several
+sub-jaxprs (``custom_vjp_call`` holds the primal *and* fwd/bwd rules,
+``linear_solve`` holds four) were double-counted.  Here recursion is
+per-primitive into the known key — ``scan``/``while``/``cond`` get their
+trip-count/branch semantics, everything else takes the FIRST of
+``call_jaxpr``/``jaxpr``/``fun_jaxpr`` (the primal computation the
+primitive will actually execute once).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Set, Tuple
+
+__all__ = ["eqn_subjaxprs", "walk_eqns", "find_primitives"]
+
+#: primal-computation param keys, most specific first; exactly ONE is taken
+_PRIMAL_KEYS = ("call_jaxpr", "jaxpr", "fun_jaxpr")
+
+
+def _as_jaxpr(v):
+    """Unwrap ClosedJaxpr -> Jaxpr; None for non-jaxpr values."""
+    inner = getattr(v, "jaxpr", v)
+    return inner if hasattr(inner, "eqns") else None
+
+
+def eqn_subjaxprs(eqn) -> Iterator[Tuple[object, float]]:
+    """Yield ``(jaxpr, multiplier)`` for the sub-jaxprs the primitive
+    executes.  ``scan`` bodies carry their trip count as the multiplier
+    (the case XLA's own FLOPs counter gets wrong); ``cond`` yields every
+    branch with multiplier 1 — callers wanting max-over-branches (FLOPs)
+    must special-case ``cond`` themselves."""
+    name = eqn.primitive.name
+    params = eqn.params
+    if name == "scan":
+        inner = _as_jaxpr(params.get("jaxpr"))
+        if inner is not None:
+            yield inner, float(params.get("length", 1))
+        return
+    if name == "while":
+        for key in ("cond_jaxpr", "body_jaxpr"):
+            inner = _as_jaxpr(params.get(key))
+            if inner is not None:
+                yield inner, 1.0
+        return
+    if name == "cond":
+        for branch in params.get("branches", ()):
+            inner = _as_jaxpr(branch)
+            if inner is not None:
+                yield inner, 1.0
+        return
+    for key in _PRIMAL_KEYS:
+        inner = _as_jaxpr(params.get(key))
+        if inner is not None:
+            yield inner, 1.0
+            return
+    # unknown primitive without a known key: take the FIRST jaxpr-valued
+    # param only — never sum over all of them (that is the double-count)
+    for v in params.values():
+        inner = _as_jaxpr(v)
+        if inner is not None:
+            yield inner, 1.0
+            return
+
+
+def walk_eqns(jaxpr, path: str = "", *,
+              max_depth: int = 32) -> Iterator[Tuple[object, str]]:
+    """Depth-first (eqn, provenance-path) pairs over ``jaxpr`` and every
+    sub-jaxpr.  Paths look like ``eqn[4]:scan/eqn[1]:dot_general``."""
+    jaxpr = _as_jaxpr(jaxpr) or jaxpr
+    if max_depth <= 0:
+        return
+    for i, eqn in enumerate(getattr(jaxpr, "eqns", ())):
+        here = f"{path}/eqn[{i}]:{eqn.primitive.name}" if path else \
+            f"eqn[{i}]:{eqn.primitive.name}"
+        yield eqn, here
+        for inner, _mult in eqn_subjaxprs(eqn):
+            yield from walk_eqns(inner, here, max_depth=max_depth - 1)
+
+
+def find_primitives(jaxpr, names: Set[str],
+                    path: str = "") -> List[Tuple[str, str]]:
+    """All (primitive-name, path) occurrences of ``names`` anywhere in the
+    (possibly nested) jaxpr — e.g. residual scan/while after an unrolling
+    export (config/deploy._unrolled_scans verification)."""
+    return [(eqn.primitive.name, p) for eqn, p in walk_eqns(jaxpr, path)
+            if eqn.primitive.name in names]
+
+
+def hlo_control_flow(hlo_text: str) -> List[str]:
+    """Control-flow op mnemonics present in an HLO/StableHLO text dump —
+    the post-lowering half of the scan-unrolling verification: after
+    ``export_aot_hlo(unroll_scans=True)`` the module should contain no
+    ``while``/``conditional`` ops (the trace-time patch is best-effort;
+    anything that bound ``lax.scan`` early, or used ``while_loop``
+    directly, still lowers a loop)."""
+    found = []
+    for op in ("while", "conditional"):
+        # HLO text: `%x = ... while(...)`; StableHLO: `"stablehlo.while"` /
+        # `stablehlo.while(` — match the op mnemonic at a call position
+        if f" {op}(" in hlo_text or f".{op}\"" in hlo_text or \
+                f"stablehlo.{op}" in hlo_text:
+            found.append(op)
+    return found

@@ -16,6 +16,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import threading
 import zipfile
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -53,8 +54,14 @@ def merge_model(
     *,
     name: str = "model",
     meta: Optional[dict] = None,
+    example_feed: Optional[Dict[str, Any]] = None,
 ) -> str:
-    """Write config + parameters as one deployable file."""
+    """Write config + parameters as one deployable file.
+
+    With ``example_feed`` the inference forward is additionally traced
+    through the lint auditor (paddle_tpu.analysis) and the findings ride
+    the bundle manifest under ``"lint"`` — the deploy-time guardrail
+    analog of the reference's eager config validation."""
     mc = dump_model_config(topology, name)
     need = {n for n, s in topology.param_specs.items() if not s.is_state}
     missing = sorted(need - set(params))
@@ -72,6 +79,16 @@ def merge_model(
         "outputs": list(mc.output_layer_names),
         "inputs": list(mc.input_layer_names),
     }
+    if example_feed is not None:
+        outs = list(mc.output_layer_names)
+
+        def fwd(params, state, feed):
+            acts, _ = topology.apply(params, state, feed, train=False,
+                                     outputs=outs)
+            return tuple(acts[n].value for n in outs)
+
+        manifest["lint"] = _audit_export(
+            fwd, (params, state or {}, example_feed), f"{name}:forward")
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("manifest.json", json.dumps(manifest, indent=1))
@@ -172,6 +189,35 @@ def load_inference_model(path: str) -> InferenceModel:
 
 _AOT_MAGIC = "paddle_tpu.aot.v1"
 
+#: AOT exports close the trained weights over the trace on purpose —
+#: constant-bloat would flag every parameter tensor
+_AOT_CHECKS = ["dtype-promotion", "host-transfer", "unsharded-op",
+               "unaligned-pallas-tile"]
+
+
+def _audit_export(fn, args, label: str, checks: Optional[list] = None):
+    """Deploy-side lint hook: audit the export trace with the analysis
+    subsystem (docs/lint.md) and return finding dicts for the artifact
+    manifest.  Gated by ``--deploy_lint``; never fails the export — a
+    broken audit logs and returns [] so deployment is never blocked by
+    the linter itself."""
+    from paddle_tpu.utils import FLAGS, logger
+
+    if not FLAGS.deploy_lint:
+        return []
+    try:
+        from paddle_tpu.analysis import audit_fn
+
+        findings = audit_fn(fn, *args, label=label, checks=checks)
+    except Exception as e:  # noqa: BLE001 — advisory path
+        logger.warning("deploy lint audit failed (%s: %s); exporting "
+                       "without findings", type(e).__name__, e)
+        return []
+    for f in findings:
+        if f.severity == "ERROR":
+            logger.warning("deploy lint: %s", f.format())
+    return [f.to_dict() for f in findings]
+
 
 def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
                *, outputs: Optional[Sequence[str]] = None) -> str:
@@ -217,6 +263,10 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
             for a in flat_example
         ],
         "outputs": names,
+        # constant-bloat is off: embedding the weights as constants is the
+        # POINT of an AOT artifact (fn closes over the trained params)
+        "lint": _audit_export(fn, flat_example, "aot_forward",
+                              checks=_AOT_CHECKS),
     }
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as z:
@@ -265,11 +315,25 @@ class _unrolled_scans:
     artifact has static shapes, so a Python loop over the static trip
     count produces a straight-line (control-flow-free) module — useful for
     consumers that prefer or require loop-free HLO.  Patches
-    ``jax.lax.scan`` for the duration of the export trace only."""
+    ``jax.lax.scan`` for the duration of the export trace only.
+
+    BEST-EFFORT, and process-global: the patch monkeypatches the module
+    attribute, so (a) a class-level lock serializes concurrent exports —
+    two threads entering at once would otherwise capture each other's
+    patched ``scan`` as ``_orig`` and leave it installed forever; (b) code
+    that bound ``lax.scan``/``fori_loop``/``while_loop`` *before* the
+    patch (e.g. ``from jax.lax import scan`` at import time, or any
+    ``while_loop``-based op) still lowers control flow.  ``export_aot_hlo``
+    therefore verifies the lowered module afterwards (via the analysis
+    subsystem's loop scan) and warns when residual while/conditional ops
+    survive instead of silently shipping a non-straight-line artifact."""
+
+    _lock = threading.Lock()
 
     def __enter__(self):
         from jax import lax as jlax
 
+        type(self)._lock.acquire()
         self._orig = jlax.scan
 
         def scan(f, init, xs=None, length=None, reverse=False, **_kw):
@@ -297,6 +361,7 @@ class _unrolled_scans:
         from jax import lax as jlax
 
         jlax.scan = self._orig
+        type(self)._lock.release()
         return False
 
 
@@ -336,6 +401,24 @@ def export_aot_hlo(bundle_or_model, out_dir: str, example_feed: Dict[str, Any],
     if unroll_scans:
         with _unrolled_scans():
             ir = jax.jit(fn).lower(*flat_example).compiler_ir(dialect="hlo")
+        # the patch is best-effort (see _unrolled_scans): verify the
+        # LOWERED module really is loop-free and warn otherwise, so a
+        # consumer that requires straight-line HLO finds out at export
+        # time, not at load time
+        from paddle_tpu.analysis import hlo_control_flow
+        from paddle_tpu.utils import logger
+
+        try:
+            residual = hlo_control_flow(ir.as_hlo_text())
+        except Exception:  # noqa: BLE001 — verification is advisory
+            residual = []
+        if residual:
+            logger.warning(
+                "export_aot_hlo(unroll_scans=True): lowered module still "
+                "contains %s op(s) — some control flow predates the scan "
+                "patch (lax.while_loop, or scan bound before export); the "
+                "artifact is correct but not straight-line",
+                "/".join(residual))
     else:
         ir = jax.jit(fn).lower(*flat_example).compiler_ir(dialect="hlo")
     os.makedirs(out_dir, exist_ok=True)
@@ -344,6 +427,8 @@ def export_aot_hlo(bundle_or_model, out_dir: str, example_feed: Dict[str, Any],
     manifest = {
         "inputs": [{"name": k, "parts": n} for k, n in spec],
         "outputs": names,
+        "lint": _audit_export(fn, flat_example, "aot_hlo_forward",
+                              checks=_AOT_CHECKS),
     }
     with open(os.path.join(out_dir, "io.txt"), "w") as f:
         f.write("\n".join(lines) + "\n")

@@ -62,13 +62,23 @@ class DataFeeder:
         *,
         buckets: Sequence[int] = _DEFAULT_BUCKETS,
         max_len: Optional[int] = None,
+        max_nnz: Optional[int] = None,
         dtype: str = "float32",
     ) -> None:
         self.types = types
         self.feeding = feeding or {name: i for i, name in enumerate(types)}
         self.buckets = tuple(buckets)
         self.max_len = max_len
+        # per-timestep feature-bag cap for sparse *sequence* slots — a
+        # separate axis from max_len (which caps TIMESTEPS): reusing
+        # max_len as the bag cap silently truncated bags whenever
+        # sequences were capped.  None = bags never truncated (the nnz
+        # width just buckets up).
+        self.max_nnz = max_nnz
         self.dtype = dtype
+        #: running count of sparse features dropped by max_len/max_nnz
+        #: truncation (logged whenever a batch drops any)
+        self.dropped_features = 0
 
     def __call__(self, batch_rows: List[Tuple]) -> Dict[str, Any]:
         feed: Dict[str, Any] = {}
@@ -158,34 +168,58 @@ class DataFeeder:
         reference's sparse_*_vector_sequence input types) -> padded
         (ids [B,T,N], nnz [B,T], lengths [B]) for 'sparse_ids_seq', with an
         extra weights [B,T,N] slot before nnz for 'sparse_pairs_seq'.  T and
-        N are bucketed like sequence lengths."""
+        N are bucketed like sequence lengths; the bag width N is capped by
+        ``max_nnz`` (NOT ``max_len`` — that caps timesteps), and any
+        features dropped by either cap are counted in
+        ``self.dropped_features`` and logged."""
         lengths = np.asarray([len(s) for s in col], np.int32)
         T = max(int(lengths.max()) if len(lengths) else 1, 1)
-        n_max = max((len(bag) for row in col for bag in row), default=1)
         if self.max_len:
             T = min(T, self.max_len)
             lengths = np.minimum(lengths, self.max_len)
-            n_max = min(max(n_max, 1), self.max_len)
+        # width over SURVIVING timesteps only: a wide bag in a timestep
+        # max_len discards must not inflate the padded feed shape
+        n_max = max((len(bag) for i, row in enumerate(col)
+                     for bag in list(row)[: lengths[i]]), default=1)
+        if self.max_nnz:
+            n_max = min(max(n_max, 1), self.max_nnz)
         T = bucket_length(T, self.buckets)
         N = bucket_length(max(n_max, 1), self.buckets)
+        # buckets round N UP; the hard bag cap stays max_nnz itself
+        cap = min(N, self.max_nnz) if self.max_nnz else N
         B = len(col)
         ids = np.zeros((B, T, N), np.int32)
         nnz = np.zeros((B, T), np.int32)
-        if kind == "sparse_ids_seq":
-            for i, row in enumerate(col):
-                for t, bag in enumerate(list(row)[: lengths[i]]):
-                    bag = list(bag)[:N]
-                    ids[i, t, : len(bag)] = bag
-                    nnz[i, t] = len(bag)
-            return ids, nnz, lengths
-        weights = np.zeros((B, T, N), self.dtype)
+        dropped = 0
+        weights = (np.zeros((B, T, N), self.dtype)
+                   if kind != "sparse_ids_seq" else None)
         for i, row in enumerate(col):
-            for t, bag in enumerate(list(row)[: lengths[i]]):
-                bag = list(bag)[:N]
-                for j, (idx, w) in enumerate(bag):
-                    ids[i, t, j] = idx
-                    weights[i, t, j] = w
+            row = list(row)
+            for t, bag in enumerate(row):
+                bag = list(bag)
+                if t >= lengths[i]:  # timestep beyond the max_len cap
+                    dropped += len(bag)
+                    continue
+                if len(bag) > cap:
+                    dropped += len(bag) - cap
+                    bag = bag[:cap]
+                if weights is None:
+                    ids[i, t, : len(bag)] = bag
+                else:
+                    for j, (idx, w) in enumerate(bag):
+                        ids[i, t, j] = idx
+                        weights[i, t, j] = w
                 nnz[i, t] = len(bag)
+        if dropped:
+            from paddle_tpu.utils.log import logger
+
+            self.dropped_features += dropped
+            logger.warning(
+                "DataFeeder: dropped %d sparse feature(s) this batch "
+                "(max_len=%s, max_nnz=%s; %d dropped total)",
+                dropped, self.max_len, self.max_nnz, self.dropped_features)
+        if weights is None:
+            return ids, nnz, lengths
         return ids, weights, nnz, lengths
 
     def _pad_seq(self, col: List, kind: str) -> Tuple[np.ndarray, np.ndarray]:

@@ -139,8 +139,17 @@ def _ce_readout_fwd(states, w, b, labels, mask):
     # path runnable at ANY B*T (ADVICE r4: row_tile=64 traced-failed when
     # B*T wasn't a multiple of 64)
     rt = math.gcd(B * T, 64)
-    lse = logsumexp_rows_pallas(logits.reshape(B * T, V),
-                                row_tile=rt).reshape(B, T)
+    if rt < 8:
+        # ADVICE r5: a row tile below the (8, 128) sublane makes the Pallas
+        # grid as long as B*T with sublane-unaligned blocks — an untested
+        # Mosaic corner that is at best very slow.  Use the XLA reduction
+        # (identical statistics) instead of shrinking the tile.
+        lf32 = logits.astype(jnp.float32)
+        m = jnp.max(lf32, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(lf32 - m[..., None]), axis=-1))
+    else:
+        lse = logsumexp_rows_pallas(logits.reshape(B * T, V),
+                                    row_tile=rt).reshape(B, T)
     lab = jnp.expand_dims(labels.astype(jnp.int32), -1)
     tok = jnp.squeeze(jnp.take_along_axis(logits, lab, axis=-1), -1)
     per_tok = lse - tok.astype(jnp.float32)

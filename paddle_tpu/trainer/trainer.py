@@ -165,6 +165,8 @@ class SGDTrainer:
             new_params = apply_masks(new_params, masks)
             return loss, new_params, new_state, new_opt, extras
 
+        # kept un-jitted for the lint auditor (audit() re-traces it)
+        self._step_fn = step
         if self.mesh is not None:
             # params/opt slots were placed ONCE at init (or after load) with
             # their rule-derived shardings; the jitted step consumes and
@@ -284,6 +286,24 @@ class SGDTrainer:
             mean, amax, mn = (float(x) for x in stats[k])
             logger.info("param %-28s mean=% .5e absmax=% .5e min=% .5e",
                         k, mean, amax, mn)
+
+    def audit(self, feed: Dict[str, Any], *, label: str = "train_step"):
+        """Run the trace-time jaxpr auditor (paddle_tpu.analysis) over this
+        trainer's full step — forward, backward, optimizer update — with
+        the given prepared feed; returns the list of Findings.
+
+        The hook behind ``python -m paddle_tpu lint --config CONF``: the
+        auditor sees exactly the program ``train_batch`` compiles (same
+        closure, same donation-free trace), so findings carry jaxpr-eqn
+        provenance into the real step."""
+        from paddle_tpu.analysis import audit_fn
+
+        if self.mesh is not None:
+            feed = self._shard_feed(feed)
+        rng = jax.random.PRNGKey(0)
+        return audit_fn(self._step_fn, self.params, self.state,
+                        self.opt_state, rng, feed,
+                        label=label, mesh=self.mesh)
 
     def train_batch(self, feed: Dict[str, Any]) -> float:
         """Run one optimizer step on a prepared feed dict; returns cost."""

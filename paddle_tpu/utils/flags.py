@@ -212,6 +212,11 @@ define_flag("check_nan", False,
             "trap NaN/Inf escaping any jitted computation (jax_debug_nans; "
             "feenableexcept analog)")
 
+# Trace-time lint subsystem (paddle_tpu/analysis; docs/lint.md)
+define_flag("deploy_lint", True,
+            "run the jaxpr auditor on every AOT/bundle export and attach "
+            "findings to the artifact manifest")
+
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")
 define_flag("profile_dir", "", "write a jax.profiler trace here during train() "

@@ -1,0 +1,72 @@
+"""Deliberately-bad trainer config — the lint subsystem's known-bad fixture.
+
+Each hazard below is planted so ``python -m paddle_tpu lint --config
+<this file>`` must report (at least) these five distinct check ids:
+
+- ``tracer-leak``        (AST): ``float(x)`` inside the jitted ``_leaky``
+- ``host-transfer``      (jaxpr): ``jax.device_put`` inside the step
+- ``dtype-promotion``    (jaxpr): an f32 dot alongside a bf16 dot
+- ``constant-bloat``     (jaxpr): a 1.5 MiB ndarray folded as a constant
+- ``unaligned-pallas-tile`` (jaxpr): a (4, 256) BlockSpec — sublane 4 % 8
+
+Keep every hazard feed-derived (never parameter-derived): the trainer's
+``value_and_grad`` runs over parameters only, so the planted ops trace
+into the step jaxpr without needing autodiff rules (pallas_call has none
+here).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.graph import Act, LayerOutput
+
+
+@jax.jit
+def _leaky(x):
+    return float(x)  # tracer-leak: concretizes the tracer
+
+
+# 400k f32 = ~1.5 MiB — closed over the step, folded into the executable
+_BIG = np.arange(400_000, dtype=np.float32)
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _bad_forward(ctx, params, xa, ha):
+    v = xa.value                                     # [B, 8] feed-derived
+    v = jax.device_put(v)                            # host-transfer
+    a = v.astype(jnp.bfloat16) @ jnp.full((8, 8), 0.01, jnp.bfloat16)
+    b = v @ jnp.full((8, 8), 0.01, jnp.float32)      # dtype-promotion
+    y = jnp.zeros((12, 256), jnp.float32) + b.sum()
+    y = pl.pallas_call(                              # unaligned-pallas-tile
+        _scale_kernel,
+        grid=(3,),
+        in_specs=[pl.BlockSpec((4, 256), lambda n: (n, 0))],
+        out_specs=pl.BlockSpec((4, 256), lambda n: (n, 0)),
+        out_shape=jax.ShapeDtypeStruct((12, 256), jnp.float32),
+        interpret=True,
+    )(y)
+    big = jnp.asarray(_BIG)                          # constant-bloat
+    noise = (a.astype(jnp.float32).sum() + y.sum() + big.sum()) * 0.0
+    return Act(value=ha.value + noise)
+
+
+def get_config():
+    nn.reset_naming()
+    x = nn.data("x", size=8)
+    h = nn.fc(x, 4, act="relu", name="h")  # real params so grads flow
+    bad = LayerOutput(name="bad", layer_type="bad_ops", size=4,
+                      parents=[x, h], forward=_bad_forward)
+    cost = nn.sum_cost(input=bad, name="cost")
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            yield {"x": rng.rand(4, 8).astype(np.float32)}
+
+    return {"cost": cost, "reader": reader}

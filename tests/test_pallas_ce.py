@@ -83,3 +83,60 @@ def test_gate_rejects_cpu_and_bad_shapes():
             _jax.default_backend() not in ("tpu", "axon")
     finally:
         FLAGS.use_pallas_ce = old
+
+
+def test_lse_readout_falls_back_below_sublane(monkeypatch, rng):
+    """ADVICE r5 / ops/losses.py:140 regression: when gcd(B*T, 64) < 8 the
+    row tile would drop below the (8, 128) sublane — the recorded-A/B lse
+    kernel must NOT be called (the XLA reduction takes over) and the
+    numerics must match the default XLA path exactly.  B*T odd forces
+    gcd == 1."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    def boom(*a, **k):
+        raise AssertionError("pallas lse called with a sub-sublane tile")
+
+    monkeypatch.setattr(pk, "logsumexp_rows_pallas", boom)
+    B, T, D, Vv = 3, 3, 16, 50  # B*T = 9 (odd): gcd(9, 64) == 1
+    states = jnp.asarray(rng.randn(B, T, D).astype(np.float32) * 0.3)
+    w = jnp.asarray(rng.randn(D, Vv).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.randn(Vv).astype(np.float32) * 0.1)
+    labels = jnp.asarray(rng.randint(0, Vv, (B, T)).astype(np.int32))
+    mask = jnp.asarray((np.arange(T)[None] < np.array([3, 1, 2])[:, None])
+                       .astype(np.float32))
+
+    def fused(states, w, b):
+        return L._ce_readout_fused(states, w, b, labels, mask)
+
+    def ref(states, w, b):  # the default XLA branch
+        return L.sequence_softmax_ce_readout(states, w, b, labels, mask)
+
+    monkeypatch.setattr(L, "_tiled_ce_cfg", lambda *a: None)
+    l_f, g_f = jax.value_and_grad(fused, argnums=(0, 1, 2))(states, w, b)
+    l_r, g_r = jax.value_and_grad(ref, argnums=(0, 1, 2))(states, w, b)
+    np.testing.assert_allclose(float(l_f), float(l_r), rtol=1e-6)
+    for a, c, nm in zip(g_r, g_f, ("d_states", "d_w", "d_b")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-5, atol=1e-6, err_msg=nm)
+
+
+def test_lse_readout_uses_kernel_when_sublane_aligned(monkeypatch, rng):
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    calls = []
+    orig = pk.logsumexp_rows_pallas
+
+    def spy(*a, **k):
+        calls.append(k.get("row_tile"))
+        return orig(*a, **k)
+
+    monkeypatch.setattr(pk, "logsumexp_rows_pallas", spy)
+    B, T, D, Vv = 2, 4, 16, 50  # B*T = 8: gcd(8, 64) == 8, kernel stays
+    states = jnp.asarray(rng.randn(B, T, D).astype(np.float32) * 0.3)
+    w = jnp.asarray(rng.randn(D, Vv).astype(np.float32) * 0.1)
+    b = jnp.zeros((Vv,), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, Vv, (B, T)).astype(np.int32))
+    mask = jnp.ones((B, T), jnp.float32)
+    loss = L._ce_readout_fused(states, w, b, labels, mask)
+    assert calls == [8]
+    assert np.isfinite(float(loss))

@@ -272,3 +272,39 @@ def test_v2_sparse_data_types():
     nn.reset_naming()
     lay = paddle.layer.data("bow", t)
     assert lay.meta["sparse"] == "binary"
+
+
+def test_feeder_sparse_seq_bags_survive_max_len():
+    """data/feeder.py:166 regression: max_len caps TIMESTEPS, not the
+    per-timestep feature bags — a 5-feature bag must survive max_len=2."""
+    feeder = DataFeeder({"x": "sparse_ids_seq"}, buckets=(2, 4, 8),
+                        max_len=2)
+    rows = [
+        [[1, 2, 3, 4, 5]],              # one timestep, wide bag
+        [[6], [7, 8], [9, 10]],         # three timesteps (one over the cap)
+    ]
+    ids, nnz, lengths = feeder([(r,) for r in rows])["x"]
+    np.testing.assert_array_equal(lengths, [1, 2])
+    assert nnz[0, 0] == 5 and ids.shape[2] >= 5   # bag intact
+    np.testing.assert_array_equal(ids[0, 0, :5], [1, 2, 3, 4, 5])
+    # the dropped third timestep's 2 features are counted
+    assert feeder.dropped_features == 2
+
+
+def test_feeder_sparse_seq_max_nnz_caps_bags_and_counts():
+    feeder = DataFeeder({"x": "sparse_ids_seq"}, buckets=(2, 4, 8),
+                        max_nnz=2)
+    ids, nnz, lengths = feeder([([[1, 2, 3, 4, 5], [6]],)])["x"]
+    assert ids.shape[2] == 2          # bag width capped independently
+    np.testing.assert_array_equal(nnz[0, :2], [2, 1])
+    np.testing.assert_array_equal(ids[0, 0], [1, 2])
+    assert feeder.dropped_features == 3  # 5 - 2 dropped from the wide bag
+
+    # weighted (sparse_pairs_seq) path: same cap, weights follow ids
+    feeder_w = DataFeeder({"x": "sparse_pairs_seq"}, buckets=(2, 4, 8),
+                          max_nnz=2)
+    rows = [[[(1, 0.5), (2, 1.5), (3, 2.5)]]]
+    ids, weights, nnz, lengths = feeder_w([(r,) for r in rows])["x"]
+    np.testing.assert_array_equal(ids[0, 0], [1, 2])
+    np.testing.assert_allclose(weights[0, 0], [0.5, 1.5])
+    assert nnz[0, 0] == 2 and feeder_w.dropped_features == 1

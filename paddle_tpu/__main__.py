@@ -64,12 +64,15 @@ def _first_feed(conf):
 
 
 def job_train(conf) -> int:
+    from paddle_tpu.resilience import resilient_reader
     from paddle_tpu.trainer import events as ev
     from paddle_tpu.trainer.checkpoint import latest_pass
     from paddle_tpu.utils import FLAGS, logger
 
     trainer = _build_trainer(conf)
-    if FLAGS.save_dir and FLAGS.start_pass > 0:
+    # --resume=auto self-locates inside train(); --start_pass remains the
+    # explicit-pass resume analog
+    if FLAGS.resume != "auto" and FLAGS.save_dir and FLAGS.start_pass > 0:
         resume = min(FLAGS.start_pass - 1, latest_pass(FLAGS.save_dir))
         if resume >= 0:
             logger.info("resuming from pass %d", resume)
@@ -79,13 +82,19 @@ def job_train(conf) -> int:
         if isinstance(e, ev.EndPass):
             logger.info("pass %d done: %s", e.pass_id, e.evaluator)
 
+    reader = conf["reader"]
+    if FLAGS.reader_retries > 0:
+        reader = resilient_reader(reader, max_retries=FLAGS.reader_retries)
     trainer.train(
-        conf["reader"],
+        reader,
         num_passes=FLAGS.num_passes,
         feeder=conf.get("feeder"),
         test_reader=conf.get("test_reader"),
         event_handler=handler,
+        resume="auto" if FLAGS.resume == "auto" else None,
     )
+    if trainer.preempted:
+        logger.warning("training preempted; relaunch with --resume=auto")
     return 0
 
 

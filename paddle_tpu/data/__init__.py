@@ -11,3 +11,8 @@ from paddle_tpu.data.reader import (
 from paddle_tpu.data.feeder import DataFeeder, bucket_length
 from paddle_tpu.data import datasets
 from paddle_tpu.data import provider
+
+# fault-tolerant reader decorator (retry/backoff/skip-bad; implemented in
+# paddle_tpu/resilience/reader.py, surfaced here beside the other reader
+# decorators — docs/resilience.md)
+from paddle_tpu.resilience.reader import resilient_reader

@@ -1,0 +1,60 @@
+"""``paddle_tpu.resilience`` — fault-tolerant training subsystem.
+
+Production TPU training is preemption-dominated; this package makes every
+tier of the trainer survivable (docs/resilience.md):
+
+- **checkpoint_io** — atomic, CRC-verified ``pass-%05d`` checkpoints with
+  a manifest (per-array CRC32 + original dtypes + wall-clock + meta),
+  ``keep_last_n`` retention, and a validating ``latest_pass`` that skips
+  corrupt directories;
+- **guard** — in-jit finite checks on loss and gradient global-norm with a
+  ``lax.cond`` skip of the optimizer update (no host syncs; audited by
+  ``paddle_tpu.analysis``);
+- **reader** — ``resilient_reader`` retry/backoff/skip-bad-batch wrapper;
+- **signals** — SIGTERM/SIGINT -> checkpoint-at-batch-boundary + clean
+  exit (``PreemptionHandler``);
+- **chaos** — fault injection (corrupt/truncate checkpoints, NaN-grad
+  batches, flaky readers, simulated preemptions) proving each recovery
+  path end-to-end in tests/test_resilience.py.
+"""
+
+from paddle_tpu.resilience.errors import (CheckpointError, ReaderError,
+                                          TooManyBadSteps)
+from paddle_tpu.resilience.checkpoint_io import (MANIFEST_VERSION,
+                                                 latest_pass,
+                                                 latest_valid_pass,
+                                                 load_checkpoint,
+                                                 load_pytree, npz_safe,
+                                                 pass_dir,
+                                                 prune_checkpoints,
+                                                 read_manifest,
+                                                 save_checkpoint,
+                                                 save_pytree,
+                                                 validate_checkpoint)
+from paddle_tpu.resilience.guard import global_grad_norm, guarded_update
+from paddle_tpu.resilience.reader import resilient_reader
+from paddle_tpu.resilience.signals import PreemptionHandler
+from paddle_tpu.resilience import chaos
+
+__all__ = [
+    "CheckpointError",
+    "ReaderError",
+    "TooManyBadSteps",
+    "MANIFEST_VERSION",
+    "npz_safe",
+    "save_pytree",
+    "load_pytree",
+    "save_checkpoint",
+    "load_checkpoint",
+    "read_manifest",
+    "validate_checkpoint",
+    "latest_pass",
+    "latest_valid_pass",
+    "prune_checkpoints",
+    "pass_dir",
+    "global_grad_norm",
+    "guarded_update",
+    "resilient_reader",
+    "PreemptionHandler",
+    "chaos",
+]

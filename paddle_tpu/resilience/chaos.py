@@ -1,0 +1,184 @@
+"""Fault-injection harness — the chaos half of the resilience subsystem.
+
+Every recovery path in this package is only as real as the failure that
+exercises it, so the chaos tools produce the exact faults production
+sees, deterministically:
+
+- storage: ``corrupt_file`` / ``truncate_file`` / ``corrupt_checkpoint``
+  bit-flip, truncate, or delete checkpoint payloads (the power-cut /
+  torn-write model behind atomic-save + CRC validation);
+- numerics: ``nan_feed`` / ``inject_nan_batches`` poison batch ``k``'s
+  float inputs with NaN so the loss and every gradient go non-finite (the
+  bad-step-guard model);
+- input pipeline: ``flaky_reader`` raises a chosen exception at sample
+  ``k`` for the first N attempts (the resilient-reader model);
+- scheduling: ``preempt_at`` wires a simulated preemption into the
+  trainer's event stream at batch ``k`` — via ``PreemptionHandler
+  .request()`` by default, or a REAL ``SIGTERM`` to the process with
+  ``use_signal=True``.
+
+Used by tests/test_resilience.py to prove each path end-to-end; equally
+usable interactively against a live save_dir.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "corrupt_file",
+    "truncate_file",
+    "corrupt_checkpoint",
+    "nan_feed",
+    "inject_nan_batches",
+    "flaky_reader",
+    "preempt_at",
+]
+
+
+# ---------------------------------------------------------------------------
+# storage faults
+# ---------------------------------------------------------------------------
+
+
+def corrupt_file(path: str, *, offset: Optional[int] = None,
+                 nbytes: int = 64) -> None:
+    """Bit-flip ``nbytes`` bytes in place (default: the middle of the file)
+    — the silent-corruption model CRC validation must catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    if offset is None:
+        offset = max(0, size // 2 - nbytes // 2)
+    n = min(nbytes, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(n)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def truncate_file(path: str, *, keep_bytes: Optional[int] = None,
+                  frac: float = 0.5) -> None:
+    """Cut the file to ``keep_bytes`` (or ``frac`` of its size) — the
+    torn-write / full-disk model."""
+    size = os.path.getsize(path)
+    keep = keep_bytes if keep_bytes is not None else int(size * frac)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, keep))
+
+
+def corrupt_checkpoint(ckpt_dir: str, *, target: str = "params.npz",
+                       mode: str = "corrupt") -> None:
+    """Damage one file of a checkpoint dir: ``corrupt`` (bit-flip),
+    ``truncate``, or ``delete``."""
+    path = os.path.join(ckpt_dir, target)
+    if mode == "corrupt":
+        corrupt_file(path)
+    elif mode == "truncate":
+        truncate_file(path)
+    elif mode == "delete":
+        os.remove(path)
+    else:
+        raise ValueError(f"unknown chaos mode {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# numeric faults
+# ---------------------------------------------------------------------------
+
+
+def nan_feed(batch: Any) -> Any:
+    """Recursively replace every float array's values with NaN (ints and
+    non-arrays pass through) — poisons the forward, hence loss and grads."""
+    if isinstance(batch, dict):
+        return {k: nan_feed(v) for k, v in batch.items()}
+    if isinstance(batch, tuple):
+        return tuple(nan_feed(v) for v in batch)
+    if isinstance(batch, list):
+        return [nan_feed(v) for v in batch]
+    arr = np.asarray(batch) if isinstance(batch, np.ndarray) else batch
+    if isinstance(arr, np.ndarray) and arr.dtype.kind == "f":
+        return np.full_like(arr, np.nan)
+    return batch
+
+
+def inject_nan_batches(reader: Callable, batches: Iterable[int]) -> Callable:
+    """Wrap a reader creator: batch indices in ``batches`` (per epoch) are
+    delivered NaN-poisoned via ``nan_feed``."""
+    bad = frozenset(batches)
+
+    def creator():
+        for i, b in enumerate(reader()):
+            yield nan_feed(b) if i in bad else b
+
+    return creator
+
+
+# ---------------------------------------------------------------------------
+# input-pipeline faults
+# ---------------------------------------------------------------------------
+
+
+def flaky_reader(reader: Callable, *, fail_at: int, times: int = 1,
+                 exc: Callable[..., Exception] = IOError) -> Callable:
+    """Raise ``exc`` instead of yielding sample ``fail_at``, for the first
+    ``times`` attempts ACROSS re-creations (a retry that fast-forwards back
+    to the sample sees the remaining failures, then success).
+
+    The returned iterator is RESUMABLE — the failing ``__next__`` consumes
+    the underlying record and advances the cursor (the corrupt-record-in-
+    a-file model), so ``resilient_reader(..., skip_bad=True)`` can iterate
+    past a persistently bad sample."""
+    remaining = [times]
+
+    class _Flaky:
+        def __init__(self):
+            self._it = iter(reader())
+            self._i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            i = self._i
+            self._i += 1
+            if i == fail_at and remaining[0] > 0:
+                remaining[0] -= 1
+                next(self._it)  # the bad record is consumed regardless
+                raise exc(f"chaos: injected reader failure at sample {i}")
+            return next(self._it)
+
+    return _Flaky
+
+
+# ---------------------------------------------------------------------------
+# scheduling faults
+# ---------------------------------------------------------------------------
+
+
+def preempt_at(handler, *, batch: int, pass_id: int = 0,
+               inner: Optional[Callable] = None,
+               use_signal: bool = False) -> Callable:
+    """Event-handler that delivers a preemption when batch ``batch`` of
+    pass ``pass_id`` BEGINS (the trainer then checkpoints at that batch
+    boundary, before stepping it).  ``handler`` is a PreemptionHandler;
+    with ``use_signal=True`` a real SIGTERM is sent to this process
+    instead.  ``inner`` chains the user's own event handler."""
+    from paddle_tpu.trainer import events as ev
+
+    def event_handler(e):
+        if (isinstance(e, ev.BeginIteration) and e.pass_id == pass_id
+                and e.batch_id == batch):
+            if use_signal:
+                os.kill(os.getpid(), _signal.SIGTERM)
+            else:
+                handler.request()
+        if inner is not None:
+            inner(e)
+
+    return event_handler

@@ -1,0 +1,31 @@
+"""Typed failure classes for the resilience subsystem.
+
+Each maps a recovery path to a distinct exception so callers (and the
+trainer's event plane) can attribute a failure to the tier that produced
+it: storage (``CheckpointError``), the input pipeline (``ReaderError``),
+or the numerics of the step itself (``TooManyBadSteps``).
+"""
+
+from __future__ import annotations
+
+__all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps"]
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory failed validation (missing files, CRC
+    mismatch, unreadable manifest) or could not be written atomically."""
+
+
+class ReaderError(RuntimeError):
+    """The data reader raised (or kept raising past its retry budget).
+
+    The trainer re-raises reader-side failures under this type so a
+    mid-pass crash is attributed to the input pipeline, never to the
+    train step that happened to be in flight.
+    """
+
+
+class TooManyBadSteps(RuntimeError):
+    """The bad-step guard skipped ``max_bad_steps`` consecutive updates —
+    the loss/gradients are persistently non-finite and continuing would
+    only burn accelerator time."""

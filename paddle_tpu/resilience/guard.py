@@ -1,0 +1,77 @@
+"""Bad-step guard — skip non-finite optimizer updates inside the jitted step.
+
+The mixed-precision-training discipline: one NaN/Inf loss or gradient must
+not poison the parameters forever, so the finite checks run ON DEVICE
+(``jnp.isfinite`` of the loss and of the gradient global-norm) and a
+``lax.cond`` selects between the real optimizer update and an identity
+step.  Nothing here crosses the host link — the trainer reads the skip
+flag from the step's extras at the same cadence it already pulls the loss,
+and ``analysis.audit_fn`` verifies the guarded step stays
+host-transfer-free (tests/test_resilience.py gate).
+
+The reference's analog was process-fatal FP traps
+(``feenableexcept`` in TrainerMain.cpp) — correct for debugging, wrong for
+a 10k-chip run where one flaky batch should cost one skipped step, not the
+job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["global_grad_norm", "guarded_update"]
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """L2 norm over every gradient leaf, accumulated in f32 (bf16 squares
+    overflow at ~256; the norm must be trustworthy or the finite check is
+    theater)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
+def guarded_update(
+    update_fn: Callable[[Any, Any, Any], Tuple[Any, Any]],
+    *,
+    loss,
+    grads,
+    params,
+    opt_state,
+    new_state,
+    old_state,
+) -> Tuple[Any, Any, Any, Dict[str, jnp.ndarray]]:
+    """Apply ``update_fn(params, grads, opt_state)`` only when the step is
+    finite; otherwise hold params, optimizer slots, AND layer state (a NaN
+    forward also poisons BN running stats) unchanged.
+
+    Returns ``(new_params, new_opt_state, selected_state, extras)`` where
+    extras carries device scalars: ``grad_norm`` and ``bad_step`` (1 when
+    the update was skipped).  Pure and jit/pjit-safe; both cond branches
+    are traced, only one executes.
+    """
+    gnorm = global_grad_norm(grads)
+    finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+    def _apply(op):
+        p, g, o = op
+        return update_fn(p, g, o)
+
+    def _skip(op):
+        p, _, o = op
+        return p, o
+
+    new_params, new_opt = jax.lax.cond(
+        finite, _apply, _skip, (params, grads, opt_state))
+    sel_state = jax.lax.cond(
+        finite, lambda s: s[0], lambda s: s[1], (new_state, old_state))
+    extras = {
+        "grad_norm": gnorm,
+        "bad_step": (~finite).astype(jnp.int32),
+    }
+    return new_params, new_opt, sel_state, extras

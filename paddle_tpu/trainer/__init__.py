@@ -6,5 +6,8 @@ from paddle_tpu.trainer.checkpoint import (
     save_pytree,
     load_pytree,
     latest_pass,
+    latest_valid_pass,
+    validate_checkpoint,
+    read_manifest,
 )
 from paddle_tpu.trainer.checkgrad import check_gradients

@@ -4,99 +4,25 @@ Reference: per-pass directories ``save_dir/pass-%05d/`` with one binary file
 per parameter (paddle/trainer/ParamUtil.cpp:50-96; format Parameter.h:229-244)
 and the v2 ``Parameters.to_tar`` (python/paddle/v2/parameters.py:266-285).
 
-Here a checkpoint is one compressed ``.npz`` per pytree (params, state,
-optimizer slots) keyed by flattened tree paths, plus a JSON manifest — a
-host-side format independent of device layout, so a checkpoint taken on an
-8-chip mesh restores on 1 chip (the gather happens implicitly when arrays are
-pulled to host).
+The implementation lives in :mod:`paddle_tpu.resilience.checkpoint_io` —
+checkpoints are now written atomically (temp dir + fsync + rename), carry a
+verification manifest (per-array CRC32, original dtypes, wall-clock, meta),
+enforce ``keep_last_n`` retention, and ``latest_pass``/``load_checkpoint``
+validate and skip corrupt directories.  This module remains the stable
+import surface for the trainer tier.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import re
-from typing import Any, Dict, Optional, Tuple
-
-import jax
-import numpy as np
+from paddle_tpu.resilience.checkpoint_io import (latest_pass,
+                                                 latest_valid_pass,
+                                                 load_checkpoint,
+                                                 load_pytree, npz_safe,
+                                                 read_manifest,
+                                                 save_checkpoint,
+                                                 save_pytree,
+                                                 validate_checkpoint)
 
 __all__ = ["save_pytree", "load_pytree", "save_checkpoint", "load_checkpoint",
-           "latest_pass", "npz_safe"]
-
-
-def npz_safe(a) -> np.ndarray:
-    """npz cannot represent ml_dtypes (bfloat16 etc. round-trip as raw void
-    bytes and fail to load) — store such arrays as float32; loaders cast back
-    to the target dtype, and bf16 -> f32 is lossless."""
-    arr = np.asarray(a)
-    if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
-        return arr.astype(np.float32)
-    return arr
-
-
-def _flatten(tree) -> Dict[str, np.ndarray]:
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = jax.tree_util.keystr(path)
-        flat[key] = npz_safe(leaf)
-    return flat
-
-
-def save_pytree(path: str, tree: Any) -> None:
-    np.savez_compressed(path, **_flatten(tree))
-
-
-def load_pytree(path: str, like: Any) -> Any:
-    """Restore into the structure of ``like`` (same treedef)."""
-    data = np.load(path, allow_pickle=False)
-    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
-    leaves = []
-    for path_k, leaf in paths_leaves:
-        key = jax.tree_util.keystr(path_k)
-        if key not in data:
-            raise KeyError(f"checkpoint missing {key}")
-        arr = data[key]
-        leaves.append(np.asarray(arr, dtype=np.asarray(leaf).dtype))
-    return jax.tree_util.tree_unflatten(treedef, leaves)
-
-
-def save_checkpoint(save_dir: str, pass_id: int, *, params, state=None,
-                    opt_state=None, meta: Optional[dict] = None) -> str:
-    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
-    os.makedirs(d, exist_ok=True)
-    save_pytree(os.path.join(d, "params.npz"), params)
-    if state is not None:
-        save_pytree(os.path.join(d, "state.npz"), state)
-    if opt_state is not None:
-        save_pytree(os.path.join(d, "opt_state.npz"), opt_state)
-    manifest = {"pass_id": pass_id, "has_state": state is not None,
-                "has_opt": opt_state is not None, **(meta or {})}
-    with open(os.path.join(d, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
-    return d
-
-
-def load_checkpoint(save_dir: str, pass_id: int, *, params, state=None, opt_state=None):
-    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
-    out_params = load_pytree(os.path.join(d, "params.npz"), params)
-    out_state = state
-    out_opt = opt_state
-    if state is not None and os.path.exists(os.path.join(d, "state.npz")):
-        out_state = load_pytree(os.path.join(d, "state.npz"), state)
-    if opt_state is not None and os.path.exists(os.path.join(d, "opt_state.npz")):
-        out_opt = load_pytree(os.path.join(d, "opt_state.npz"), opt_state)
-    return out_params, out_state, out_opt
-
-
-def latest_pass(save_dir: str) -> int:
-    """Highest pass id saved under save_dir, or -1 (resume support —
-    the --start_pass analog)."""
-    if not os.path.isdir(save_dir):
-        return -1
-    best = -1
-    for name in os.listdir(save_dir):
-        m = re.fullmatch(r"pass-(\d{5})", name)
-        if m:
-            best = max(best, int(m.group(1)))
-    return best
+           "latest_pass", "latest_valid_pass", "validate_checkpoint",
+           "read_manifest", "npz_safe"]

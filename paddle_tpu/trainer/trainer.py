@@ -26,8 +26,12 @@ import numpy as np
 
 from paddle_tpu.nn.graph import LayerOutput, Topology
 from paddle_tpu.param.optimizers import Optimizer, ParameterAverager, SGD
+from paddle_tpu.resilience import (PreemptionHandler, ReaderError,
+                                   TooManyBadSteps, guarded_update)
+from paddle_tpu.resilience.checkpoint_io import (latest_pass, load_checkpoint,
+                                                 read_manifest, pass_dir,
+                                                 save_checkpoint)
 from paddle_tpu.trainer import events as ev
-from paddle_tpu.trainer.checkpoint import load_checkpoint, save_checkpoint
 from paddle_tpu.utils import FLAGS, logger
 
 __all__ = ["SGDTrainer"]
@@ -51,6 +55,8 @@ class SGDTrainer:
         device_specs: Optional[Dict[str, Any]] = None,
         sharding_rules=None,
         pipeline: Optional[Dict[str, Any]] = None,
+        guard_nonfinite: Optional[bool] = None,
+        max_bad_steps: Optional[int] = None,
     ) -> None:
         # several costs train jointly (MultiNetwork analog,
         # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
@@ -124,6 +130,14 @@ class SGDTrainer:
         self.avg_params = self.averager.init_state(self.params) if self.averager else None
         if self.mesh is not None:
             self._place_sharded()
+        # bad-step guard (resilience/guard.py): skip non-finite updates
+        # inside the jitted step; counters live host-side on the trainer
+        self.guard_nonfinite = (FLAGS.guard_nonfinite if guard_nonfinite is None
+                                else bool(guard_nonfinite))
+        self.max_bad_steps = (FLAGS.max_bad_steps if max_bad_steps is None
+                              else int(max_bad_steps))
+        self.bad_steps_total = 0
+        self._bad_streak = 0
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -141,6 +155,7 @@ class SGDTrainer:
         sparse_rows, masks = self.sparse_rows, self.masks
 
         device_specs = self.device_specs
+        guard = self.guard_nonfinite
 
         def step(params, state, opt_state, rng, feed):
             def loss_fn(p):
@@ -157,12 +172,26 @@ class SGDTrainer:
             (loss, (new_state, extras)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            new_params, new_opt = opt.update(
-                params, grads, opt_state,
-                lr_scales=lr_scales, decays=decays, statics=statics,
-                sparse_rows=sparse_rows,
-            )
-            new_params = apply_masks(new_params, masks)
+
+            def do_update(p, g, o):
+                np_, no_ = opt.update(
+                    p, g, o,
+                    lr_scales=lr_scales, decays=decays, statics=statics,
+                    sparse_rows=sparse_rows,
+                )
+                return apply_masks(np_, masks), no_
+
+            if guard:
+                # finite checks on loss + grad global-norm, update skipped
+                # via lax.cond — on-device, no host round-trip (gated by
+                # the audit in tests/test_resilience.py)
+                new_params, new_opt, new_state, gextras = guarded_update(
+                    do_update, loss=loss, grads=grads, params=params,
+                    opt_state=opt_state, new_state=new_state,
+                    old_state=state)
+                extras = {**extras, **gextras}
+            else:
+                new_params, new_opt = do_update(params, grads, opt_state)
             return loss, new_params, new_state, new_opt, extras
 
         # kept un-jitted for the lint auditor (audit() re-traces it)
@@ -306,7 +335,16 @@ class SGDTrainer:
                         label=label, mesh=self.mesh)
 
     def train_batch(self, feed: Dict[str, Any]) -> float:
-        """Run one optimizer step on a prepared feed dict; returns cost."""
+        """Run one optimizer step on a prepared feed dict; returns cost.
+
+        With the bad-step guard on, a non-finite loss/grad step leaves
+        params, optimizer slots, and layer state untouched (the skip
+        happens inside the jitted step — resilience/guard.py); the skip
+        flag lands in ``_last_extras['bad_step']`` and the host-side
+        counters ``bad_steps_total``/``bad_steps_streak`` advance.  After
+        ``max_bad_steps`` CONSECUTIVE skips the step raises
+        ``TooManyBadSteps`` — persistent non-finite training cannot
+        recover by skipping."""
         self._rng, key = jax.random.split(self._rng)
         loss, self.params, self.state, self.opt_state, extras = self._step(
             self.params, self.state, self.opt_state, key, feed
@@ -314,7 +352,25 @@ class SGDTrainer:
         if self.averager is not None:
             self.avg_params = self.averager.update(self.avg_params, self.params)
         self._last_extras = extras
+        if self.guard_nonfinite and "bad_step" in extras:
+            if bool(jax.device_get(extras["bad_step"])):
+                self.bad_steps_total += 1
+                self._bad_streak += 1
+                logger.warning(
+                    "non-finite loss/grad: optimizer update skipped "
+                    "(streak %d, total %d)", self._bad_streak,
+                    self.bad_steps_total)
+                if self.max_bad_steps and self._bad_streak >= self.max_bad_steps:
+                    raise TooManyBadSteps(
+                        f"{self._bad_streak} consecutive non-finite steps "
+                        f"(max_bad_steps={self.max_bad_steps})")
+            else:
+                self._bad_streak = 0
         return loss
+
+    @property
+    def bad_steps_streak(self) -> int:
+        return self._bad_streak
 
     def train(
         self,
@@ -324,8 +380,23 @@ class SGDTrainer:
         event_handler: Optional[Callable] = None,
         feeder: Optional[Callable] = None,
         test_reader: Optional[Callable] = None,
+        resume: Optional[str] = None,
+        preemption: Optional[PreemptionHandler] = None,
     ) -> None:
         """Pass/batch loop with events — trainer.py:108-173 analog.
+
+        Fault tolerance (docs/resilience.md):
+
+        - ``resume="auto"`` (or ``--resume=auto``): restore params / state /
+          opt_state / RNG / pass-id from the newest VALID checkpoint under
+          ``FLAGS.save_dir`` and continue from there — including mid-pass,
+          at the exact batch a preemption checkpoint recorded;
+        - SIGTERM/SIGINT (or a ``preemption`` handler's ``request()``)
+          triggers an atomic checkpoint at the next batch boundary and a
+          clean return (``self.preempted`` is set);
+        - a reader exception mid-pass emits ``EndPass`` (handlers see pass
+          teardown on failure) and re-raises as ``ReaderError`` so the
+          crash is attributed to the data tier, not the step.
 
         Instrumentation mirrors the reference's Stat plane: named timers
         around data-wait / step / eval (REGISTER_TIMER in
@@ -338,25 +409,72 @@ class SGDTrainer:
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
         profiling = bool(FLAGS.profile_dir)
+
+        resume = resume or FLAGS.resume or None
+        start_pass, start_batch = FLAGS.start_pass, 0
+        if resume == "auto":
+            start_pass, start_batch = self._auto_resume()
+        elif resume is not None:
+            raise ValueError(f"resume must be None or 'auto', got {resume!r}")
+        if (preemption is None and FLAGS.save_dir
+                and FLAGS.checkpoint_on_preemption):
+            preemption = PreemptionHandler()
+        self.preempted = False
+        if preemption is not None:
+            preemption.install()
         if profiling:
             jax.profiler.start_trace(FLAGS.profile_dir)
         try:
-            for pass_id in range(FLAGS.start_pass, num_passes):
+            for pass_id in range(start_pass, num_passes):
                 handler(ev.BeginPass(pass_id))
                 costs: List[float] = []
+                loss = None
                 t0 = time.time()
-                it = iter(reader())
+
+                def _reader_failed(e: Exception):
+                    # pass teardown reaches the handlers even on failure,
+                    # and the crash is attributed to the reader tier
+                    handler(ev.EndPass(pass_id))
+                    if isinstance(e, ReaderError):
+                        return e
+                    return ReaderError(
+                        f"reader raised in pass {pass_id}: "
+                        f"{type(e).__name__}: {e}")
+
+                try:
+                    it = iter(reader())
+                except Exception as e:
+                    raise _reader_failed(e) from e
+                skip = start_batch if pass_id == start_pass else 0
+                if skip:
+                    logger.info("resuming pass %d at batch %d", pass_id, skip)
                 batch_id = 0
                 while True:
+                    if preemption is not None and preemption.requested:
+                        self._preempt_exit(pass_id, batch_id, preemption)
+                        return
                     with timer("DataWaitTimer"):
-                        data_batch = next(it, None)
+                        try:
+                            data_batch = next(it, None)
+                        except Exception as e:
+                            raise _reader_failed(e) from e
                     if data_batch is None:
                         break
+                    if skip:
+                        # fast-forward a deterministic reader to the batch
+                        # the preemption checkpoint recorded
+                        skip -= 1
+                        batch_id += 1
+                        continue
                     handler(ev.BeginIteration(pass_id, batch_id))
                     with timer("PrepareBatch"):
                         feed = feeder(data_batch) if feeder else data_batch
-                    with timer("TrainBatch", sync=lambda: loss):
-                        loss = self.train_batch(feed)
+                    try:
+                        with timer("TrainBatch", sync=lambda: loss):
+                            loss = self.train_batch(feed)
+                    except TooManyBadSteps:
+                        handler(ev.EndPass(pass_id))
+                        raise
                     cost = float(loss)
                     costs.append(cost)
                     handler(ev.EndIteration(pass_id, batch_id, cost))
@@ -395,6 +513,49 @@ class SGDTrainer:
         finally:
             if profiling:
                 jax.profiler.stop_trace()
+            if preemption is not None:
+                preemption.uninstall()
+
+    def _preempt_exit(self, pass_id: int, batch_id: int,
+                      preemption: PreemptionHandler) -> None:
+        """Preemption landed: persist an atomically-written mid-pass
+        checkpoint (manifest records ``next_batch`` so ``resume="auto"``
+        re-enters this pass at this exact batch) and return cleanly."""
+        self.preempted = True
+        if FLAGS.save_dir:
+            d = self.save(FLAGS.save_dir, pass_id,
+                          meta={"preempted": True, "next_batch": batch_id})
+            logger.warning(
+                "preemption: checkpoint saved to %s (pass %d, next batch "
+                "%d); exiting", d, pass_id, batch_id)
+        else:
+            logger.warning(
+                "preemption requested but --save_dir is unset: exiting "
+                "WITHOUT a checkpoint")
+
+    def _auto_resume(self) -> tuple:
+        """Locate the newest valid checkpoint under FLAGS.save_dir and
+        restore it; returns ``(start_pass, start_batch)``."""
+        save_dir = FLAGS.save_dir
+        if not save_dir:
+            return FLAGS.start_pass, 0
+        p = latest_pass(save_dir)
+        if p < 0:
+            logger.info("resume=auto: no valid checkpoint under %r, "
+                        "starting fresh", save_dir)
+            return FLAGS.start_pass, 0
+        # latest_pass just CRC-validated pass p: load without a second
+        # decompress-and-hash pass (restart latency sits inside the
+        # preemption grace window)
+        manifest = self.load(save_dir, p, validate=False)
+        meta = (manifest or {}).get("meta", {})
+        if meta.get("preempted"):
+            nb = int(meta.get("next_batch", 0))
+            logger.info("resume=auto: preemption checkpoint pass %d, "
+                        "resuming at batch %d", p, nb)
+            return p, nb
+        logger.info("resume=auto: resuming after completed pass %d", p)
+        return p + 1, 0
 
     # ------------------------------------------------------------------
 
@@ -497,17 +658,57 @@ class SGDTrainer:
 
     # ------------------------------------------------------------------
 
-    def save(self, save_dir: str, pass_id: int) -> str:
+    def save(self, save_dir: str, pass_id: int,
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        """Atomic, CRC-manifested checkpoint (resilience/checkpoint_io.py):
+        params + state + optimizer slots + averaged params, with the RNG
+        key in the manifest so a resumed run continues the exact random
+        stream.  Retention (``FLAGS.keep_last_n``) prunes old passes."""
+        meta = dict(meta or {})
+        meta.setdefault("rng_key", self._rng_to_list(self._rng))
+        extra = {}
+        if self.avg_params is not None:
+            extra["avg_params"] = self.avg_params
         return save_checkpoint(
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
+            extra=extra or None, meta=meta,
         )
 
-    def load(self, save_dir: str, pass_id: int) -> None:
-        self.params, self.state, self.opt_state = load_checkpoint(
+    def load(self, save_dir: str, pass_id: int, *,
+             validate: bool = True) -> Dict[str, Any]:
+        """Validate + restore a checkpoint; raises
+        ``resilience.CheckpointError`` on corruption.  Restores the RNG
+        key when the manifest carries one; returns the manifest."""
+        extra_like = ({"avg_params": self.avg_params}
+                      if self.avg_params is not None else None)
+        out = load_checkpoint(
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
+            extra_like=extra_like, validate=validate,
         )
+        if extra_like is None:
+            self.params, self.state, self.opt_state = out
+        else:
+            self.params, self.state, self.opt_state, extras = out
+            if "avg_params" in extras:
+                self.avg_params = extras["avg_params"]
+        try:
+            manifest = read_manifest(pass_dir(save_dir, pass_id))
+        except (FileNotFoundError, ValueError):
+            manifest = {}
+        rng_key = (manifest.get("meta") or {}).get("rng_key")
+        if rng_key is not None:
+            self._rng = jnp.asarray(np.asarray(rng_key, np.uint32))
         if self.mesh is not None:
             self._place_sharded()
         self.rebuild_masks()
+        return manifest
+
+    @staticmethod
+    def _rng_to_list(key) -> List[int]:
+        try:
+            raw = np.asarray(key)
+        except TypeError:  # typed PRNG key arrays
+            raw = np.asarray(jax.random.key_data(key))
+        return [int(x) for x in raw.reshape(-1)]

@@ -173,6 +173,23 @@ define_flag("save_dir", "", "checkpoint root; pass dirs saved under it ('' = no 
 define_flag("start_pass", 0, "resume training from this pass")
 define_flag("saving_period", 1, "save checkpoint every N passes")
 
+# Fault tolerance (paddle_tpu/resilience; docs/resilience.md)
+define_flag("resume", "", "'' = --start_pass behavior; 'auto' = resume from the "
+            "newest VALID checkpoint under --save_dir (self-locating)",
+            validator=lambda v: v in ("", "auto"))
+define_flag("keep_last_n", 0, "checkpoint retention: keep only the newest N "
+            "pass dirs under --save_dir (0 = keep all)")
+define_flag("guard_nonfinite", True, "bad-step guard: skip the optimizer "
+            "update inside the jitted step when loss or grad global-norm is "
+            "non-finite (lax.cond, no host syncs)")
+define_flag("max_bad_steps", 8, "abort training after N CONSECUTIVE "
+            "guard-skipped bad steps (0 = never abort)")
+define_flag("checkpoint_on_preemption", True, "on SIGTERM/SIGINT, write an "
+            "atomic checkpoint at the next batch boundary and exit cleanly "
+            "(needs --save_dir; resume with --resume=auto)")
+define_flag("reader_retries", 0, "CLI: wrap the config's reader in "
+            "resilience.resilient_reader with this retry budget (0 = off)")
+
 # Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
 define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")
 define_flag("mesh_axes", "data", "comma-separated mesh axis names, e.g. 'data,model'")

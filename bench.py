@@ -37,15 +37,31 @@ _PEAKS = [
     ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
 ]
 
+# chip peak HBM bandwidth (bytes/s) by device_kind substring — the other
+# roofline axis for the small-batch rows
+_BW_PEAKS = [
+    ("v6 lite", 1640e9), ("v6e", 1640e9),
+    ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9), ("v5", 2765e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
 
-def _chip_peak(kind: str):
+
+def _chip_lookup(kind: str, table, default):
     k = kind.lower()
     if "tpu" not in k:
         return None
-    for sub, peak in _PEAKS:
+    for sub, val in table:
         if sub in k:
-            return peak
-    return 197e12  # unknown TPU: assume v5e-class
+            return val
+    return default
+
+
+def _chip_peak(kind: str):
+    return _chip_lookup(kind, _PEAKS, 197e12)  # unknown TPU: assume v5e
+
+
+def _chip_bw(kind: str):
+    return _chip_lookup(kind, _BW_PEAKS, 819e9)
 
 
 def _fetch(x) -> float:
@@ -203,6 +219,33 @@ def _mfu(sec, flops, peak):
     return round(flops / sec / peak, 4)
 
 
+def _roofline(sec, carry):
+    """Memory-roofline context for the small-batch rows, so memory- or
+    launch-bound rows are not misread as kernel regressions: a FLOOR
+    estimate of compulsory HBM bytes per train step — parameters read by
+    the forward (1x), gradients written (1x), then parameters plus one
+    optimizer slot re-read and re-written by the update (4x), i.e. 6x
+    param bytes total, plus the feed batch read by the forward and again
+    by the backward (2x feed bytes) — and the fraction of chip peak HBM
+    bandwidth that floor
+    implies at the measured step time.  Reading the pair: bw_frac near 1
+    with modest MFU = the row sits on the memory roofline (structural
+    ceiling); bw_frac AND mfu both low = launch-bound (the documented
+    smallnet/googlenet-b64 floor), not a kernel regression."""
+    import jax
+
+    bw = _chip_bw(jax.devices()[0].device_kind)
+    if bw is None or sec <= 0:
+        return {}
+    params, feeds = carry[0], carry[-1]
+    nbytes = lambda x: int(getattr(x, "nbytes", 0))  # no host pulls
+    pbytes = sum(nbytes(x) for x in jax.tree_util.tree_leaves(params))
+    fbytes = sum(nbytes(x) for x in jax.tree_util.tree_leaves(feeds))
+    floor = 6 * pbytes + 2 * fbytes
+    return {"bytes_floor": int(floor),
+            "bw_frac": round(floor / sec / bw, 4)}
+
+
 # ---------------------------------------------------------------------------
 # model benches
 # ---------------------------------------------------------------------------
@@ -328,7 +371,13 @@ def bench_seq2seq_decode(rtt, peak):
     readout each step, which dominates); generation has no backward, and
     each step's matmuls ride B*K=192 rows, so the expected roofline is far
     below training MFU — the number published is words/s with that
-    context."""
+    context.
+
+    Since the fused decode engine (ops/decode.py) this row runs the
+    vocab-tiled Pallas top-k+logsumexp readout under the early-exit while
+    loop; random inputs essentially never finish every beam early, so the
+    measured time is the honest full-max_len cost.  The kernel-vs-fallback
+    delta is isolated in the pallas_decode_ab row."""
     import jax
     import jax.numpy as jnp
 
@@ -497,6 +546,8 @@ def bench_smallnet(rtt, peak, batch_size=64):
         "mfu": _mfu(sec, flops, peak),
         "ms_min": round(lo * 1e3, 3),
         "ms_max": round(hi * 1e3, 3),
+        # roofline context on the small-batch row only (see _bench_image_net)
+        **(_roofline(sec, carry) if B <= 64 else {}),
     }
 
 
@@ -525,6 +576,9 @@ def _bench_image_net(rtt, peak, *, build, batch_size, hw, label, published):
         flops = _jaxpr_flops(one_step, carry)
     ms = sec * 1e3
     base = published.get(batch_size)
+    # roofline context on the small-batch rows only — the documented
+    # launch-floor cases (smallnet/alexnet/googlenet b64 analyses)
+    ctx = _roofline(sec, carry) if batch_size <= 64 else {}
     return {
         "metric": f"{label}_train_ms_per_batch(b{batch_size},{hw}px,1000cls)",
         "short": f"{label}_b{batch_size}",
@@ -534,6 +588,7 @@ def _bench_image_net(rtt, peak, *, build, batch_size, hw, label, published):
         "mfu": _mfu(sec, flops, peak),  # conv nets: no scans, XLA count exact
         "ms_min": round(lo * 1e3, 3),
         "ms_max": round(hi * 1e3, 3),
+        **ctx,
     }
 
 
@@ -647,6 +702,78 @@ def bench_pallas_lstm_ab(rtt, peak):
     }
 
 
+def bench_pallas_decode_ab(rtt, peak):
+    """A/B the fused decode engine's vocab-tiled Pallas top-k+logsumexp
+    readout vs the XLA ``top_k`` fallback at the gen bench shape — settles
+    FLAGS.use_pallas_decode (mirrors pallas_lstm_ab's winner/default_flag
+    contract).  Both variants run the SAME engine (early-exit while loop,
+    packed gather); only the per-step readout differs."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import Seq2SeqAttention
+    from paddle_tpu.utils.flags import FLAGS
+
+    B, S, K, L = 64, 32, 3, 32
+    m = Seq2SeqAttention()
+    params = m.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(3, m.src_vocab, (B, S)).astype(np.int32))
+    src_len = jnp.full((B,), S, jnp.int32)
+
+    def run_variant(use_kernel: bool):
+        # flag is read at trace time: fresh python fn -> fresh jit cache
+        def one_step(carry):
+            params, src, src_len = carry
+            toks, scores = m.beam_search(params, src, src_len, beam_size=K,
+                                         max_len=L, use_kernel=use_kernel)
+            # feed the decode back so XLA can't hoist it (see decode row)
+            src = (src + toks[:, 0, :S]) % (m.src_vocab - 3) + 3
+            return (params, src, src_len), scores.sum()
+
+        sec, _, spread = _time_chain(one_step, (params, src, src_len),
+                                     iters=10, rtt=rtt, reps=5)
+        return sec, spread
+
+    xla_sec, xla_spread = run_variant(False)
+    pallas_err = None
+    try:
+        # use_kernel=True bypasses the backend half of the gate, which off
+        # TPU would TIME the interpret-mode emulation — report the kernel
+        # unavailable instead (parity with pallas_lstm_ab's degradation)
+        if jax.default_backend() not in ("tpu", "axon"):
+            raise RuntimeError("no TPU backend: kernel variant not A/B-able")
+        pallas_sec, pallas_spread = run_variant(True)
+    except Exception as e:  # gated OR genuinely crashing: keep the reason
+        pallas_sec, pallas_spread = None, None
+        pallas_err = f"{type(e).__name__}: {e}"[:200]
+    if pallas_sec is None:
+        winner = "xla_topk"
+    elif pallas_sec < 0.95 * xla_sec:
+        winner = "pallas"
+    elif xla_sec < 0.95 * pallas_sec:
+        winner = "xla_topk"
+    else:
+        winner = "tie"
+    best = min(x for x in (xla_sec, pallas_sec) if x is not None)
+    return {
+        "metric": f"pallas_decode_ab_beam{K}_ms(B{B},S{S},L{L})",
+        "short": "pallas_decode_ab",
+        "value": round(best * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "xla_topk_ms": round(xla_sec * 1e3, 3),
+        "xla_topk_ms_min": round(xla_spread[0] * 1e3, 3),
+        "xla_topk_ms_max": round(xla_spread[1] * 1e3, 3),
+        "pallas_ms": round(pallas_sec * 1e3, 3) if pallas_sec else None,
+        "pallas_ms_min": round(pallas_spread[0] * 1e3, 3) if pallas_spread else None,
+        "pallas_ms_max": round(pallas_spread[1] * 1e3, 3) if pallas_spread else None,
+        "pallas_error": pallas_err,
+        "winner": winner,
+        "default_flag": bool(FLAGS.use_pallas_decode),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -689,6 +816,7 @@ def main() -> None:
         safe(bench_googlenet),
         safe(bench_googlenet, batch_size=256),
         safe(bench_pallas_lstm_ab),
+        safe(bench_pallas_decode_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

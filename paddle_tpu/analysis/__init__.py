@@ -14,7 +14,8 @@ from paddle_tpu.analysis.findings import (Finding, SEVERITIES,
                                           load_allowlist, severity_at_least)
 from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
                                             hlo_control_flow, walk_eqns)
-from paddle_tpu.analysis.jaxpr_audit import (JAXPR_CHECKS, audit_fn,
+from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
+                                             audit_decode, audit_fn,
                                              audit_jaxpr)
 from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
                                           lint_source)
@@ -32,6 +33,8 @@ __all__ = [
     "hlo_control_flow",
     "audit_jaxpr",
     "audit_fn",
+    "audit_decode",
+    "DECODE_CHECKS",
     "JAXPR_CHECKS",
     "AST_CHECKS",
     "lint_source",

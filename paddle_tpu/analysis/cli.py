@@ -5,11 +5,20 @@ Usage:
     python -m paddle_tpu lint --path paddle_tpu --format json
     python -m paddle_tpu lint --config demo/mnist/conf.py --fail-on WARN
     python -m paddle_tpu lint --config conf.py --allowlist .tpu-lint-allow
+    python -m paddle_tpu lint --decode B,S,K,L
 
 ``--path DIR`` runs the AST trace-safety linter over the tree;
 ``--config CONF.py`` additionally builds the config's trainer and audits
 the closed jaxpr of its train step (the jaxpr auditor).  Both may repeat.
 With neither, the installed ``paddle_tpu`` package itself is linted.
+
+``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
+generation path (Seq2SeqAttention.beam_search over the fused decode
+engine, ops/decode.py) with the decode check set — host transfers inside
+the token loop, >1 MiB folded constants, and the tile alignment of the
+vocab-tiled top-k readout kernel's BlockSpecs.  Both the kernel and the
+XLA-fallback variants are traced (the kernel in interpret mode off-TPU),
+so a serving regression fails lint on any backend.
 
 Exit status: 1 when any finding at/above ``--fail-on`` (default ERROR)
 survives suppression, else 0.  ``--fail-on NEVER`` always exits 0.
@@ -57,6 +66,57 @@ def _audit_config(conf_path: str) -> List[Finding]:
     return findings
 
 
+def _audit_decode_closure(spec: str) -> List[Finding]:
+    """Trace the flagship decode at a compact flagship-shaped model
+    (lane-aligned dims, tiled vocab — structure, not perf) and audit both
+    readout variants.  ``spec``: 'B,S,K,L' (defaults 8,8,4,8 — B*K=32
+    keeps the kernel variant inside its sublane-aligned row gate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.jaxpr_audit import audit_decode
+    from paddle_tpu.models import Seq2SeqAttention
+
+    from paddle_tpu.ops.decode import _forced_kernel_config
+
+    try:
+        dims = [int(x) for x in spec.split(",")] if spec else []
+    except ValueError:
+        return [Finding(
+            check="decode-build", severity="ERROR", file="--decode",
+            message=f"malformed --decode spec {spec!r}: expected up to four "
+                    f"comma-separated ints 'B,S,K,L'")]
+    B, S, K, L = (dims + [8, 8, 4, 8][len(dims):])[:4]
+    m = Seq2SeqAttention(src_vocab=1024, trg_vocab=1024, emb_dim=128,
+                         enc_dim=128, dec_dim=128, att_dim=128)
+    params = m.init(jax.random.PRNGKey(0))
+    src = jnp.zeros((B, S), jnp.int32)
+    src_len = jnp.full((B,), S, jnp.int32)
+    findings: List[Finding] = []
+    variants = [(False, "xla_topk")]
+    if _forced_kernel_config(B * K, m.dec_dim, m.trg_vocab, K) is not None:
+        variants.insert(0, (True, "kernel"))
+    else:
+        findings.append(Finding(
+            check="decode-build", severity="INFO", file="decode[kernel]",
+            message=f"kernel variant gated at B*K={B * K}, k={K} (needs a "
+                    f"sublane-aligned row block and k<=16) — audited the "
+                    f"XLA fallback only"))
+    for use_kernel, tag in variants:
+        try:
+            findings.extend(audit_decode(
+                lambda p, s, l, uk=use_kernel: m.beam_search(
+                    p, s, l, beam_size=K, max_len=L, use_kernel=uk),
+                params, src, src_len, label=f"decode[{tag}]:beam{K}"))
+        except Exception as e:  # a decode that fails to TRACE is a finding
+            findings.append(Finding(
+                check="decode-build", severity="ERROR",
+                file=f"decode[{tag}]",
+                message=f"decode closure failed to trace: "
+                        f"{type(e).__name__}: {e}"))
+    return findings
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu lint",
@@ -66,6 +126,10 @@ def run(argv: Optional[List[str]] = None) -> int:
                    help="audit the train step of this config (repeatable)")
     p.add_argument("--path", action="append", default=[], metavar="DIR",
                    help="AST-lint this file/tree (repeatable)")
+    p.add_argument("--decode", nargs="?", const="", default=None,
+                   metavar="B,S,K,L",
+                   help="audit the flagship fused-decode closure "
+                        "(kernel + XLA-fallback variants) at these shapes")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--fail-on", default="ERROR", type=str.upper,
                    choices=("ERROR", "WARN", "INFO", "NEVER"),
@@ -77,7 +141,7 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     targets = list(ns.path)
     configs = list(ns.config)
-    if not targets and not configs:
+    if not targets and not configs and ns.decode is None:
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -92,6 +156,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         findings.extend(lint_path(path))
     for conf in configs:
         findings.extend(_audit_config(conf))
+    if ns.decode is not None:
+        findings.extend(_audit_decode_closure(ns.decode))
 
     if ns.allowlist:
         findings = apply_allowlist(findings, load_allowlist(ns.allowlist))

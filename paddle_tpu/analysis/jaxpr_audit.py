@@ -41,8 +41,8 @@ import numpy as np
 from paddle_tpu.analysis.findings import Finding
 from paddle_tpu.analysis.jaxpr_walk import walk_eqns
 
-__all__ = ["audit_jaxpr", "audit_fn", "JAXPR_CHECKS",
-           "CONSTANT_BLOAT_BYTES"]
+__all__ = ["audit_jaxpr", "audit_fn", "audit_decode", "DECODE_CHECKS",
+           "JAXPR_CHECKS", "CONSTANT_BLOAT_BYTES"]
 
 #: constants folded into the executable above this size are flagged
 CONSTANT_BLOAT_BYTES = 1 << 20
@@ -294,3 +294,23 @@ def audit_fn(fn: Callable, *args: Any, label: str = "step", mesh=None,
                   for leaf in jax.tree_util.tree_leaves((args, kwargs)))
     return audit_jaxpr(closed, label=label, mesh=mesh,
                        inputs_sharded=sharded, checks=checks)
+
+
+#: the checks that matter for a serving/generation closure: a host
+#: round-trip per emitted token, weights folded into the executable, and
+#: the decode engine's kernel tiles.  (dtype-promotion is deliberately
+#: excluded — a decode program legitimately runs its statistics in f32,
+#: and unsharded-op needs a training mesh to mean anything.)
+DECODE_CHECKS: Sequence[str] = ("host-transfer", "constant-bloat",
+                                "unaligned-pallas-tile")
+
+
+def audit_decode(fn: Callable, *args: Any, label: str = "decode",
+                 **kwargs: Any) -> List[Finding]:
+    """Audit a decode/generation closure (``ops/decode.py`` engine output,
+    a ``SequenceGenerator`` run, a ``v2.infer`` forward) with the decode
+    check set.  The traversal sees through the engine's early-exit
+    ``while`` (``jaxpr_walk.eqn_subjaxprs`` recurses into cond/body), so
+    kernel BlockSpecs and callbacks inside the token loop are covered —
+    the acceptance bar is ERROR-free, i.e. host-transfer-free."""
+    return audit_fn(fn, *args, label=label, checks=DECODE_CHECKS, **kwargs)

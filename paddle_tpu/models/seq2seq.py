@@ -9,7 +9,8 @@ generateSequence; beam callbacks .h:73-188) and simple_attention
 
 TPU-first re-design (SURVEY.md §7 hard part (a)): the dynamic per-sequence
 unroll becomes a static-shape ``lax.scan`` over bucketed padded targets with
-masking; beam search is a fixed-``max_len`` scan maintaining [B, K] beam state
+masking; generation drives the fused decode engine (ops/decode.py) — a
+vocab-tiled Pallas top-k/logsumexp readout under an early-exit while loop
 (no host round-trips — the whole decode jits onto the chip).  The encoder's
 input projections and the decoder's readout are big batched MXU matmuls; the
 per-step recurrent matmuls are [B*K, H] x [H, 3H].
@@ -141,70 +142,59 @@ class Seq2SeqAttention:
             states, params["out_w"], params["out_b"], trg_next, trg_mask)
 
     # ------------------------------------------------------------------
+    # generation — both paths drive the fused decode engine (ops/decode.py):
+    # vocab-tiled Pallas top-k+logsumexp readout (the [B*K, V] logits and
+    # the f32 log-softmax buffer never touch HBM), all-beams-finished early
+    # exit, packed beam-state gather.  docs/decode.md has the design.
+    # ------------------------------------------------------------------
 
-    def greedy_decode(self, params, src_ids, src_len, *, max_len: int = 50):
-        """Argmax decode — returns (tokens [B, max_len], lengths [B])."""
-        toks, scores = self.beam_search(params, src_ids, src_len,
-                                        beam_size=1, max_len=max_len)
-        return toks[:, 0], scores[:, 0]
+    def _decode_step_fn(self, params, enc, enc_proj, src_mask):
+        """Engine step protocol: embed the previous token, advance the
+        attention-GRU cell, hand the pre-readout states to the engine."""
+
+        def step_fn(tokens, state):
+            y_emb = O.embedding_lookup(params["trg_emb"], tokens)
+            s_new, _ = self._dec_step(params, y_emb, state["s"], enc,
+                                      enc_proj, src_mask)
+            return s_new, {"s": s_new}
+
+        return step_fn
+
+    def greedy_decode(self, params, src_ids, src_len, *, max_len: int = 50,
+                      early_exit=None, use_kernel=None):
+        """Argmax decode — returns (tokens [B, max_len], scores [B]).
+        True fast path: B rows (no beam tiling), running argmax +
+        logsumexp; token-identical to ``beam_search(beam_size=1)``."""
+        B, S = src_ids.shape
+        src_mask = O.mask_from_lengths(src_len, S)
+        enc, enc_proj, s0 = self.encode(params, src_ids, src_mask)
+        return O.greedy_decode(
+            self._decode_step_fn(params, enc, enc_proj, src_mask),
+            O.LinearReadout(params["out_w"], params["out_b"]), {"s": s0},
+            batch_size=B, vocab_size=self.trg_vocab, max_len=max_len,
+            bos=BOS, eos=EOS, early_exit=early_exit, use_kernel=use_kernel)
 
     def beam_search(self, params, src_ids, src_len, *, beam_size: int = 3,
-                    max_len: int = 50, length_penalty: float = 0.0):
+                    max_len: int = 50, length_penalty: float = 0.0,
+                    early_exit=None, use_kernel=None):
         """Batched beam search, fully jitted: returns (tokens [B,K,max_len],
         scores [B,K]) sorted best-first.  The analog of
         RecurrentGradientMachine::generateSequence + --beam_size.
         """
         B, S = src_ids.shape
-        K, V = beam_size, self.trg_vocab
+        K = beam_size
         src_mask = O.mask_from_lengths(src_len, S)
         enc, enc_proj, s0 = self.encode(params, src_ids, src_mask)
 
-        # tile per-beam: [B,K,...] flattened to [B*K,...]
+        # statics tile per-beam once: [B,K,...] flattened to [B*K,...]
         def tile(x):
             return jnp.repeat(x, K, axis=0)
 
-        enc_t, enc_proj_t, mask_t = tile(enc), tile(enc_proj), tile(src_mask)
-        state = tile(s0)                                   # [BK, D]
-        neg_inf = jnp.asarray(-1e9, jnp.float32)
-        logp = jnp.tile(jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32)[None], (B, 1))
-        tokens = jnp.full((B, K, max_len + 1), EOS, jnp.int32).at[:, :, 0].set(BOS)
-        finished = jnp.zeros((B, K), bool)
-
-        def step(carry, t):
-            tokens, logp, state, finished = carry
-            y = jax.lax.dynamic_index_in_dim(tokens, t, axis=2, keepdims=False)  # [B,K]
-            y_emb = O.embedding_lookup(params["trg_emb"], y.reshape(B * K))
-            s_new, _ = self._dec_step(params, y_emb, state, enc_t, enc_proj_t, mask_t)
-            step_logits = O.linear(s_new, params["out_w"], params["out_b"])
-            step_logp = jax.nn.log_softmax(step_logits.astype(jnp.float32), axis=-1)
-            step_logp = step_logp.reshape(B, K, V)
-            # finished beams may only emit EOS at zero cost
-            eos_only = jnp.full((V,), -1e9, jnp.float32).at[EOS].set(0.0)
-            step_logp = jnp.where(finished[..., None], eos_only[None, None, :], step_logp)
-            cand = logp[..., None] + step_logp                     # [B,K,V]
-            flat = cand.reshape(B, K * V)
-            new_logp, flat_idx = jax.lax.top_k(flat, K)            # [B,K]
-            beam_idx = flat_idx // V                               # [B,K]
-            tok = (flat_idx % V).astype(jnp.int32)
-            # reorder beam state
-            gather = lambda x: jnp.take_along_axis(x, beam_idx, axis=1)
-            tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
-            tokens = tokens.at[:, :, t + 1].set(tok)
-            state_bk = s_new.reshape(B, K, -1)
-            state_bk = jnp.take_along_axis(state_bk, beam_idx[..., None], axis=1)
-            finished = gather(finished) | (tok == EOS)
-            return (tokens, new_logp, state_bk.reshape(B * K, -1), finished), None
-
-        (tokens, logp, _, finished), _ = jax.lax.scan(
-            step, (tokens, logp, state, finished), jnp.arange(max_len)
-        )
-        out = tokens[:, :, 1:]
-        if length_penalty > 0:
-            lengths = jnp.sum((out != EOS).astype(jnp.float32), axis=-1) + 1.0
-            scores = logp / jnp.power(lengths, length_penalty)
-        else:
-            scores = logp
-        order = jnp.argsort(-scores, axis=1)
-        out = jnp.take_along_axis(out, order[..., None], axis=1)
-        scores = jnp.take_along_axis(scores, order, axis=1)
-        return out, scores
+        step_fn = self._decode_step_fn(params, tile(enc), tile(enc_proj),
+                                       tile(src_mask))
+        return O.beam_decode(
+            step_fn, O.LinearReadout(params["out_w"], params["out_b"]),
+            {"s": s0}, batch_size=B, beam_size=K,
+            vocab_size=self.trg_vocab, max_len=max_len, bos=BOS, eos=EOS,
+            length_penalty=length_penalty, early_exit=early_exit,
+            use_kernel=use_kernel)

@@ -332,9 +332,19 @@ class SequenceGenerator:
 
     def generate(self, params, mems0, *, batch_size: int, beam_size: int = 3,
                  max_len: int = 50, length_penalty: float = 0.0,
-                 candidate_adjust_fn=None, drop_fn=None, return_trace: bool = False):
+                 candidate_adjust_fn=None, drop_fn=None, return_trace: bool = False,
+                 early_exit=None, use_kernel=None):
         """mems0: pytree with leading dim B. Returns (tokens [B,K,max_len],
         scores [B,K]) best-first.
+
+        Without beam-control callbacks the search runs on the fused decode
+        engine (ops/decode.py): per-row top-k + logsumexp straight from the
+        step logits (one HBM pass, no f32 log-softmax buffer; Pallas kernel
+        on TPU via ``FLAGS.use_pallas_decode``), all-beams-finished early
+        exit, packed beam-state gather — output-identical to the scan path.
+        The callback/trace protocol below needs the full [B,K,V] per-step
+        log-probs (and, for the trace, a record at every one of the
+        ``max_len`` steps), so those runs keep the fixed-length scan.
 
         Beam-search control callbacks — the analog of the reference's
         ``registerBeamSearchControlCallbacks`` / ``...StatisticsCallbacks``
@@ -361,6 +371,15 @@ class SequenceGenerator:
         """
         B, K, V = batch_size, beam_size, self.V
         step_fn = self.step_fn
+        if candidate_adjust_fn is None and drop_fn is None and not return_trace:
+            from paddle_tpu.ops.decode import (LogitsReadout, beam_decode)
+
+            return beam_decode(
+                lambda tokens, mems: step_fn(params, tokens, mems),
+                LogitsReadout(), mems0, batch_size=B, beam_size=K,
+                vocab_size=V, max_len=max_len, bos=self.bos, eos=self.eos,
+                length_penalty=length_penalty, early_exit=early_exit,
+                use_kernel=use_kernel)
 
         def tile(x):
             return jnp.repeat(x, K, axis=0)

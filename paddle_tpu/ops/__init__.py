@@ -55,6 +55,14 @@ from paddle_tpu.ops.attention import (
     dot_product_attention,
 )
 from paddle_tpu.ops.attention_decoder import attention_gru_decoder
+from paddle_tpu.ops.decode import (
+    LinearReadout,
+    LogitsReadout,
+    beam_decode,
+    greedy_decode,
+    beam_gather,
+    decode_kernel_config,
+)
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
 from paddle_tpu.ops.sparse import (
     sparse_gather_matmul,

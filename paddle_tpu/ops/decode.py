@@ -1,0 +1,389 @@
+"""Fused decode engine — ONE generation implementation for every surface.
+
+The analog of ``RecurrentGradientMachine::generateSequence`` + ``--beam_size``
+(reference: gserver/gradientmachines/RecurrentGradientMachine.cpp:383; SWIG
+SequenceGenerator, paddle/api/PaddleAPI.h:1002).  Every user-facing
+generation path — ``models/seq2seq.py`` ``beam_search``/``greedy_decode``,
+the DSL ``SequenceGenerator`` behind ``nn/recurrent.py beam_search`` (and
+through it ``v2.infer`` over a beam_search layer) — drives this engine.
+
+What it replaces (the 13% MFU decode of BENCH_r05): a fixed-``max_len``
+``lax.scan`` whose every step materialized the full [B*K, V] logits in HBM,
+log-softmaxed them into a second f32 [B*K, V] buffer, and ``top_k``'d over
+K*V.  Here:
+
+- **Vocab-tiled readout kernel** (``ops/pallas_kernels.py``
+  ``topk_lse_readout_pallas``): the same tiling discipline as the fused
+  softmax-CE readout — ``out_w`` tiles stream through VMEM, a running
+  top-k and running logsumexp per row are maintained on-chip, and neither
+  the logits nor any f32 log-softmax buffer ever touches HBM.  Per row,
+  k values + k indices + one logsumexp come back.  Opaque step nets that
+  hand the engine pre-built logits get the one-HBM-pass variant
+  (``topk_lse_logits_pallas``).
+- **Early-exit driver**: a ``lax.while_loop`` that stops as soon as every
+  beam has emitted EOS (finished beams only extend with EOS at zero cost
+  and the token buffer is EOS-prefilled, so stopping early is
+  output-identical to running all ``max_len`` steps).  ``early_exit=False``
+  keeps a ``lax.scan`` driver — fixed trip count, unrollable for AOT
+  export (``config/deploy`` ``unroll_scans`` cannot patch a while loop).
+- **True greedy fast path**: ``greedy_decode`` runs B rows with a running
+  argmax + logsumexp — no beam tiling, no K*V top-k — and is
+  token-identical to ``beam_size=1`` beam search.
+- **Packed beam reorder**: ``beam_gather`` reorders the whole carry
+  (token buffer, state pytree, finished mask) with one fused
+  ``take_along_axis`` per dtype group instead of one gather per leaf.
+
+Per-row top-k + a small second-stage ``top_k`` over the K*k candidates is
+exactly equivalent to the reference's ``top_k`` over K*V (the global top-K
+is contained in the union of per-row top-Ks, and both stages tie-break
+toward the lower flat index like ``lax.top_k``'s stable sort), so token
+ids are bit-identical to the unfused path and scores match to float
+re-association (~1e-7).
+
+Kernel gating mirrors ``losses._tiled_ce_cfg``: TPU backend + tile-aligned
+shapes + ``FLAGS.use_pallas_decode``, with the XLA ``top_k`` fallback
+otherwise (A/B benched as ``pallas_decode_ab`` in bench.py).  The lowered
+decode fn is auditable host-transfer-free via
+``paddle_tpu.analysis.audit_decode``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "LinearReadout",
+    "LogitsReadout",
+    "beam_decode",
+    "greedy_decode",
+    "beam_gather",
+    "decode_kernel_config",
+]
+
+#: the reference's kill score for impossible candidates (nn/recurrent.py
+#: used -1e9 throughout; scores must match it exactly)
+NEG = -1e9
+
+#: static unroll bound for the kernel's running top-k (k masked-argmax
+#: passes per tile; beyond this the XLA fallback is the better program)
+_MAX_KERNEL_K = 16
+
+_V_TILE = 512
+
+
+def _row_block(n: int) -> Optional[int]:
+    return next((r for r in (512, 256, 128, 64, 32, 16, 8) if n % r == 0),
+                None)
+
+
+def decode_kernel_config(n_rows: int, depth: Optional[int], vocab: int,
+                         k: int) -> Optional[Tuple[int, int]]:
+    """Gate for the vocab-tiled top-k readout kernel: (row_block, v_tile)
+    or None for the XLA ``top_k`` fallback.  ``depth`` is the readout
+    contraction dim (None for the pre-materialized-logits variant, which
+    has no MXU operand to align).  Needs a TPU backend, the flag on,
+    lane-aligned depth, a sublane-aligned row block dividing the rows, and
+    a small static k (the kernel unrolls k merge passes per tile)."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not FLAGS.use_pallas_decode:
+        return None
+    if jax.default_backend() not in ("tpu", "axon"):
+        return None
+    return _forced_kernel_config(n_rows, depth, vocab, k)
+
+
+def _forced_kernel_config(n_rows, depth, vocab, k):
+    """Shape-only half of the gate (backend/flag checks skipped) — used by
+    tests and the A/B bench to exercise the kernel in interpret mode."""
+    if depth is not None and depth % 128:
+        return None
+    if not 1 <= k <= _MAX_KERNEL_K or vocab < k:
+        return None
+    rb = _row_block(n_rows)
+    if rb is None:
+        return None
+    return rb, _V_TILE
+
+
+def _topk_lse_xla(logits, k):
+    """XLA fallback: same (vals, idx, lse) statistics from materialized
+    logits — identical math to the pre-engine ``log_softmax`` + ``top_k``
+    path (log_softmax(x) = x - lse(x); the shift preserves order, so token
+    selection is unchanged)."""
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+    vals, idx = lax.top_k(lf, k)
+    return vals, idx.astype(jnp.int32), lse
+
+
+def _pad_cols(x, vp, value):
+    v = x.shape[-1]
+    if vp == v:
+        return x
+    return jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, vp - v),),
+                   constant_values=value)
+
+
+@dataclass(frozen=True)
+class LinearReadout:
+    """Fused-capable readout: the step net returns pre-readout states
+    [N, D]; the engine owns the [D, V] projection and never materializes
+    the logits (kernel path)."""
+
+    w: Any   # [D, V]
+    b: Any   # [V]
+
+    def __call__(self, states, k, *, use_kernel: Optional[bool] = None):
+        from paddle_tpu.ops.matmul import linear
+        from paddle_tpu.ops.numerics import mxu_cast
+
+        N, D = states.shape
+        V = int(self.w.shape[1])
+        cfg = (_forced_kernel_config(N, D, V, k) if use_kernel
+               else None if use_kernel is False
+               else decode_kernel_config(N, D, V, k))
+        if cfg is None:
+            if use_kernel:
+                raise ValueError(
+                    f"decode kernel forced but shapes are gated: "
+                    f"N={N}, D={D}, V={V}, k={k}")
+            return _topk_lse_xla(linear(states, self.w, self.b), k)
+        if use_kernel is not True and V < cfg[1] // 2:
+            # tiny vocabularies: tile padding costs more than it saves
+            # (same call LogitsReadout makes for the same shape class)
+            return _topk_lse_xla(linear(states, self.w, self.b), k)
+        from paddle_tpu.ops.pallas_kernels import topk_lse_readout_pallas
+
+        rb, vt = cfg
+        sc, wc = mxu_cast(states, self.w)
+        vp = -(-V // vt) * vt
+        w_p = _pad_cols(wc, vp, 0)
+        b_p = _pad_cols(self.b.astype(jnp.float32).reshape(1, V), vp, -1e30)
+        tv, ti, lse = topk_lse_readout_pallas(sc, w_p, b_p, vocab=V, k=k,
+                                              row_block=rb, v_tile=vt)
+        return tv[:, :k], ti[:, :k], lse[:, 0]
+
+
+@dataclass(frozen=True)
+class LogitsReadout:
+    """Opaque-step readout: the step net returns full logits [N, V] (the
+    DSL beam_search layer ends in an arbitrary logits layer).  The kernel
+    still wins one pass over XLA's three (max, exp-sum, top-k) and skips
+    the f32 log-softmax buffer."""
+
+    def __call__(self, logits, k, *, use_kernel: Optional[bool] = None):
+        N, V = logits.shape
+        cfg = (_forced_kernel_config(N, None, V, k) if use_kernel
+               else None if use_kernel is False
+               else decode_kernel_config(N, None, V, k))
+        if cfg is None:
+            if use_kernel:
+                raise ValueError(
+                    f"decode kernel forced but shapes are gated: "
+                    f"N={N}, V={V}, k={k}")
+            return _topk_lse_xla(logits, k)
+        if use_kernel is not True and V < cfg[1] // 2:
+            # tiny vocabularies (DSL toy nets): tiling buys nothing
+            return _topk_lse_xla(logits, k)
+        from paddle_tpu.ops.pallas_kernels import topk_lse_logits_pallas
+
+        rb, vt = cfg
+        vp = -(-V // vt) * vt
+        l_p = _pad_cols(logits, vp, -1e30)
+        tv, ti, lse = topk_lse_logits_pallas(l_p, vocab=V, k=k,
+                                             row_block=rb, v_tile=vt)
+        return tv[:, :k], ti[:, :k], lse[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# packed beam reorder
+# ---------------------------------------------------------------------------
+
+
+def beam_gather(tree, beam_idx):
+    """Reorder every [B*K, ...] / [B, K, ...] leaf of ``tree`` by
+    ``beam_idx`` [B, K] with ONE fused ``take_along_axis`` per dtype group:
+    leaves are flattened to [B, K, F], concatenated along F per dtype,
+    gathered once, and split back — instead of XLA emitting one gather per
+    pytree leaf (the old per-leaf ``reorder`` tree_map)."""
+    B, K = beam_idx.shape
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flats = []
+    for x in leaves:
+        if x.ndim >= 2 and x.shape[0] == B and x.shape[1] == K:
+            flats.append(x.reshape(B, K, -1))
+        elif x.shape[0] == B * K:
+            flats.append(x.reshape(B, K, -1))
+        else:
+            raise ValueError(
+                f"beam_gather leaf has no beam axis: shape {x.shape} with "
+                f"B={B}, K={K}")
+    groups = {}
+    for i, f in enumerate(flats):
+        groups.setdefault(jnp.dtype(f.dtype), []).append(i)
+    out = [None] * len(leaves)
+    for dt, idxs in groups.items():
+        packed = (flats[idxs[0]] if len(idxs) == 1 else
+                  jnp.concatenate([flats[i] for i in idxs], axis=-1))
+        packed = jnp.take_along_axis(packed, beam_idx[..., None], axis=1)
+        off = 0
+        for i in idxs:
+            w = flats[i].shape[-1]
+            out[i] = packed[..., off:off + w].reshape(leaves[i].shape)
+            off += w
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# candidate helpers
+# ---------------------------------------------------------------------------
+
+
+def _eos_candidates(vocab: int, k: int, eos: int):
+    """The per-row candidate list of a FINISHED beam, in ``lax.top_k``
+    order over the reference's eos-only row (EOS at 0, everything else at
+    NEG): EOS first at zero cost, then the lowest non-EOS token ids at the
+    kill score.  Matches the unfused path's selection bit-for-bit when a
+    finished beam's junk candidates reach the global top-K."""
+    toks = [eos] + [v for v in range(vocab) if v != eos][:k - 1]
+    toks += [eos] * (k - len(toks))          # k > vocab: EOS filler
+    vals = [0.0] + [NEG] * (k - 1)
+    return (jnp.asarray(toks, jnp.int32), jnp.asarray(vals, jnp.float32))
+
+
+def _loop(cond_extra, body, carry, max_len: int, early_exit: bool):
+    """Driver control flow: a ``while_loop`` with the all-finished early
+    exit, or a fixed-trip ``scan`` (AOT-unrollable) when ``early_exit`` is
+    off.  ``carry[0]`` is the step counter."""
+    if early_exit:
+        return lax.while_loop(
+            lambda c: (c[0] < max_len) & cond_extra(c), body, carry)
+
+    def scan_step(c, _):
+        return body(c), None
+
+    out, _ = lax.scan(scan_step, carry, None, length=max_len)
+    return out
+
+
+def _resolve_early_exit(early_exit: Optional[bool]) -> bool:
+    if early_exit is not None:
+        return bool(early_exit)
+    from paddle_tpu.utils.flags import FLAGS
+
+    return bool(FLAGS.decode_early_exit)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def beam_decode(step_fn: Callable, readout, state0, *, batch_size: int,
+                beam_size: int, vocab_size: int, max_len: int,
+                bos: int = 0, eos: int = 1, length_penalty: float = 0.0,
+                early_exit: Optional[bool] = None,
+                use_kernel: Optional[bool] = None):
+    """Batched beam search over a functional step protocol.
+
+    ``step_fn(tokens [B*K] i32, state) -> (readout_input, new_state)``
+    where ``state`` is a pytree with leading dim B*K (``state0`` arrives
+    per-sequence with leading dim B and is beam-tiled here) and
+    ``readout_input`` is whatever ``readout`` consumes (pre-readout states
+    for ``LinearReadout``, full logits for ``LogitsReadout``).
+
+    Returns ``(tokens [B, K, max_len], scores [B, K])`` sorted best-first —
+    the exact output contract (and, token-for-token, the exact output) of
+    the pre-engine scan path.  ``early_exit``/``use_kernel`` default to
+    FLAGS.decode_early_exit / the ``decode_kernel_config`` gate."""
+    B, K, V = batch_size, beam_size, vocab_size
+    kr = min(K, V)                 # per-row candidates: top-K needs ≤ V
+    early = _resolve_early_exit(early_exit)
+
+    state = jax.tree_util.tree_map(lambda x: jnp.repeat(x, K, axis=0), state0)
+    logp = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)[None],
+                    (B, 1))
+    tokens = jnp.full((B, K, max_len + 1), eos, jnp.int32)
+    tokens = tokens.at[:, :, 0].set(bos)
+    finished = jnp.zeros((B, K), bool)
+    fin_toks, fin_vals = _eos_candidates(V, kr, eos)
+
+    def body(carry):
+        t, tokens, logp, state, finished = carry
+        y = lax.dynamic_index_in_dim(tokens, t, axis=2, keepdims=False)
+        r_in, state_new = step_fn(y.reshape(B * K), state)
+        vals, idx, lse = readout(r_in, kr, use_kernel=use_kernel)
+        row_logp = (vals - lse[:, None]).reshape(B, K, kr)
+        row_idx = idx.reshape(B, K, kr)
+        # finished beams may only emit EOS at zero cost
+        row_logp = jnp.where(finished[..., None], fin_vals[None, None],
+                             row_logp)
+        row_idx = jnp.where(finished[..., None], fin_toks[None, None],
+                            row_idx)
+        flat = (logp[..., None] + row_logp).reshape(B, K * kr)
+        new_logp, flat_ix = lax.top_k(flat, K)
+        beam_ix = flat_ix // kr
+        tok = jnp.take_along_axis(row_idx.reshape(B, K * kr), flat_ix,
+                                  axis=1)
+        # one packed gather reorders the whole carry
+        tokens, state_new, finished = beam_gather(
+            (tokens, state_new, finished), beam_ix)
+        tokens = tokens.at[:, :, t + 1].set(tok)
+        finished = finished | (tok == eos)
+        return t + 1, tokens, new_logp, state_new, finished
+
+    carry = (jnp.asarray(0, jnp.int32), tokens, logp, state, finished)
+    _, tokens, logp, _, _ = _loop(
+        lambda c: jnp.logical_not(jnp.all(c[4])), body, carry, max_len,
+        early)
+    out = tokens[:, :, 1:]
+    if length_penalty > 0:
+        lengths = jnp.sum((out != eos).astype(jnp.float32), axis=-1) + 1.0
+        scores = logp / jnp.power(lengths, length_penalty)
+    else:
+        scores = logp
+    order = jnp.argsort(-scores, axis=1)
+    out = jnp.take_along_axis(out, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return out, scores
+
+
+def greedy_decode(step_fn: Callable, readout, state0, *, batch_size: int,
+                  vocab_size: int, max_len: int, bos: int = 0, eos: int = 1,
+                  early_exit: Optional[bool] = None,
+                  use_kernel: Optional[bool] = None):
+    """True greedy fast path: B rows (no beam tiling), running argmax +
+    logsumexp via the same readout (k=1 — no K*V top-k anywhere), early
+    exit when every row has emitted EOS.  Token-identical to
+    ``beam_decode(beam_size=1)``'s best beam; returns
+    ``(tokens [B, max_len], scores [B])``."""
+    B, V = batch_size, vocab_size
+    early = _resolve_early_exit(early_exit)
+
+    tokens = jnp.full((B, max_len + 1), eos, jnp.int32).at[:, 0].set(bos)
+    logp = jnp.zeros((B,), jnp.float32)
+    finished = jnp.zeros((B,), bool)
+
+    def body(carry):
+        t, tokens, logp, state, finished = carry
+        y = lax.dynamic_index_in_dim(tokens, t, axis=1, keepdims=False)
+        r_in, state_new = step_fn(y, state)
+        vals, idx, lse = readout(r_in, 1, use_kernel=use_kernel)
+        tok = jnp.where(finished, eos, idx[:, 0])
+        logp = logp + jnp.where(finished, 0.0, vals[:, 0] - lse)
+        tokens = tokens.at[:, t + 1].set(tok)
+        finished = finished | (tok == eos)
+        return t + 1, tokens, logp, state_new, finished
+
+    carry = (jnp.asarray(0, jnp.int32), tokens, logp, state0, finished)
+    _, tokens, logp, _, _ = _loop(
+        lambda c: jnp.logical_not(jnp.all(c[4])), body, carry, max_len,
+        early)
+    return tokens[:, 1:], logp

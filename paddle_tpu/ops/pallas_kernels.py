@@ -27,7 +27,18 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["pallas_available", "lstm_forward_pallas", "gru_forward_pallas",
-           "attn_dec_fwd_pallas", "attn_dec_bwd_pallas"]
+           "attn_dec_fwd_pallas", "attn_dec_bwd_pallas",
+           "topk_lse_readout_pallas", "topk_lse_logits_pallas", "TOPK_LANES"]
+
+
+def _compiler_params(**kw):
+    """TPU CompilerParams across jax versions: renamed from
+    ``TPUCompilerParams`` to ``CompilerParams`` upstream — prefer the new
+    name, fall back to the old one (same fields either way)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
 
 
 def pallas_available() -> bool:
@@ -341,7 +352,7 @@ def _gru_pallas_raw(xp_tb, mask_tb, w_h, *, residuals: bool = True,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             # the bidirectional batch doubles the per-step working set past
             # Mosaic's 16 MB default scoped-VMEM limit
             vmem_limit_bytes=64 * 1024 * 1024),
@@ -608,7 +619,7 @@ def _gru_bwd_pallas_raw(dout_tb, m_tb, z_tb, hp_tb, w_t, d_hfin, *,
             jax.ShapeDtypeStruct((B, H), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(dout_tb, m_tb[..., None], z_tb, hp_tb, w_t, d_hfin)
@@ -789,7 +800,7 @@ def attn_dec_fwd_pallas(xp_y_tb, m_tb, s0, enc, enc_proj, src_mask,
             jax.ShapeDtypeStruct((T, B, D), jnp.float32),   # s_prev residual
         ],
         scratch_shapes=[pltpu.VMEM((Bb, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
@@ -942,7 +953,7 @@ def attn_dec_bwd_pallas(dout_tb, m_tb, sp_tb, r_tb, u_tb, cand_tb, q_tb,
             jax.ShapeDtypeStruct((B, D), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((Bb, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
@@ -1052,7 +1063,7 @@ def ce_readout_fwd_pallas(states_c, w_c, b_f, labels, *,
             pltpu.VMEM((Rb, 1), jnp.float32),
             pltpu.VMEM((Rb, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
@@ -1124,10 +1135,251 @@ def ce_readout_bwd_pallas(logits_c, states_c, w_c, labels, lse, scale, *,
             jax.ShapeDtypeStruct((D, Vp), jnp.float32),
             jax.ShapeDtypeStruct((1, Vp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("arbitrary",),
             # the resident d_states accumulator + states + per-tile
             # temporaries measure ~102 MB at WMT14 bench shapes
             vmem_limit_bytes=112 * 1024 * 1024),
         interpret=_interpret(),
     )(logits_c, states_c, w_c, labels, lse, scale)
+
+
+# ---------------------------------------------------------------------------
+# Fused vocab-tiled top-k + logsumexp readout — the decode engine's kernel
+# (ops/decode.py).  The unfused decode step materializes the full [B*K, V]
+# logits in HBM, log-softmaxes them in f32 (a second same-shaped buffer),
+# and top-k's over K*V — at the WMT14 gen shape that is ~46 MB of HBM
+# round-trips per emitted token for statistics that fit in a few lanes.
+# Here the vocabulary is tiled exactly like the CE readout above: each
+# [Rb, Vt] logits tile is computed on the MXU (or streamed in, for the
+# pre-materialized-logits variant) and consumed IN VMEM by
+#
+#   - an online max/sum-exp logsumexp update (flash-attention-style), and
+#   - a running top-k merge: k masked-argmax passes over (tile ∪ running),
+#     tie-broken toward the LOWEST vocab index so the selection is
+#     bit-identical to ``lax.top_k`` over the full row (stable sort).
+#
+# Neither the logits nor any f32 log-softmax buffer ever exists in HBM;
+# per row the kernel writes k values + k indices + one logsumexp.  The
+# top-k scratch rides lane-padded [Rb, TOPK_LANES] blocks (only the first
+# k lanes carry data) — Mosaic-friendly full-lane vectors instead of
+# ragged k-wide tiles.  k is a static unroll; the decode gate bounds it.
+# ---------------------------------------------------------------------------
+
+#: lane padding of the top-k scratch/output blocks (first k lanes are real)
+TOPK_LANES = 128
+
+#: index sentinel for empty top-k slots (greater than any real vocab id)
+_IDX_SENTINEL = 2 ** 30
+
+#: bias/padding value for vocab columns past V: exp underflows to exactly
+#: zero, so the logsumexp is exact; the top-k merge additionally masks pad
+#: columns to -inf so they can never be SELECTED either (a user row may
+#: carry -inf logits — constrained decoding — which would otherwise lose
+#: to a -1e30 pad and leak out-of-vocab indices)
+_PAD_NEG = -1e30
+
+
+def _topk_lse_update(l, base_col, vocab, k, m_scr, s_scr, tv_scr, ti_scr):
+    """Fold one [Rb, Vt] f32 logits tile (global column offset ``base_col``,
+    real vocabulary size ``vocab``) into the running logsumexp (m/s) and
+    top-k (tv/ti) scratches."""
+    f32 = jnp.float32
+    # --- online logsumexp ---
+    # the lse path runs on FINITE-clamped values: a tile that is entirely
+    # -inf for a row (ban-prefix constrained decoding) would otherwise
+    # poison the running stats with exp(-inf - -inf) = nan.  Clamped
+    # entries contribute exp(finfo.min - m) == 0 exactly once any finite
+    # logit has been seen, so the statistics stay exact; an all--inf row
+    # yields ~finfo.min instead of the reference's nan (documented edge).
+    lo = jnp.finfo(f32).min
+    l_lse = jnp.maximum(l, lo)
+    m_old = m_scr[...]
+    m_new = jnp.maximum(m_old, jnp.max(l_lse, axis=-1, keepdims=True))
+    s_scr[...] = (s_scr[...] * jnp.exp(m_old - m_new)
+                  + jnp.sum(jnp.exp(l_lse - m_new), axis=-1, keepdims=True))
+    m_scr[...] = m_new
+    # --- running top-k merge ---
+    col = jax.lax.broadcasted_iota(jnp.int32, l.shape, 1) + base_col
+    # pad columns drop to -inf for SELECTION (not for the lse, whose exact
+    # zero contribution needs the finite -1e30): a real -inf logit then
+    # still beats them on the index tie-break, so indices stay < vocab and
+    # all--inf tails resolve to the lowest ids exactly like lax.top_k
+    tile_v = jnp.where(col < vocab, l, -jnp.inf)
+    tile_i = jnp.where(col < vocab, col, _IDX_SENTINEL)
+    run_v, run_i = tv_scr[...], ti_scr[...]
+    lane = jax.lax.broadcasted_iota(jnp.int32, run_v.shape, 1)
+    new_v = jnp.full_like(run_v, -jnp.inf)
+    new_i = jnp.full_like(run_i, _IDX_SENTINEL)
+    for j in range(k):
+        # the arg-min over matching entries EXCLUDES sentinel-indexed slots
+        # (removed winners, empty run slots, pad columns after masking), so
+        # a legitimate -inf logit is still selectable by lowest index
+        t_m = jnp.max(tile_v, axis=-1, keepdims=True)
+        t_i = jnp.min(jnp.where(tile_v == t_m, tile_i, _IDX_SENTINEL),
+                      axis=-1, keepdims=True)
+        r_m = jnp.max(run_v, axis=-1, keepdims=True)
+        r_i = jnp.min(jnp.where(run_v == r_m, run_i, _IDX_SENTINEL),
+                      axis=-1, keepdims=True)
+        # lax.top_k tie order: equal values resolve to the lower vocab
+        # index.  Running entries come from earlier tiles (smaller ids),
+        # so on a value tie the tile wins only with a smaller index.
+        take_tile = (t_m > r_m) | ((t_m == r_m) & (t_i < r_i))
+        c_v = jnp.where(take_tile, t_m, r_m).astype(f32)
+        c_i = jnp.where(take_tile, t_i, r_i)
+        new_v = jnp.where(lane == j, c_v, new_v)
+        new_i = jnp.where(lane == j, c_i, new_i)
+        # remove the winner from its source BY INDEX (ids are unique across
+        # both): value alone is ambiguous once real -inf logits exist
+        hit_t, hit_r = tile_i == c_i, run_i == c_i
+        tile_v = jnp.where(hit_t, -jnp.inf, tile_v)
+        tile_i = jnp.where(hit_t, _IDX_SENTINEL, tile_i)
+        run_v = jnp.where(hit_r, -jnp.inf, run_v)
+        run_i = jnp.where(hit_r, _IDX_SENTINEL, run_i)
+    tv_scr[...] = new_v
+    ti_scr[...] = new_i
+
+
+def _topk_init(m_scr, s_scr, tv_scr, ti_scr):
+    # m starts at the finite f32 min (not -inf): see _topk_lse_update's
+    # clamp note.  The top-k value scratch keeps -inf (selection wants
+    # true -inf semantics for empty slots).
+    m_scr[...] = jnp.full_like(m_scr, jnp.finfo(jnp.float32).min)
+    s_scr[...] = jnp.zeros_like(s_scr)
+    tv_scr[...] = jnp.full_like(tv_scr, -jnp.inf)
+    ti_scr[...] = jnp.full_like(ti_scr, _IDX_SENTINEL)
+
+
+def _topk_emit(topv_ref, topi_ref, lse_ref, m_scr, s_scr, tv_scr, ti_scr):
+    lse_ref[...] = m_scr[...] + jnp.log(s_scr[...])
+    topv_ref[...] = tv_scr[...]
+    topi_ref[...] = ti_scr[...]
+
+
+def _topk_readout_kernel(s_ref, w_ref, b_ref, topv_ref, topi_ref, lse_ref,
+                         m_scr, s_scr, tv_scr, ti_scr, *, vocab, k, v_tile):
+    from jax.experimental import pallas as pl
+
+    v = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(v == 0)
+    def _init():
+        _topk_init(m_scr, s_scr, tv_scr, ti_scr)
+
+    l = jnp.dot(s_ref[...], w_ref[...],
+                preferred_element_type=jnp.float32) + b_ref[...]  # [Rb, Vt]
+    _topk_lse_update(l, v * v_tile, vocab, k, m_scr, s_scr, tv_scr, ti_scr)
+
+    @pl.when(v == nv - 1)
+    def _fin():
+        _topk_emit(topv_ref, topi_ref, lse_ref, m_scr, s_scr, tv_scr, ti_scr)
+
+
+def topk_lse_readout_pallas(states_c, w_p, b_p, *, vocab: int, k: int,
+                            row_block: int, v_tile: int):
+    """states_c [N, D] compute dtype, w_p [D, V'] compute dtype, b_p [1, V']
+    f32 (padded tail at -1e30), ``vocab`` the REAL V (columns >= vocab are
+    padding and can never be selected) -> (topv [N, TOPK_LANES] f32,
+    topi [N, TOPK_LANES] i32, lse [N, 1] f32).  Only the first ``k`` lanes
+    of topv/topi carry data — the caller slices ``[:, :k]``.  The [N, V']
+    logits never exist outside VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, D = states_c.shape
+    Vp = w_p.shape[1]
+    nR, nV = N // row_block, Vp // v_tile
+    Rb, Vt, L = row_block, v_tile, TOPK_LANES
+    kernel = functools.partial(_topk_readout_kernel, vocab=vocab, k=k,
+                               v_tile=Vt)
+    return pl.pallas_call(
+        kernel,
+        grid=(nR, nV),
+        in_specs=[
+            pl.BlockSpec((Rb, D), lambda r, v: (r, 0)),    # states (resident)
+            pl.BlockSpec((D, Vt), lambda r, v: (0, v)),    # w tile
+            pl.BlockSpec((1, Vt), lambda r, v: (0, v)),    # bias tile
+        ],
+        out_specs=[
+            pl.BlockSpec((Rb, L), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, L), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, 1), lambda r, v: (r, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, L), jnp.float32),
+            jax.ShapeDtypeStruct((N, L), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, L), jnp.float32),
+            pltpu.VMEM((Rb, L), jnp.int32),
+        ],
+        compiler_params=_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(states_c, w_p, b_p)
+
+
+def _topk_logits_kernel(l_ref, topv_ref, topi_ref, lse_ref,
+                        m_scr, s_scr, tv_scr, ti_scr, *, vocab, k, v_tile):
+    from jax.experimental import pallas as pl
+
+    v = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(v == 0)
+    def _init():
+        _topk_init(m_scr, s_scr, tv_scr, ti_scr)
+
+    l = l_ref[...].astype(jnp.float32)
+    _topk_lse_update(l, v * v_tile, vocab, k, m_scr, s_scr, tv_scr, ti_scr)
+
+    @pl.when(v == nv - 1)
+    def _fin():
+        _topk_emit(topv_ref, topi_ref, lse_ref, m_scr, s_scr, tv_scr, ti_scr)
+
+
+def topk_lse_logits_pallas(logits, *, vocab: int, k: int, row_block: int,
+                           v_tile: int):
+    """Pre-materialized-logits variant (opaque step nets whose readout the
+    engine cannot tile): logits [N, V'] (tail padded at -1e30, ``vocab``
+    the real V) are read ONCE instead of XLA's three passes (max, exp-sum,
+    top-k) and no f32 log-softmax buffer is ever built.  Same outputs as
+    ``topk_lse_readout_pallas``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, Vp = logits.shape
+    nR, nV = N // row_block, Vp // v_tile
+    Rb, Vt, L = row_block, v_tile, TOPK_LANES
+    kernel = functools.partial(_topk_logits_kernel, vocab=vocab, k=k,
+                               v_tile=Vt)
+    return pl.pallas_call(
+        kernel,
+        grid=(nR, nV),
+        in_specs=[pl.BlockSpec((Rb, Vt), lambda r, v: (r, v))],
+        out_specs=[
+            pl.BlockSpec((Rb, L), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, L), lambda r, v: (r, 0)),
+            pl.BlockSpec((Rb, 1), lambda r, v: (r, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, L), jnp.float32),
+            jax.ShapeDtypeStruct((N, L), jnp.int32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, 1), jnp.float32),
+            pltpu.VMEM((Rb, L), jnp.float32),
+            pltpu.VMEM((Rb, L), jnp.int32),
+        ],
+        compiler_params=_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(logits)

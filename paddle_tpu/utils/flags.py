@@ -219,6 +219,16 @@ define_flag("use_pallas_ce", True,
 # the WMT14 encoder shape, kept off (see the gate's docstring)
 define_flag("use_pallas_bigru", False,
             "fuse bidirectional GRU pairs into one Pallas time loop")
+# Gate: ops/decode.py:decode_kernel_config (vocab-tiled top-k+logsumexp
+# readout inside the fused decode engine; docs/decode.md).  A/B row:
+# pallas_decode_ab in bench.py.
+define_flag("use_pallas_decode", True,
+            "use the vocab-tiled Pallas top-k/logsumexp readout kernel in "
+            "the decode engine on TPU")
+define_flag("decode_early_exit", True,
+            "beam/greedy decode exits its token loop once every beam has "
+            "emitted EOS (lax.while_loop); off = fixed-max_len lax.scan "
+            "(AOT-unrollable)")
 
 # Numeric traps — the feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)
 # analog (reference: paddle/trainer/TrainerMain.cpp:49 installs FP traps for

@@ -17,7 +17,7 @@ __all__ = ["infer"]
 
 def infer(output_layer, parameters: Parameters, input: Sequence,
           feeding: Optional[Dict[str, int]] = None,
-          field="value"):
+          field="value", audit: bool = False):
     """``paddle.infer(output_layer=out, parameters=params, input=rows)``.
 
     ``field`` selects what to pull from each output layer — the reference's
@@ -25,7 +25,15 @@ def infer(output_layer, parameters: Parameters, input: Sequence,
     'id'] for beam_search outputs): ``"value"``/``"id"`` → the layer value
     (token ids for a beam_search layer), ``"prob"``/``"score"`` → the
     auxiliary scores from the layer's state (beam log-probs).  Pass a list
-    of field names to get a list back, e.g. ``field=['prob', 'id']``."""
+    of field names to get a list back, e.g. ``field=['prob', 'id']``.
+
+    ``audit=True`` is the serving preflight: before running, the jitted
+    inference closure (for a beam_search layer, the whole fused decode
+    engine — docs/decode.md) is traced through the jaxpr auditor's decode
+    checks (host transfers, >1 MiB folded constants, Pallas tile
+    alignment) and a ``RuntimeError`` is raised on ERROR-severity findings
+    — a per-step host round-trip must never silently ship in a generation
+    path."""
     outputs = ([output_layer] if isinstance(output_layer, LayerOutput)
                else list(output_layer))
     topo = Topology(outputs)
@@ -41,6 +49,17 @@ def infer(output_layer, parameters: Parameters, input: Sequence,
         return [(outs[o.name].value,
                  (outs[o.name].state or {}) if need_state else {})
                 for o in outputs]
+
+    if audit:
+        from paddle_tpu.analysis import audit_decode, severity_at_least
+
+        findings = audit_decode(run, parameters.params, parameters.state,
+                                feed, label="v2.infer")
+        if severity_at_least(findings, "ERROR"):
+            bad = "; ".join(f"{f.check}@{f.where}: {f.message}"
+                            for f in findings if f.severity == "ERROR")
+            raise RuntimeError(f"inference closure failed the decode "
+                               f"audit: {bad}")
 
     pairs = jax.jit(run)(parameters.params, parameters.state, feed)
 

@@ -534,6 +534,67 @@ def test_golden_nets_audit_error_free():
 
 
 # ---------------------------------------------------------------------------
+# decode-closure auditing (fused decode engine, ops/decode.py; docs/decode.md)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_audit_flagship_closure_is_host_transfer_free(rng):
+    """The acceptance bar for the decode engine: the lowered decode fn —
+    early-exit while loop, packed gather, and (forced, interpret-mode)
+    vocab-tiled top-k kernel included — carries no host transfer, no >1 MiB
+    folded constant, and no unaligned kernel BlockSpec."""
+    from paddle_tpu.analysis import audit_decode
+    from paddle_tpu.models import Seq2SeqAttention
+
+    m = Seq2SeqAttention(src_vocab=300, trg_vocab=300, emb_dim=32,
+                         enc_dim=32, dec_dim=128, att_dim=32)
+    params = m.init(jax.random.PRNGKey(0))
+    src = jnp.asarray(rng.randint(3, 300, (8, 6)).astype(np.int32))
+    src_len = jnp.full((8,), 6, jnp.int32)
+    for use_kernel in (True, False):
+        fs = audit_decode(
+            lambda p, s, l, uk=use_kernel: m.beam_search(
+                p, s, l, beam_size=4, max_len=5, use_kernel=uk),
+            params, src, src_len, label=f"decode_uk{use_kernel}")
+        errs = severity_at_least(fs, "ERROR")
+        assert not errs, [f.format() for f in errs]
+        assert not [f for f in fs if f.check == "unaligned-pallas-tile"], \
+            [f.format() for f in fs]
+
+
+def test_decode_audit_fires_on_planted_host_transfer(rng):
+    """audit_decode must still SEE a host round-trip smuggled into the
+    decode step (through the engine's while loop)."""
+    from paddle_tpu.analysis import audit_decode
+    from paddle_tpu.ops.decode import LogitsReadout, beam_decode
+
+    V, H = 12, 8
+    w = jnp.asarray(rng.randn(H, V).astype(np.float32))
+
+    def leaky_step(tokens, state):
+        h = jax.device_put(state["h"])  # the planted per-token transfer
+        return h @ w, {"h": h + 1.0}
+
+    fs = audit_decode(
+        lambda m0: beam_decode(leaky_step, LogitsReadout(), m0,
+                               batch_size=2, beam_size=3, vocab_size=V,
+                               max_len=4),
+        {"h": jnp.zeros((2, H))}, label="leaky")
+    assert "host-transfer" in _checks(fs)
+
+
+def test_cli_decode_audit_is_clean(capsys):
+    """`python -m paddle_tpu lint --decode` — the CI surface of the decode
+    audit (kernel + XLA-fallback variants)."""
+    from paddle_tpu.__main__ import main
+
+    rc = main(["lint", "--decode", "8,6,4,5", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    errors = [f for f in out["findings"] if f["severity"] == "ERROR"]
+    assert rc == 0 and not errors, errors
+
+
+# ---------------------------------------------------------------------------
 # deploy: _unrolled_scans lock (satellite config/deploy.py:283)
 # ---------------------------------------------------------------------------
 

@@ -178,18 +178,34 @@ JOBS = {
 }
 
 
+_USAGE = """\
+usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [--flag=value ...]
+       python -m paddle_tpu lint [--config CONF|--path DIR] ...
+
+The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
+module docstring of paddle_tpu/__main__.py).  Flags (also settable via
+PADDLE_TPU_<NAME> env vars):
+"""
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from paddle_tpu.utils import FLAGS
     from paddle_tpu.utils.devices import init
     from paddle_tpu.utils.error import ConfigError
+    from paddle_tpu.utils.flags import flags_help
 
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if argv and argv[0] == "lint":
         # the lint subcommand has its own argparse surface (analysis/cli.py)
-        # and must not run through the flag registry (--config clashes)
+        # — including its own --help — and must not run through the flag
+        # registry (--config clashes)
         from paddle_tpu.analysis.cli import run as lint_run
 
         return lint_run(argv[1:])
+    if "-h" in argv or "--help" in argv:
+        print(_USAGE)
+        print(flags_help())
+        return 0
     rest = init(argv)
     if rest:
         raise ConfigError(f"unrecognized arguments: {rest}")

@@ -26,9 +26,11 @@ from paddle_tpu.parallel.ring_attention import ring_attention, ring_attention_sh
 from paddle_tpu.parallel.embedding import sharded_embedding_lookup, shard_table
 from paddle_tpu.parallel.distributed import (
     initialize_distributed,
+    shutdown_distributed,
     global_mesh,
     is_multi_host,
     resume_pass,
 )
-from paddle_tpu.parallel.launcher import ClusterLauncher, launch_local
+from paddle_tpu.parallel.launcher import (ClusterLauncher, launch_local,
+                                          launch_supervised)
 from paddle_tpu.utils.devices import make_mesh

@@ -5,9 +5,15 @@ Reference equivalents: the pserver/trainer gflags topology (--pservers,
 cluster launcher (paddle/scripts/cluster_train/paddle.py:101-175).  On TPU the
 launcher is the TPU runtime itself: every host runs the same program,
 ``jax.distributed.initialize`` wires the DCN control plane, and
-``jax.devices()`` becomes the global chip list.  The failure model matches the
-reference's (SURVEY.md §5): no elastic scale-up — on failure, restart from the
-latest pass checkpoint (``latest_pass`` + ``--start_pass`` analog).
+``jax.devices()`` becomes the global chip list.
+
+Failure model: no elastic scale-up, but recovery is AUTOMATIC — the gang
+supervisor (``paddle_tpu.resilience.cluster.GangSupervisor``; docs/
+resilience.md "Multi-host recovery") detects rank death and heartbeat
+stalls, kills the whole gang, and relaunches it with the same world size;
+the relaunched ranks call ``shutdown_distributed``-fresh
+``initialize_distributed`` and resume from the newest gang-consistent
+checkpoint via ``--resume=auto`` (rank-0 publish + all-ranks barrier).
 """
 
 from __future__ import annotations
@@ -17,9 +23,11 @@ from typing import Optional, Sequence, Tuple
 
 from paddle_tpu.utils import FLAGS, logger
 
-__all__ = ["initialize_distributed", "global_mesh", "is_multi_host", "resume_pass"]
+__all__ = ["initialize_distributed", "shutdown_distributed", "global_mesh",
+           "is_multi_host", "resume_pass"]
 
 _initialized = False
+_live = False          # True only when jax.distributed.initialize ran
 
 
 def initialize_distributed(
@@ -30,9 +38,10 @@ def initialize_distributed(
     """Idempotent jax.distributed.initialize wrapper. No-ops single-host.
 
     Env-driven on TPU pods (the runtime sets everything); explicit args are
-    for CPU multi-process tests.
+    for CPU multi-process tests.  ``shutdown_distributed`` resets the
+    latch for supervised re-entry and multi-scenario tests.
     """
-    global _initialized
+    global _initialized, _live
     if _initialized:
         return
     import jax
@@ -55,11 +64,32 @@ def initialize_distributed(
         process_id=process_id,
     )
     _initialized = True
+    _live = True
     logger.info(
         "distributed init: process %d/%d, %d local / %d global devices",
         jax.process_index(), jax.process_count(),
         jax.local_device_count(), jax.device_count(),
     )
+
+
+def shutdown_distributed() -> None:
+    """Tear down the DCN control plane and reset the init latch.
+
+    The module-global latch otherwise makes ``initialize_distributed`` a
+    one-shot per process; supervised re-entry (a gang rank reused across
+    scenarios) and multi-scenario tests need the way back.  Safe to call
+    when nothing was initialized — only a LIVE ``jax.distributed`` client
+    (one this module actually started) is shut down."""
+    global _initialized, _live
+    if _live:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except RuntimeError as e:  # already torn down elsewhere
+            logger.warning("jax.distributed.shutdown: %s", e)
+    _live = False
+    _initialized = False
 
 
 def is_multi_host() -> bool:

@@ -12,6 +12,13 @@ env up (PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID).
 
 On real TPU pods the platform launcher (GKE/xpk/ray) plays this role; this
 module is the self-contained equivalent for bare hosts and for tests.
+
+Supervision: ``poll()``/``kill_gang()`` expose the gang-level process
+control the :class:`paddle_tpu.resilience.cluster.GangSupervisor` builds
+on (detect rank death, SIGKILL the whole gang — SIGKILL, because a rank
+wedged in a JAX collective, or SIGSTOPped by the chaos harness, ignores
+SIGTERM).  ``launch_supervised`` is the one-call local form: launch N
+ranks under a supervisor that gang-restarts them on death or hang.
 """
 
 from __future__ import annotations
@@ -26,7 +33,7 @@ from typing import Dict, List, Optional, Sequence
 
 from paddle_tpu.utils import logger
 
-__all__ = ["ClusterLauncher", "launch_local"]
+__all__ = ["ClusterLauncher", "launch_local", "launch_supervised"]
 
 _LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1", "")
 
@@ -124,6 +131,26 @@ class ClusterLauncher:
             self.procs.append(p)
         return self.procs
 
+    def poll(self) -> List[Optional[int]]:
+        """Non-blocking per-rank exit codes (None = still running)."""
+        return [p.poll() for p in self.procs]
+
+    def kill_gang(self) -> List[Optional[int]]:
+        """SIGKILL every rank and reap; returns the exit codes.  The gang
+        is one failure domain: once any rank is dead or hung, surviving
+        ranks are wedged in collectives (or about to be) and must die too
+        before a relaunch can bind the same ports."""
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        codes = []
+        for p in self.procs:
+            try:
+                codes.append(p.wait(timeout=10))
+            except subprocess.TimeoutExpired:
+                codes.append(p.poll())
+        return codes
+
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         """Wait for all ranks; returns exit codes (raises on timeout)."""
         deadline = time.time() + timeout if timeout else None
@@ -153,3 +180,17 @@ def launch_local(n: int, script: str, args: Sequence[str] = (),
                         coordinator_port=coordinator_port)
     l.launch(script, args, env=env)
     return l
+
+
+def launch_supervised(n: int, script: str, args: Sequence[str] = (),
+                      env: Optional[Dict[str, str]] = None, **kw):
+    """Run ``n`` local ranks of ``script`` under a gang supervisor: rank
+    death or heartbeat stall kills and relaunches the whole gang (bounded
+    by ``--gang_max_restarts``, exponential backoff), resuming through the
+    trainer's ``--resume=auto`` path.  Keyword args forward to
+    :class:`paddle_tpu.resilience.cluster.GangSupervisor`; returns its
+    ``GangResult``, raising ``GangFailedError`` when the budget is spent."""
+    from paddle_tpu.resilience.cluster import GangSupervisor
+
+    sup = GangSupervisor(["localhost"] * n, script, args, env=env, **kw)
+    return sup.run()

@@ -12,14 +12,27 @@ tier of the trainer survivable (docs/resilience.md):
   ``paddle_tpu.analysis``);
 - **reader** — ``resilient_reader`` retry/backoff/skip-bad-batch wrapper;
 - **signals** — SIGTERM/SIGINT -> checkpoint-at-batch-boundary + clean
-  exit (``PreemptionHandler``);
+  exit (``PreemptionHandler``), gang-agreed when a cluster context is
+  attached;
+- **cluster** — the gang-supervised runtime (docs/resilience.md
+  "Multi-host recovery"): ``GangSupervisor`` kills and relaunches the
+  whole gang on rank death or heartbeat stall (bounded restarts,
+  exponential backoff, per-rank attribution in ``GangFailedError``);
+  ``current_gang()`` gives workers the barrier / preemption-OR /
+  coordinator-broadcast primitives that make checkpoints and resume
+  multi-host-consistent;
 - **chaos** — fault injection (corrupt/truncate checkpoints, NaN-grad
-  batches, flaky readers, simulated preemptions) proving each recovery
-  path end-to-end in tests/test_resilience.py.
+  batches, flaky readers, simulated preemptions, rank kill/hang) proving
+  each recovery path end-to-end in tests/test_resilience.py and
+  tests/test_gang.py.
 """
 
-from paddle_tpu.resilience.errors import (CheckpointError, ReaderError,
+from paddle_tpu.resilience.errors import (CheckpointError, GangError,
+                                          GangFailedError, ReaderError,
                                           TooManyBadSteps)
+from paddle_tpu.resilience.cluster import (GangContext, GangResult,
+                                           GangSupervisor, RankReport,
+                                           current_gang)
 from paddle_tpu.resilience.checkpoint_io import (MANIFEST_VERSION,
                                                  latest_pass,
                                                  latest_valid_pass,
@@ -40,6 +53,13 @@ __all__ = [
     "CheckpointError",
     "ReaderError",
     "TooManyBadSteps",
+    "GangError",
+    "GangFailedError",
+    "GangContext",
+    "GangResult",
+    "GangSupervisor",
+    "RankReport",
+    "current_gang",
     "MANIFEST_VERSION",
     "npz_safe",
     "save_pytree",

@@ -15,16 +15,27 @@ sees, deterministically:
 - scheduling: ``preempt_at`` wires a simulated preemption into the
   trainer's event stream at batch ``k`` — via ``PreemptionHandler
   .request()`` by default, or a REAL ``SIGTERM`` to the process with
-  ``use_signal=True``.
+  ``use_signal=True``;
+- cluster (the gang-supervisor fault models, resilience/cluster.py):
+  ``kill_rank`` SIGKILLs one rank of a live gang, ``hang_rank`` SIGSTOPs
+  it (alive but silent — the stuck-in-a-collective model the heartbeat
+  watchdog must catch), ``die_at``/``stall_at`` are worker-side event
+  handlers that SIGKILL or wedge THIS rank at an exact batch (marker-file
+  guarded, so only the first gang attempt is sabotaged), and
+  ``corrupt_latest_checkpoint`` damages the newest pass dir between
+  restarts.
 
-Used by tests/test_resilience.py to prove each path end-to-end; equally
-usable interactively against a live save_dir.
+Used by tests/test_resilience.py and tests/test_gang.py to prove each
+recovery path end-to-end; equally usable interactively against a live
+save_dir.
 """
 
 from __future__ import annotations
 
 import os
+import random as _random
 import signal as _signal
+import time as _time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 import numpy as np
@@ -33,10 +44,15 @@ __all__ = [
     "corrupt_file",
     "truncate_file",
     "corrupt_checkpoint",
+    "corrupt_latest_checkpoint",
     "nan_feed",
     "inject_nan_batches",
     "flaky_reader",
     "preempt_at",
+    "kill_rank",
+    "hang_rank",
+    "die_at",
+    "stall_at",
 ]
 
 
@@ -85,6 +101,23 @@ def corrupt_checkpoint(ckpt_dir: str, *, target: str = "params.npz",
         os.remove(path)
     else:
         raise ValueError(f"unknown chaos mode {mode!r}")
+
+
+def corrupt_latest_checkpoint(save_dir: str, *, target: str = "params.npz",
+                              mode: str = "corrupt") -> Optional[str]:
+    """Damage the NEWEST pass dir under ``save_dir`` (no validation — the
+    point is to break the one resume would pick).  The between-restarts
+    gang fault: a supervisor relaunch must fall back to the previous
+    valid pass, or to a fresh start.  Returns the damaged dir, or None
+    when there is no checkpoint yet."""
+    from paddle_tpu.resilience.checkpoint_io import latest_pass, pass_dir
+
+    p = latest_pass(save_dir, validate=False)
+    if p < 0:
+        return None
+    d = pass_dir(save_dir, p)
+    corrupt_checkpoint(d, target=target, mode=mode)
+    return d
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +192,85 @@ def flaky_reader(reader: Callable, *, fail_at: int, times: int = 1,
 # ---------------------------------------------------------------------------
 # scheduling faults
 # ---------------------------------------------------------------------------
+
+
+def kill_rank(gang, rank: Optional[int] = None, *,
+              sig: int = _signal.SIGKILL,
+              rng: Optional[_random.Random] = None) -> Optional[int]:
+    """Send ``sig`` (default SIGKILL — no cleanup, no checkpoint) to one
+    LIVE rank of a running gang; ``rank=None`` picks one at random.
+    ``gang`` is anything with ``.procs`` (a ClusterLauncher, a
+    GangSupervisor via ``.launcher``) or a plain Popen list.  Returns the
+    rank hit, or None when nothing was alive to kill."""
+    procs = _procs_of(gang)
+    live = [i for i, p in enumerate(procs) if p.poll() is None]
+    if not live:
+        return None
+    if rank is None:
+        rank = (rng or _random).choice(live)
+    os.kill(procs[rank].pid, sig)
+    return rank
+
+
+def hang_rank(gang, rank: int, *, resume: bool = False) -> None:
+    """SIGSTOP (or SIGCONT with ``resume=True``) one rank: the process
+    stays alive — ``poll()`` sees nothing — but stops heartbeating, the
+    exact signature of a rank wedged in a collective after a peer died.
+    Only the supervisor's heartbeat watchdog can catch this."""
+    procs = _procs_of(gang)
+    os.kill(procs[rank].pid, _signal.SIGCONT if resume else _signal.SIGSTOP)
+
+
+def _procs_of(gang):
+    if hasattr(gang, "procs"):
+        return gang.procs
+    if hasattr(gang, "launcher") and gang.launcher is not None:
+        return gang.launcher.procs
+    return list(gang)
+
+
+def die_at(*, batch: int, pass_id: int = 0, marker: str,
+           inner: Optional[Callable] = None,
+           sig: int = _signal.SIGKILL) -> Callable:
+    """Worker-side event handler: SIGKILL THIS process when batch
+    ``batch`` of pass ``pass_id`` begins — but only if ``marker`` (a path
+    on storage shared across gang attempts) does not exist yet, so the
+    relaunched incarnation survives.  The rank-death fault for supervised
+    gang tests: deterministic, mid-pass, no cleanup."""
+    from paddle_tpu.trainer import events as ev
+
+    def event_handler(e):
+        if (isinstance(e, ev.BeginIteration) and e.pass_id == pass_id
+                and e.batch_id == batch and not os.path.exists(marker)):
+            with open(marker, "w") as f:
+                f.write("died\n")
+            os.kill(os.getpid(), sig)
+        if inner is not None:
+            inner(e)
+
+    return event_handler
+
+
+def stall_at(*, batch: int, pass_id: int = 0, marker: str,
+             duration: float = 3600.0,
+             inner: Optional[Callable] = None) -> Callable:
+    """Worker-side event handler: wedge THIS process (sleep on the MAIN
+    thread) when batch ``batch`` of pass ``pass_id`` begins, marker-file
+    guarded like ``die_at``.  Because gang heartbeats ride the training
+    loop, the stall silences them — the hung-rank model the watchdog must
+    detect and gang-restart within ``--gang_watchdog_s``."""
+    from paddle_tpu.trainer import events as ev
+
+    def event_handler(e):
+        if (isinstance(e, ev.BeginIteration) and e.pass_id == pass_id
+                and e.batch_id == batch and not os.path.exists(marker)):
+            with open(marker, "w") as f:
+                f.write("stalled\n")
+            _time.sleep(duration)
+        if inner is not None:
+            inner(e)
+
+    return event_handler
 
 
 def preempt_at(handler, *, batch: int, pass_id: int = 0,

@@ -30,7 +30,7 @@ import shutil
 import time
 import uuid
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -61,6 +61,11 @@ MANIFEST_VERSION = 1
 _PASS_RE = re.compile(r"pass-(\d{5,})")
 
 _TMP_PREFIX = ".tmp-"
+
+# a temp dir younger than this is treated as an IN-FLIGHT save by a
+# concurrent writer and left alone by prune_checkpoints; older ones are
+# debris from a crashed save and get swept
+_TMP_GRACE_S = 900.0
 
 
 def pass_dir(save_dir: str, pass_id: int) -> str:
@@ -167,7 +172,8 @@ def _fsync_dir(path: str) -> None:
 def save_checkpoint(save_dir: str, pass_id: int, *, params, state=None,
                     opt_state=None, extra: Optional[Dict[str, Any]] = None,
                     meta: Optional[dict] = None,
-                    keep_last_n: Optional[int] = None) -> str:
+                    keep_last_n: Optional[int] = None,
+                    barrier: Optional[Callable[[], None]] = None) -> str:
     """Atomically write ``save_dir/pass-%05d``.
 
     The write goes to a dot-prefixed temp dir (never matched by
@@ -180,6 +186,15 @@ def save_checkpoint(save_dir: str, pass_id: int, *, params, state=None,
     ``meta`` lands verbatim under manifest ``meta``; ``keep_last_n``
     (default ``FLAGS.keep_last_n``; 0 = unlimited) prunes the oldest pass
     dirs after the save succeeds.
+
+    ``barrier`` (multi-host commit protocol, t5x/Orbax style) is invoked
+    after every file is written and fsynced but BEFORE the rename-publish:
+    in a gang, rank 0 passes the gang barrier here while every other rank
+    calls the matching ``gang.barrier()``, so a checkpoint only becomes
+    visible once ALL ranks have reached the commit point — no rank can
+    later resume past a checkpoint a peer never saw.  If the barrier
+    raises (peer died), the temp dir is discarded and the previous
+    checkpoint stays in place.
     """
     if keep_last_n is None:
         keep_last_n = FLAGS.keep_last_n
@@ -217,6 +232,8 @@ def save_checkpoint(save_dir: str, pass_id: int, *, params, state=None,
             f.flush()
             os.fsync(f.fileno())
         _fsync_dir(tmp)
+        if barrier is not None:
+            barrier()  # gang commit point: all ranks agree the save is done
         # publish: replace() is atomic for the rename.  An existing dir from
         # an earlier save of the same pass (e.g. a preemption checkpoint
         # being overwritten by the completed pass) is moved ASIDE first, not
@@ -241,20 +258,53 @@ def save_checkpoint(save_dir: str, pass_id: int, *, params, state=None,
     return final
 
 
+def _newest_mtime(tmp_dir: str) -> float:
+    """Freshest mtime of a temp dir OR anything inside it.  The dir's own
+    mtime only advances on entry create/rename — a writer streaming one
+    huge npz for longer than the grace window would look abandoned by the
+    dir timestamp alone while its file mtime keeps moving."""
+    newest = os.path.getmtime(tmp_dir)
+    try:
+        with os.scandir(tmp_dir) as it:
+            for entry in it:
+                try:
+                    newest = max(newest, entry.stat().st_mtime)
+                except OSError:
+                    continue
+    except OSError:
+        pass
+    return newest
+
+
 def prune_checkpoints(save_dir: str, keep_last_n: int) -> List[str]:
     """Delete all but the newest ``keep_last_n`` pass dirs (by pass id);
     also sweeps abandoned temp dirs from crashed saves.  Returns removed
-    paths."""
+    paths.
+
+    Concurrency-safe against a peer writer/pruner sharing ``save_dir``
+    (two gang attempts overlapping during a restart, or retention racing
+    a preemption save): temp dirs modified within ``_TMP_GRACE_S`` are an
+    IN-FLIGHT save and are skipped, and every stat/remove tolerates
+    ENOENT — an entry a concurrent prune already removed is simply
+    counted as gone, never raised mid-retention."""
     removed = []
-    if not os.path.isdir(save_dir):
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
         return removed
     ids = []
-    for name in os.listdir(save_dir):
+    now = time.time()
+    for name in names:
         m = _PASS_RE.fullmatch(name)
         if m:
             ids.append(int(m.group(1)))
         elif name.startswith(_TMP_PREFIX):
             p = os.path.join(save_dir, name)
+            try:
+                if now - _newest_mtime(p) < _TMP_GRACE_S:
+                    continue  # a concurrent save owns this dir
+            except OSError:
+                continue      # vanished under us: a peer swept it
             shutil.rmtree(p, ignore_errors=True)
             removed.append(p)
     for pid in sorted(ids)[:-keep_last_n] if keep_last_n > 0 else []:

@@ -1,0 +1,536 @@
+"""Gang-supervised cluster runtime — multi-host failure recovery.
+
+The reference's pserver tier survived worker loss by restarting trainers
+against the latest pass checkpoint (SURVEY.md §5, ``paddle/pserver``); the
+TPU-native analog is a **gang supervisor** in the spirit of TorchElastic's
+agent model: every rank of a distributed job is launched and monitored as
+one gang, and ANY failure — a rank dying, or a rank *hanging* (the common
+TPU mode: JAX collectives deadlock rather than error once a peer is gone)
+— kills the whole gang and relaunches it, with bounded restarts and
+exponential backoff.  Recovery rides the existing ``--resume=auto`` path,
+so a killed-and-relaunched run reproduces an uninterrupted run's losses.
+
+Two halves:
+
+- **worker side** — :class:`GangContext` (``current_gang()``): rank
+  identity plus the coordination primitives the resilience tier needs to
+  be multi-host-correct — per-rank **heartbeat** files (written at batch
+  boundaries from the MAIN thread, so a rank stuck in a collective stops
+  heartbeating), a sequence-numbered **barrier** (all ranks agree a
+  checkpoint is complete before rank 0 rename-publishes it, the
+  t5x/Orbax commit protocol), an OR-reduced **preemption** flag (a
+  SIGTERM delivered to one host checkpoints everyone consistently), and
+  a coordinator **broadcast** (``latest_valid_pass`` resolves on rank 0,
+  not just locally).  The file protocol needs only a directory shared
+  with the supervisor; on live ``jax.distributed`` pods without one, the
+  same API degrades to DCN collectives (:class:`_JaxGang`).
+- **supervisor side** — :class:`GangSupervisor`: launches the gang
+  through :class:`~paddle_tpu.parallel.launcher.ClusterLauncher`, polls
+  for rank death, watches heartbeat staleness against the watchdog
+  budget (``--gang_watchdog_s``), and drives the restart loop.  Budget
+  exhausted raises :class:`~paddle_tpu.resilience.errors.GangFailedError`
+  with per-rank exit attribution.
+
+Supervisor state machine (docs/resilience.md "Multi-host recovery")::
+
+    LAUNCH -> MONITOR --all ranks exit 0--------------------> DONE
+                 |  \\--rank died / heartbeat stale--> KILL GANG
+                 |                                        |
+                 +--deadline exceeded--> GangFailedError  |
+                                                          v
+              restarts left?  --no--> GangFailedError   BACKOFF
+                     ^--yes------------------------------/
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddle_tpu.resilience.errors import GangError, GangFailedError
+from paddle_tpu.utils import FLAGS, logger
+
+__all__ = [
+    "GangContext",
+    "GangSupervisor",
+    "GangResult",
+    "RankReport",
+    "current_gang",
+]
+
+# Env wiring injected by GangSupervisor (alongside the launcher's
+# PADDLE_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID):
+_ENV_DIR = "PADDLE_TPU_GANG_DIR"          # per-ATTEMPT shared directory
+_ENV_SIZE = "PADDLE_TPU_GANG_SIZE"
+_ENV_RANK = "PADDLE_TPU_GANG_RANK"        # falls back to _PROCESS_ID
+_ENV_HEARTBEAT = "PADDLE_TPU_GANG_HEARTBEAT_S"
+
+_POLL_S = 0.02
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class GangContext:
+    """Worker-side gang coordination over a shared directory.
+
+    The directory is per-ATTEMPT (the supervisor creates a fresh one for
+    every relaunch), so no state — barrier arrivals, preemption flags,
+    published decisions — can leak from a previous incarnation of the
+    gang into the next.
+    """
+
+    def __init__(self, gang_dir: str, rank: int, size: int,
+                 heartbeat_s: Optional[float] = None,
+                 barrier_timeout_s: float = 600.0) -> None:
+        self.gang_dir = gang_dir
+        self.rank = int(rank)
+        self.size = int(size)
+        self.heartbeat_s = (FLAGS.gang_heartbeat_s if heartbeat_s is None
+                            else float(heartbeat_s))
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self._barrier_seq = 0
+        self._hb_count = 0
+        self._hb_last = 0.0
+        self._preempt_flagged = False
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+    # -- heartbeat -------------------------------------------------------
+
+    def heartbeat(self, *, force: bool = False) -> None:
+        """Touch this rank's heartbeat file.  Called from the TRAINING
+        loop's main thread at batch boundaries — deliberately NOT from a
+        background thread, so a rank wedged inside a collective stops
+        heartbeating and the supervisor's watchdog can see it."""
+        now = time.monotonic()
+        if not force and now - self._hb_last < self.heartbeat_s:
+            return
+        self._hb_count += 1
+        try:
+            _atomic_write(os.path.join(self.gang_dir, f"hb-rank{self.rank}"),
+                          str(self._hb_count))
+        except OSError as e:  # gang dir swept mid-write: supervisor owns it
+            logger.warning("gang heartbeat failed: %s", e)
+            return
+        self._hb_last = now
+
+    # -- barrier ---------------------------------------------------------
+
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        """Sequence-numbered all-ranks barrier.
+
+        Every rank executes the SAME sequence of barrier calls (the saves
+        of a deterministic training loop), so a plain per-process counter
+        names each rendezvous.  Waiting ranks keep heartbeating — a slow
+        checkpoint write on rank 0 must not read as a hang."""
+        n = self._barrier_seq
+        self._barrier_seq += 1
+        me = os.path.join(self.gang_dir, f"barrier-{n:05d}-rank{self.rank}")
+        _atomic_write(me, "1")
+        deadline = time.monotonic() + (self.barrier_timeout_s
+                                       if timeout_s is None else timeout_s)
+        want = [os.path.join(self.gang_dir, f"barrier-{n:05d}-rank{r}")
+                for r in range(self.size)]
+        while True:
+            if all(os.path.exists(p) for p in want):
+                return
+            if time.monotonic() > deadline:
+                raise GangError(
+                    f"rank {self.rank}: barrier {n} timed out after "
+                    f"{self.barrier_timeout_s:.0f}s — a peer likely died "
+                    "(the supervisor will relaunch the gang)")
+            self.heartbeat()
+            time.sleep(_POLL_S)
+
+    # -- preemption OR-reduce -------------------------------------------
+
+    def agree_preempt(self, local: bool) -> bool:
+        """Gang-wide OR of the per-rank preemption request, evaluated at
+        the batch boundary: a SIGTERM delivered to ONE host makes every
+        rank checkpoint at its next boundary, so the published mid-pass
+        checkpoint is consistent across the gang."""
+        if local and not self._preempt_flagged:
+            _atomic_write(
+                os.path.join(self.gang_dir, f"preempt-rank{self.rank}"), "1")
+            self._preempt_flagged = True
+        if self._preempt_flagged:
+            return True
+        try:
+            names = os.listdir(self.gang_dir)
+        except OSError:
+            return local
+        return any(n.startswith("preempt-rank") for n in names)
+
+    # -- coordinator broadcast ------------------------------------------
+
+    def broadcast_json(self, obj: Optional[Any], *, name: str = "decision",
+                       timeout_s: Optional[float] = None) -> Any:
+        """Rank 0 publishes ``obj`` (JSON) under ``name``; every other
+        rank blocks (heartbeating) until it appears and returns it.  The
+        resume-decision plane: ``latest_valid_pass`` resolves on the
+        coordinator and the gang follows, never a locally-newer pass a
+        peer cannot see."""
+        path = os.path.join(self.gang_dir, f"pub-{name}.json")
+        if self.is_coordinator:
+            _atomic_write(path, json.dumps(obj))
+            return obj
+        deadline = time.monotonic() + (self.barrier_timeout_s
+                                       if timeout_s is None else timeout_s)
+        while True:
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (FileNotFoundError, json.JSONDecodeError):
+                pass
+            if time.monotonic() > deadline:
+                raise GangError(
+                    f"rank {self.rank}: no coordinator decision {name!r} "
+                    f"within {self.barrier_timeout_s:.0f}s")
+            self.heartbeat()
+            time.sleep(_POLL_S)
+
+
+class _JaxGang:
+    """GangContext API over live ``jax.distributed`` collectives — the
+    path for platform-launched pods (GKE/xpk) that share no filesystem
+    with a supervisor.  Heartbeats are a no-op (the platform's own agent
+    watches liveness there)."""
+
+    def __init__(self) -> None:
+        import jax
+
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+        self._seq = 0
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.rank == 0
+
+    def heartbeat(self, *, force: bool = False) -> None:
+        pass
+
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        from jax.experimental import multihost_utils
+
+        n = self._seq
+        self._seq += 1
+        multihost_utils.sync_global_devices(f"paddle_tpu_gang_barrier_{n}")
+
+    def agree_preempt(self, local: bool) -> bool:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(local)], dtype=np.bool_))
+        return bool(np.any(flags))
+
+    def broadcast_json(self, obj: Optional[Any], *, name: str = "decision",
+                       timeout_s: Optional[float] = None) -> Any:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        cap = 4096
+        buf = np.zeros((cap,), np.uint8)
+        if self.is_coordinator:
+            raw = json.dumps(obj).encode()
+            if len(raw) > cap - 8:
+                raise GangError(f"broadcast payload {name!r} exceeds {cap}B")
+            buf[:8] = np.frombuffer(
+                len(raw).to_bytes(8, "little"), np.uint8)
+            buf[8:8 + len(raw)] = np.frombuffer(raw, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        n = int.from_bytes(out[:8].tobytes(), "little")
+        return json.loads(out[8:8 + n].tobytes().decode())
+
+
+def current_gang():
+    """The active gang context for THIS process, or ``None``.
+
+    Supervisor-launched ranks (``PADDLE_TPU_GANG_DIR`` set) get the
+    shared-directory protocol; a live multi-process ``jax.distributed``
+    run without one gets the collective-backed equivalent; single-process
+    runs get ``None`` and every gang hook no-ops.
+    """
+    gang_dir = os.environ.get(_ENV_DIR)
+    if gang_dir:
+        rank = int(os.environ.get(_ENV_RANK,
+                                  os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
+        size = int(os.environ.get(_ENV_SIZE, "1"))
+        hb = os.environ.get(_ENV_HEARTBEAT)
+        return GangContext(gang_dir, rank, size,
+                           heartbeat_s=float(hb) if hb else None)
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None and jax.process_count() > 1:
+        return _JaxGang()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RankReport:
+    """Attribution for one rank's part in a failed attempt."""
+
+    attempt: int
+    rank: int
+    pid: int
+    exit_code: Optional[int]       # None = still alive when the gang died
+    reason: str                    # 'exit' | 'hung' | 'gang-killed' | ...
+    stale_s: Optional[float] = None  # heartbeat age at hang detection
+
+    def describe(self) -> str:
+        tail = (f" (heartbeat stale {self.stale_s:.1f}s)"
+                if self.stale_s is not None else "")
+        code = "alive" if self.exit_code is None else f"exit={self.exit_code}"
+        return f"attempt {self.attempt} rank {self.rank}: {self.reason}, {code}{tail}"
+
+
+@dataclass
+class GangResult:
+    """Outcome of a successful ``GangSupervisor.run()``."""
+
+    attempts: int
+    reports: List[RankReport] = field(default_factory=list)
+
+
+class GangSupervisor:
+    """Launch, watch, and gang-restart an N-rank job.
+
+    ``hosts`` follows :class:`ClusterLauncher` (``["localhost"]*2`` for a
+    local CPU gang); every rank runs ``python script args...`` with the
+    distributed wiring AND the gang wiring (shared attempt directory,
+    heartbeat cadence) injected.  ``run()`` returns a :class:`GangResult`
+    once an attempt sees every rank exit 0, and raises
+    :class:`GangFailedError` when ``max_restarts`` relaunches are burned
+    (or ``deadline_s`` passes) — carrying per-rank attribution for every
+    failed attempt.
+
+    ``on_restart(supervisor, attempt)`` runs between a gang kill and the
+    next launch — the chaos harness corrupts checkpoints there; ``tick``
+    runs every monitor poll (tests inject mid-pass faults through it).
+    """
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        script: str,
+        args: Sequence[str] = (),
+        *,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        gang_dir: Optional[str] = None,
+        max_restarts: Optional[int] = None,
+        heartbeat_s: Optional[float] = None,
+        watchdog_s: Optional[float] = None,
+        startup_grace_s: Optional[float] = None,
+        backoff_s: float = 1.0,
+        max_backoff_s: float = 30.0,
+        poll_s: float = 0.05,
+        coordinator_port: Optional[Callable[[], int]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        on_restart: Optional[Callable[["GangSupervisor", int], None]] = None,
+        tick: Optional[Callable[["GangSupervisor", int, float], None]] = None,
+    ) -> None:
+        self.hosts = list(hosts)
+        self.script = script
+        self.args = list(args)
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.gang_dir = gang_dir or os.path.join(
+            os.getcwd(), f".gang-{uuid.uuid4().hex[:8]}")
+        self.max_restarts = (FLAGS.gang_max_restarts if max_restarts is None
+                             else int(max_restarts))
+        self.heartbeat_s = (FLAGS.gang_heartbeat_s if heartbeat_s is None
+                            else float(heartbeat_s))
+        self.watchdog_s = (FLAGS.gang_watchdog_s if watchdog_s is None
+                           else float(watchdog_s))
+        # ranks need import + first compile before the first heartbeat can
+        # exist; until then liveness is judged against this grace window
+        self.startup_grace_s = (max(60.0, self.watchdog_s)
+                                if startup_grace_s is None
+                                else float(startup_grace_s))
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.poll_s = float(poll_s)
+        self._port = coordinator_port
+        self._sleep = sleep
+        self._on_restart = on_restart
+        self._tick = tick
+        self.reports: List[RankReport] = []
+        self.launcher = None           # live ClusterLauncher, for chaos hooks
+        self.attempt_dir: Optional[str] = None
+        self._created_dirs: List[str] = []
+
+    # -- one attempt -----------------------------------------------------
+
+    def _launch(self, attempt: int):
+        from paddle_tpu.parallel.launcher import ClusterLauncher
+
+        self.attempt_dir = os.path.join(self.gang_dir, f"attempt-{attempt:03d}")
+        os.makedirs(self.attempt_dir, exist_ok=True)
+        self._created_dirs.append(self.attempt_dir)
+        kw = {}
+        if self._port is not None:
+            kw["coordinator_port"] = self._port()
+        launcher = ClusterLauncher(hosts=self.hosts, **kw)
+        env = {
+            **self.env,
+            _ENV_DIR: self.attempt_dir,
+            _ENV_SIZE: str(len(self.hosts)),
+            _ENV_HEARTBEAT: str(self.heartbeat_s),
+        }
+        launcher.launch(self.script, self.args, env=env, cwd=self.cwd)
+        self.launcher = launcher
+        return launcher
+
+    def _hb_age(self, rank: int, now: float) -> Optional[float]:
+        """Seconds since rank's last heartbeat, or None if none yet."""
+        try:
+            mtime = os.path.getmtime(
+                os.path.join(self.attempt_dir, f"hb-rank{rank}"))
+        except OSError:
+            return None
+        return max(0.0, now - mtime)
+
+    def _monitor(self, launcher, attempt: int,
+                 deadline: Optional[float]) -> Optional[List[RankReport]]:
+        """Poll until success (returns None) or failure (rank reports)."""
+        t0 = time.monotonic()
+        drain_since = None   # first time we saw a partial zero-exit gang
+        while True:
+            codes = launcher.poll()
+            if all(c == 0 for c in codes):
+                return []
+            dead = [(r, c) for r, c in enumerate(codes)
+                    if c is not None and c != 0]
+            if dead:
+                return [
+                    RankReport(attempt, r, launcher.procs[r].pid, c, "exit")
+                    for r, c in dead
+                ]
+            now = time.monotonic()
+            elapsed = now - t0
+            # straggler drain: some ranks exited 0 while peers run on.  A
+            # peer blocked in a barrier whose partner is gone heartbeats
+            # while it waits (slow saves must not read as hangs), so
+            # neither the death poll nor the staleness watchdog would ever
+            # fire — bound the inconsistency with the same watchdog budget
+            if any(c == 0 for c in codes):
+                if drain_since is None:
+                    drain_since = now
+                elif now - drain_since > self.watchdog_s:
+                    return [RankReport(
+                        attempt, r, launcher.procs[r].pid, None,
+                        "straggler (peers already exited)",
+                        stale_s=now - drain_since)
+                        for r, c in enumerate(codes) if c is None]
+            else:
+                drain_since = None
+            wall = time.time()
+            hung = []
+            for r, c in enumerate(codes):
+                if c is not None:      # exited 0, waiting on peers
+                    continue
+                age = self._hb_age(r, wall)
+                if age is None:
+                    if elapsed > self.startup_grace_s:
+                        hung.append(RankReport(
+                            attempt, r, launcher.procs[r].pid, None,
+                            "hung (no heartbeat after startup grace)",
+                            stale_s=elapsed))
+                elif age > self.watchdog_s:
+                    hung.append(RankReport(
+                        attempt, r, launcher.procs[r].pid, None, "hung",
+                        stale_s=age))
+            if hung:
+                return hung
+            if deadline is not None and now > deadline:
+                raise GangFailedError(
+                    f"gang did not complete within the deadline "
+                    f"({elapsed:.0f}s into attempt {attempt})",
+                    reports=self.reports)
+            if self._tick is not None:
+                self._tick(self, attempt, elapsed)
+            self._sleep(self.poll_s)
+
+    # -- the restart loop ------------------------------------------------
+
+    def run(self, *, deadline_s: Optional[float] = None) -> GangResult:
+        os.makedirs(self.gang_dir, exist_ok=True)
+        deadline = (time.monotonic() + deadline_s) if deadline_s else None
+        attempt = 0
+        while True:
+            launcher = self._launch(attempt)
+            logger.info("gang attempt %d: %d ranks launched", attempt,
+                        len(self.hosts))
+            try:
+                failed = self._monitor(launcher, attempt, deadline)
+            except BaseException:
+                launcher.kill_gang()
+                raise
+            if not failed:
+                launcher.wait(timeout=60)
+                logger.info("gang attempt %d: all %d ranks exited 0",
+                            attempt, len(self.hosts))
+                self._scrub_attempt_dirs()
+                return GangResult(attempts=attempt + 1, reports=self.reports)
+            # attribute the peers that the gang kill takes down with it
+            culprits = {f.rank for f in failed}
+            self.reports.extend(failed)
+            for r, c in enumerate(launcher.poll()):
+                if r not in culprits:
+                    self.reports.append(RankReport(
+                        attempt, r, launcher.procs[r].pid, c, "gang-killed"))
+            logger.warning("gang attempt %d failed: %s", attempt,
+                           "; ".join(f.describe() for f in failed))
+            launcher.kill_gang()
+            if attempt >= self.max_restarts:
+                raise GangFailedError(
+                    f"gang failed {attempt + 1} times "
+                    f"(max_restarts={self.max_restarts}); per-rank: "
+                    + "; ".join(f.describe() for f in self.reports),
+                    reports=self.reports)
+            if self._on_restart is not None:
+                self._on_restart(self, attempt)
+            delay = min(self.backoff_s * (2.0 ** attempt), self.max_backoff_s)
+            logger.info("gang restart %d/%d in %.1fs", attempt + 1,
+                        self.max_restarts, delay)
+            self._sleep(delay)
+            attempt += 1
+
+    def _scrub_attempt_dirs(self) -> None:
+        """Success path: drop the attempt dirs THIS run created (heartbeat
+        / barrier / flag scratch — never checkpoints) so supervised runs
+        don't accumulate debris; the gang dir itself goes only if empty
+        (it may be user-supplied and shared).  Failed runs keep their
+        attempt dirs for post-mortem."""
+        for d in self._created_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        self._created_dirs.clear()
+        try:
+            os.rmdir(self.gang_dir)
+        except OSError:
+            pass
+
+    def cleanup(self) -> None:
+        """Remove the gang scratch directory (attempt state only — never
+        checkpoints; those live under the job's own save_dir)."""
+        shutil.rmtree(self.gang_dir, ignore_errors=True)

@@ -3,12 +3,16 @@
 Each maps a recovery path to a distinct exception so callers (and the
 trainer's event plane) can attribute a failure to the tier that produced
 it: storage (``CheckpointError``), the input pipeline (``ReaderError``),
-or the numerics of the step itself (``TooManyBadSteps``).
+the numerics of the step itself (``TooManyBadSteps``), or the cluster
+runtime (``GangError`` / ``GangFailedError``).
 """
 
 from __future__ import annotations
 
-__all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps"]
+from typing import List, Optional
+
+__all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps",
+           "GangError", "GangFailedError"]
 
 
 class CheckpointError(RuntimeError):
@@ -29,3 +33,24 @@ class TooManyBadSteps(RuntimeError):
     """The bad-step guard skipped ``max_bad_steps`` consecutive updates —
     the loss/gradients are persistently non-finite and continuing would
     only burn accelerator time."""
+
+
+class GangError(RuntimeError):
+    """A gang coordination primitive failed on the WORKER side: a barrier
+    or coordinator-broadcast timed out (a peer likely died mid-protocol).
+    The supervisor treats the resulting nonzero exit like any rank death
+    and relaunches the gang."""
+
+
+class GangFailedError(RuntimeError):
+    """The gang supervisor burned its restart budget (or deadline).
+
+    ``reports`` carries per-attempt, per-rank attribution
+    (:class:`~paddle_tpu.resilience.cluster.RankReport`): which rank died
+    with what exit code, which rank hung and how stale its heartbeat was,
+    and which ranks were merely gang-killed alongside the culprit.
+    """
+
+    def __init__(self, message: str, *, reports: Optional[List] = None) -> None:
+        super().__init__(message)
+        self.reports = list(reports or [])

@@ -12,6 +12,17 @@ on exit.  Installation is best-effort: ``signal.signal`` only works in the
 main thread — elsewhere the handler degrades to the programmatic
 ``request()`` path (which is also how the chaos harness delivers simulated
 preemptions without killing the test process).
+
+Multi-host: with a gang attached (``handler.gang``, wired by the trainer
+from ``resilience.cluster.current_gang()``), ``poll()`` is a GANG-AGREED
+decision — the local latch is OR-reduced across every process, so a
+SIGTERM delivered to one host makes the whole gang checkpoint at the same
+consistent point instead of leaving N-1 ranks to die mid-collective.
+``poll()`` is called by the trainer at every batch boundary, the one spot
+every rank passes symmetrically: on live pods the reduce is a DCN
+collective and MUST be executed by all processes in lockstep, which is
+also why the ``requested`` property stays local and side-effect-free —
+reading it from an event handler on one rank can never deadlock the pod.
 """
 
 from __future__ import annotations
@@ -30,12 +41,16 @@ class PreemptionHandler:
     """Latches a preemption request from OS signals or ``request()``."""
 
     def __init__(self, signals: Tuple[int, ...] = (_signal.SIGTERM,
-                                                   _signal.SIGINT)) -> None:
+                                                   _signal.SIGINT),
+                 gang=None) -> None:
         self.signals = tuple(signals)
         self._requested = threading.Event()
         self._prev: Dict[int, object] = {}
         self._installed = False
         self.signum: Optional[int] = None
+        # a resilience.cluster gang context (or None): requested becomes
+        # the OR over all ranks' local latches
+        self.gang = gang
 
     # -- context manager -------------------------------------------------
 
@@ -94,8 +109,28 @@ class PreemptionHandler:
         self.signum = signum
         self._requested.set()
 
+    def poll(self) -> bool:
+        """Gang-agreed preemption check — the trainer's batch-boundary
+        probe.  Without a gang this is just the local latch; with one,
+        the latch is OR-reduced across ranks (flag files on the shared
+        dir, or a DCN allgather on live pods — every rank calls poll()
+        at every boundary, keeping the collective symmetric), and a
+        gang-sourced request latches locally so the decision is sticky
+        even if the flag's origin rank exits first."""
+        local = self._requested.is_set()
+        gang = self.gang
+        if gang is None or gang.size <= 1:
+            return local
+        if gang.agree_preempt(local):
+            self._requested.set()
+            return True
+        return False
+
     @property
     def requested(self) -> bool:
+        """Local latch only — side-effect-free and collective-free, safe
+        to read from any rank or thread.  Gang agreement happens in
+        ``poll()``."""
         return self._requested.is_set()
 
     def clear(self) -> None:

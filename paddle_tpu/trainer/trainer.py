@@ -31,6 +31,7 @@ from paddle_tpu.resilience import (PreemptionHandler, ReaderError,
 from paddle_tpu.resilience.checkpoint_io import (latest_pass, load_checkpoint,
                                                  read_manifest, pass_dir,
                                                  save_checkpoint)
+from paddle_tpu.resilience.cluster import current_gang
 from paddle_tpu.trainer import events as ev
 from paddle_tpu.utils import FLAGS, logger
 
@@ -138,6 +139,8 @@ class SGDTrainer:
                               else int(max_bad_steps))
         self.bad_steps_total = 0
         self._bad_streak = 0
+        # gang context (resilience/cluster.py) — bound per train() call
+        self._gang = None
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -398,6 +401,15 @@ class SGDTrainer:
           teardown on failure) and re-raises as ``ReaderError`` so the
           crash is attributed to the data tier, not the step.
 
+        Gang mode (a supervised rank, or a live multi-process
+        ``jax.distributed`` run — ``resilience.cluster.current_gang()``):
+        the loop heartbeats at every batch boundary (a wedged collective
+        goes silent and the supervisor restarts the gang), the preemption
+        request is OR-reduced across ranks so everyone checkpoints at a
+        consistent boundary, checkpoints are published by rank 0 behind
+        an all-ranks barrier, and auto-resume follows the COORDINATOR's
+        notion of the latest valid pass.
+
         Instrumentation mirrors the reference's Stat plane: named timers
         around data-wait / step / eval (REGISTER_TIMER in
         TrainerInternal.cpp:118), a per-pass timing table behind
@@ -410,6 +422,7 @@ class SGDTrainer:
         log_period = FLAGS.log_period
         profiling = bool(FLAGS.profile_dir)
 
+        gang = self._gang = current_gang()
         resume = resume or FLAGS.resume or None
         start_pass, start_batch = FLAGS.start_pass, 0
         if resume == "auto":
@@ -419,6 +432,10 @@ class SGDTrainer:
         if (preemption is None and FLAGS.save_dir
                 and FLAGS.checkpoint_on_preemption):
             preemption = PreemptionHandler()
+        if (preemption is not None and gang is not None
+                and getattr(preemption, "gang", None) is None):
+            # one host's SIGTERM becomes a gang-agreed checkpoint decision
+            preemption.gang = gang
         self.preempted = False
         if preemption is not None:
             preemption.install()
@@ -450,7 +467,12 @@ class SGDTrainer:
                     logger.info("resuming pass %d at batch %d", pass_id, skip)
                 batch_id = 0
                 while True:
-                    if preemption is not None and preemption.requested:
+                    if gang is not None:
+                        # liveness signal from the MAIN thread: a rank
+                        # stuck in a collective stops heartbeating here
+                        # and the supervisor's watchdog gang-restarts it
+                        gang.heartbeat()
+                    if preemption is not None and preemption.poll():
                         self._preempt_exit(pass_id, batch_id, preemption)
                         return
                     with timer("DataWaitTimer"):
@@ -535,10 +557,18 @@ class SGDTrainer:
 
     def _auto_resume(self) -> tuple:
         """Locate the newest valid checkpoint under FLAGS.save_dir and
-        restore it; returns ``(start_pass, start_batch)``."""
+        restore it; returns ``(start_pass, start_batch)``.
+
+        In a gang, the checkpoint is resolved ON THE COORDINATOR and
+        broadcast: a pass that happens to look newest/valid to one rank's
+        local view but not the coordinator's can never fork the gang onto
+        different restore points."""
         save_dir = FLAGS.save_dir
         if not save_dir:
             return FLAGS.start_pass, 0
+        gang = getattr(self, "_gang", None)
+        if gang is not None and gang.size > 1:
+            return self._gang_auto_resume(gang, save_dir)
         p = latest_pass(save_dir)
         if p < 0:
             logger.info("resume=auto: no valid checkpoint under %r, "
@@ -548,6 +578,10 @@ class SGDTrainer:
         # decompress-and-hash pass (restart latency sits inside the
         # preemption grace window)
         manifest = self.load(save_dir, p, validate=False)
+        return self._resume_point(p, manifest)
+
+    @staticmethod
+    def _resume_point(p: int, manifest) -> tuple:
         meta = (manifest or {}).get("meta", {})
         if meta.get("preempted"):
             nb = int(meta.get("next_batch", 0))
@@ -556,6 +590,30 @@ class SGDTrainer:
             return p, nb
         logger.info("resume=auto: resuming after completed pass %d", p)
         return p + 1, 0
+
+    def _gang_auto_resume(self, gang, save_dir: str) -> tuple:
+        """Coordinator resolves ``latest_valid_pass`` and broadcasts the
+        decision; every rank restores that exact pass."""
+        if gang.is_coordinator:
+            p = latest_pass(save_dir)
+            if p < 0:
+                sp, sb = FLAGS.start_pass, 0
+                logger.info("resume=auto: coordinator found no valid "
+                            "checkpoint under %r, gang starts fresh",
+                            save_dir)
+            else:
+                manifest = self.load(save_dir, p, validate=False)
+                sp, sb = self._resume_point(p, manifest)
+            gang.broadcast_json({"pass": p, "start_pass": sp,
+                                 "start_batch": sb}, name="resume")
+            return sp, sb
+        decision = gang.broadcast_json(None, name="resume")
+        p = int(decision["pass"])
+        if p >= 0:
+            # peers did not run the coordinator's validating latest_pass —
+            # CRC-verify their own view of the chosen checkpoint on load
+            self.load(save_dir, p, validate=True)
+        return int(decision["start_pass"]), int(decision["start_batch"])
 
     # ------------------------------------------------------------------
 
@@ -663,7 +721,19 @@ class SGDTrainer:
         """Atomic, CRC-manifested checkpoint (resilience/checkpoint_io.py):
         params + state + optimizer slots + averaged params, with the RNG
         key in the manifest so a resumed run continues the exact random
-        stream.  Retention (``FLAGS.keep_last_n``) prunes old passes."""
+        stream.  Retention (``FLAGS.keep_last_n``) prunes old passes.
+
+        Gang mode: only rank 0 writes — replicas hold identical params,
+        so N ranks writing N copies buys nothing but torn races — and the
+        rename-publish happens behind an all-ranks barrier (every rank
+        calls ``save()`` at the same loop point; non-coordinators just
+        join the barrier).  A checkpoint therefore exists only if the
+        WHOLE gang finished the pass: no rank can later auto-resume past
+        a point a dead peer never reached."""
+        gang = getattr(self, "_gang", None)
+        if gang is not None and gang.size > 1 and not gang.is_coordinator:
+            gang.barrier()  # matches the coordinator's pre-publish barrier
+            return pass_dir(save_dir, pass_id)
         meta = dict(meta or {})
         meta.setdefault("rng_key", self._rng_to_list(self._rng))
         extra = {}
@@ -673,6 +743,8 @@ class SGDTrainer:
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
             extra=extra or None, meta=meta,
+            barrier=(gang.barrier if gang is not None and gang.size > 1
+                     else None),
         )
 
     def load(self, save_dir: str, pass_id: int, *,

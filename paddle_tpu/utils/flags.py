@@ -16,7 +16,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
-__all__ = ["FLAGS", "define_flag", "parse_flags", "flags_snapshot"]
+__all__ = ["FLAGS", "define_flag", "parse_flags", "flags_snapshot",
+           "flags_help"]
 
 _ENV_PREFIX = "PADDLE_TPU_"
 
@@ -145,6 +146,17 @@ def flags_snapshot() -> Dict[str, Any]:
     return FLAGS.as_dict()
 
 
+def flags_help() -> str:
+    """One line per registered flag — the ``--help`` surface of the CLI
+    (the reference printed its gflags table the same way)."""
+    lines = []
+    for name in sorted(FLAGS._specs):
+        spec = FLAGS._specs[name]
+        head = f"  --{name}={spec.default!r}"
+        lines.append(f"{head:<40} {spec.help}" if spec.help else head)
+    return "\n".join(lines)
+
+
 # --- Core flag set (TPU-native analog of paddle/utils/Flags.cpp:18-77) ---
 
 # Device / platform (replaces use_gpu, gpu_id, parallel_nn ...)
@@ -189,6 +201,17 @@ define_flag("checkpoint_on_preemption", True, "on SIGTERM/SIGINT, write an "
             "(needs --save_dir; resume with --resume=auto)")
 define_flag("reader_retries", 0, "CLI: wrap the config's reader in "
             "resilience.resilient_reader with this retry budget (0 = off)")
+
+# Gang supervision (resilience/cluster.py; docs/resilience.md multi-host)
+define_flag("gang_max_restarts", 3, "gang supervisor: relaunch the whole "
+            "gang at most N times after a rank dies or hangs before "
+            "raising GangFailedError")
+define_flag("gang_heartbeat_s", 5.0, "supervised ranks touch their "
+            "heartbeat file at batch boundaries, at most every N seconds")
+define_flag("gang_watchdog_s", 60.0, "gang supervisor: a rank whose "
+            "heartbeat is older than N seconds is declared hung and the "
+            "gang is restarted (JAX collectives deadlock, not error, when "
+            "a peer dies)")
 
 # Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
 define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")

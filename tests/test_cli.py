@@ -50,6 +50,25 @@ def test_cli_time(capsys):
     assert "ms/batch" in capsys.readouterr().out
 
 
+def test_cli_help_lists_flags(capsys):
+    """--help prints the registered flag table (the gflags-print analog)
+    without requiring --config; gang supervision knobs must be surfaced."""
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "usage: python -m paddle_tpu" in out
+    for flag in ("--gang_max_restarts", "--gang_heartbeat_s",
+                 "--gang_watchdog_s", "--resume", "--save_dir"):
+        assert flag in out, flag
+    assert main(["-h", "--job=train"]) == 0  # -h wins over other args
+    # the lint subcommand keeps its OWN argparse help surface
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as ei:
+        main(["lint", "--help"])
+    assert ei.value.code == 0
+    out = capsys.readouterr().out
+    assert "lint" in out and "--gang_max_restarts" not in out
+
+
 def test_cli_rejects_bad_args():
     with pytest.raises(ConfigError, match="unrecognized"):
         main([f"--config={CONF}", "--job=train", "--no_such_flag=1"])

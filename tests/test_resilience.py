@@ -167,11 +167,63 @@ def test_keep_last_n_retention_and_tmp_sweep(tmp_path):
         save_checkpoint(str(tmp_path), i, params=_params())
     junk = tmp_path / ".tmp-pass-00099-dead"
     junk.mkdir()
+    os.utime(junk, (1, 1))  # debris from a long-crashed save, not in-flight
     save_checkpoint(str(tmp_path), 5, params=_params(), keep_last_n=2)
     assert sorted(os.listdir(tmp_path)) == ["pass-00004", "pass-00005"]
     assert not junk.exists()  # abandoned temp dirs swept
     removed = prune_checkpoints(str(tmp_path), 1)
     assert sorted(os.listdir(tmp_path)) == ["pass-00005"] and removed
+
+
+def test_prune_leaves_inflight_tmp_dirs_alone(tmp_path):
+    """Satellite (review fix): a FRESH temp dir belongs to a concurrent
+    writer mid-save — sweeping it would destroy the checkpoint being
+    written.  Only aged debris is swept; a dir that vanishes between
+    listdir and stat (concurrent prune) is tolerated, not raised."""
+    save_checkpoint(str(tmp_path), 0, params=_params())
+    inflight = tmp_path / ".tmp-pass-00001-beef1234"
+    inflight.mkdir()  # mtime = now: in-flight
+    old = tmp_path / ".tmp-pass-00001-dead5678"
+    old.mkdir()
+    os.utime(old, (1, 1))
+    # an AGED dir whose contents are still being written is in-flight too
+    # (dir mtime doesn't advance while one huge npz streams)
+    slow = tmp_path / ".tmp-pass-00002-slow9abc"
+    slow.mkdir()
+    (slow / "params.npz").write_bytes(b"partial")  # fresh file inside
+    os.utime(slow, (1, 1))
+    removed = prune_checkpoints(str(tmp_path), 1)
+    assert inflight.exists() and slow.exists() and not old.exists()
+    assert str(old) in removed
+    # missing save_dir stays a no-op, not an error
+    assert prune_checkpoints(str(tmp_path / "nope"), 1) == []
+
+
+def test_save_checkpoint_barrier_gates_the_publish(tmp_path):
+    """Multi-host commit protocol: the barrier fires after the temp dir is
+    fully written but BEFORE the rename — and a barrier failure (peer
+    died) discards the temp dir, keeping the previous checkpoint."""
+    seen = {}
+
+    def barrier():
+        seen["tmps"] = [n for n in os.listdir(tmp_path)
+                        if n.startswith(".tmp-")]
+        seen["published"] = os.path.isdir(pass_dir(str(tmp_path), 0))
+
+    save_checkpoint(str(tmp_path), 0, params=_params(), meta={"v": 1},
+                    barrier=barrier)
+    assert seen["tmps"] and not seen["published"]  # written, not yet visible
+    assert validate_checkpoint(pass_dir(str(tmp_path), 0)) is None
+
+    def broken_barrier():
+        raise RuntimeError("peer died mid-save")
+
+    with pytest.raises(RuntimeError, match="peer died"):
+        save_checkpoint(str(tmp_path), 0, params=_params(), meta={"v": 2},
+                        barrier=broken_barrier)
+    # previous checkpoint intact, no temp debris
+    assert read_manifest(pass_dir(str(tmp_path), 0))["meta"]["v"] == 1
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
 
 
 # ---------------------------------------------------------------------------

@@ -180,10 +180,13 @@ JOBS = {
 
 _USAGE = """\
 usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [--flag=value ...]
-       python -m paddle_tpu lint [--config CONF|--path DIR] ...
+       python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE] ...
+       python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
 
 The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
-module docstring of paddle_tpu/__main__.py).  Flags (also settable via
+module docstring of paddle_tpu/__main__.py).  `serve` runs the
+overload-safe inference runtime (docs/serving.md) over a deploy bundle,
+configured by the --serve_* flags below.  Flags (also settable via
 PADDLE_TPU_<NAME> env vars):
 """
 
@@ -203,9 +206,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return lint_run(argv[1:])
     if "-h" in argv or "--help" in argv:
+        # also covers `serve --help`: the serve knobs are registered
+        # --serve_* flags, so the global table IS its help surface (only
+        # lint, handled above, keeps a separate argparse help)
         print(_USAGE)
         print(flags_help())
         return 0
+    if argv and argv[0] == "serve":
+        # the serving runtime (docs/serving.md) is driven by the
+        # registered --serve_* flags; its runner does its own init()
+        from paddle_tpu.serving.cli import run as serve_run
+
+        return serve_run(argv[1:])
     rest = init(argv)
     if rest:
         raise ConfigError(f"unrecognized arguments: {rest}")

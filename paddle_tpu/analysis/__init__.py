@@ -10,8 +10,9 @@ the check catalog.
 """
 
 from paddle_tpu.analysis.findings import (Finding, SEVERITIES,
-                                          apply_allowlist, format_findings,
-                                          load_allowlist, severity_at_least)
+                                          apply_allowlist, errors_summary,
+                                          format_findings, load_allowlist,
+                                          severity_at_least)
 from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
                                             hlo_control_flow, walk_eqns)
 from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
@@ -24,6 +25,7 @@ __all__ = [
     "Finding",
     "SEVERITIES",
     "severity_at_least",
+    "errors_summary",
     "apply_allowlist",
     "load_allowlist",
     "format_findings",

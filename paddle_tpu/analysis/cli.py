@@ -6,11 +6,18 @@ Usage:
     python -m paddle_tpu lint --config demo/mnist/conf.py --fail-on WARN
     python -m paddle_tpu lint --config conf.py --allowlist .tpu-lint-allow
     python -m paddle_tpu lint --decode B,S,K,L
+    python -m paddle_tpu lint --serve model.ptz
 
 ``--path DIR`` runs the AST trace-safety linter over the tree;
 ``--config CONF.py`` additionally builds the config's trainer and audits
 the closed jaxpr of its train step (the jaxpr auditor).  Both may repeat.
 With neither, the installed ``paddle_tpu`` package itself is linted.
+
+``--serve BUNDLE.ptz`` is the serving preflight: the bundle's inference
+closure is audited with the serving check set (host transfers on the
+request path, >1 MiB folded constants — weights must ride as arguments,
+not baked into the executable), the same gate
+``InferenceServer.start(preflight=True)`` applies before reporting ready.
 
 ``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
 generation path (Seq2SeqAttention.beam_search over the fused decode
@@ -117,6 +124,32 @@ def _audit_decode_closure(spec: str) -> List[Finding]:
     return findings
 
 
+def _audit_serving_bundle(bundle: str) -> List[Finding]:
+    """``lint --serve BUNDLE.ptz``: load the deploy bundle and trace its
+    serving closure through the auditor's host-transfer/constant-bloat
+    checks — the same preflight ``InferenceServer.start(preflight=True)``
+    runs before reporting ready (fail-fast, like ``v2.infer(audit=True)``).
+    Bundle-integrity failures (BundleCorruptError) are findings too: a
+    corrupt artifact must fail lint, not crash it."""
+    try:
+        from paddle_tpu.config.deploy import load_inference_model
+
+        model = load_inference_model(bundle)
+    except Exception as e:
+        return [Finding(
+            check="serve-build", severity="ERROR", file=bundle,
+            message=f"bundle failed to load: {type(e).__name__}: {e}")]
+    try:
+        from paddle_tpu.serving.preflight import audit_serving
+
+        return audit_serving(model, label=f"serve:{os.path.basename(bundle)}")
+    except Exception as e:  # a closure that fails to TRACE is a finding
+        return [Finding(
+            check="serve-build", severity="ERROR", file=bundle,
+            message=f"serving closure failed to trace: "
+                    f"{type(e).__name__}: {e}")]
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu lint",
@@ -130,6 +163,11 @@ def run(argv: Optional[List[str]] = None) -> int:
                    metavar="B,S,K,L",
                    help="audit the flagship fused-decode closure "
                         "(kernel + XLA-fallback variants) at these shapes")
+    p.add_argument("--serve", action="append", default=[],
+                   metavar="BUNDLE.ptz",
+                   help="serving preflight: audit a deploy bundle's "
+                        "serving closure (host-transfer/constant-bloat; "
+                        "repeatable)")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--fail-on", default="ERROR", type=str.upper,
                    choices=("ERROR", "WARN", "INFO", "NEVER"),
@@ -141,7 +179,7 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     targets = list(ns.path)
     configs = list(ns.config)
-    if not targets and not configs and ns.decode is None:
+    if not targets and not configs and ns.decode is None and not ns.serve:
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -158,6 +196,8 @@ def run(argv: Optional[List[str]] = None) -> int:
         findings.extend(_audit_config(conf))
     if ns.decode is not None:
         findings.extend(_audit_decode_closure(ns.decode))
+    for bundle in ns.serve:
+        findings.extend(_audit_serving_bundle(bundle))
 
     if ns.allowlist:
         findings = apply_allowlist(findings, load_allowlist(ns.allowlist))

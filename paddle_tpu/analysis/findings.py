@@ -27,6 +27,7 @@ __all__ = [
     "Finding",
     "SEVERITIES",
     "severity_at_least",
+    "errors_summary",
     "line_suppressions",
     "load_allowlist",
     "apply_allowlist",
@@ -40,6 +41,18 @@ SEVERITIES = ("INFO", "WARN", "ERROR")
 def severity_at_least(findings: Iterable["Finding"], level: str) -> List["Finding"]:
     floor = SEVERITIES.index(level)
     return [f for f in findings if SEVERITIES.index(f.severity) >= floor]
+
+
+def errors_summary(findings) -> Optional[str]:
+    """One ``check@location: message`` line per ERROR finding, joined
+    with '; ' — THE formatting of every fail-fast audit gate
+    (``v2.infer(audit=True)``, ``serving.check_serving``), so the two
+    surfaces can never drift.  None when no ERROR survives."""
+    bad = [f for f in findings if f.severity == "ERROR"]
+    if not bad:
+        return None
+    return "; ".join(f"{f.check}@{f.where or f.location()}: {f.message}"
+                     for f in bad)
 
 
 @dataclass(frozen=True)

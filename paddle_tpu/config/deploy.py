@@ -29,9 +29,24 @@ from paddle_tpu.nn.graph import Topology
 from paddle_tpu.proto import model_config_pb2 as pb
 
 __all__ = ["merge_model", "InferenceModel", "load_inference_model",
-           "export_aot", "export_aot_hlo"]
+           "export_aot", "export_aot_hlo", "BundleCorruptError"]
 
 _MAGIC = "paddle_tpu.bundle.v1"
+
+
+class BundleCorruptError(RuntimeError):
+    """A ``.ptz`` bundle failed integrity validation: truncated/not a zip,
+    a member missing, a member's CRC or compressed stream damaged, or a
+    payload that no longer parses.  ``member`` names the failing zip
+    member (None when the archive itself is unreadable) so storage-tier
+    faults are attributed precisely — the serving tier's analog of the
+    checkpoint manifest's CRC validation (docs/resilience.md)."""
+
+    def __init__(self, message: str, *, path: str = "",
+                 member: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.path = path
+        self.member = member
 
 
 def _npz_bytes(tree: Dict[str, Any]) -> bytes:
@@ -145,6 +160,19 @@ class InferenceModel:
             for k, v in init_s.items()
         }
         self._fns: Dict[tuple, Any] = {}
+        #: required-input-slot sets per output tuple — the topology walk
+        #: is a pure function of the names, so the serving hot path (one
+        #: infer per coalesced batch) must not re-walk the graph per call
+        self._needed_slots: Dict[tuple, frozenset] = {}
+        #: zero-row replies per (names, per-row feed shapes) — eval_shape
+        #: is a full trace; a trickle of empty requests must not re-pay it
+        self._empty_cache: Dict[tuple, Dict[str, np.ndarray]] = {}
+        # serializes compile-cache misses only: N threads hammering one
+        # model (the serving worker + callers) race on dict insert and
+        # would otherwise trace the same signature concurrently; the hot
+        # path (cache hit) stays lock-free — dict reads are atomic and
+        # jitted calls are thread-safe
+        self._fns_lock = threading.Lock()
 
     @property
     def input_names(self) -> List[str]:
@@ -154,32 +182,147 @@ class InferenceModel:
     def output_names(self) -> List[str]:
         return list(self.model_config.output_layer_names)
 
+    def _check_feed(self, feed: Dict[str, Any], names: tuple) -> None:
+        # only the data layers REACHABLE from the requested outputs are
+        # required (a classifier bundle serves 'out' without its training
+        # 'label' slot); a miss is named instead of surfacing as a
+        # ConfigError deep inside the jitted apply.  The walk is cached
+        # per output tuple — the serving worker calls infer once per
+        # batch and must not pay O(graph) Python per call.
+        need = self._needed_slots.get(names)
+        if need is None:
+            needed = self.topology._needed_layers(set(names))
+            need = frozenset(l.name for l in needed if l.is_data)
+            self._needed_slots[names] = need
+        missing = sorted(need - set(feed))
+        if missing:
+            raise ValueError(
+                f"feed is missing input slot(s) {missing}; outputs "
+                f"{list(names)} need inputs {sorted(need)}")
+
+    def _make_run(self, names: tuple):
+        def run(params, state, feed):
+            outs, _ = self.topology.apply(
+                params, state, feed, train=False, outputs=list(names)
+            )
+            return {n: outs[n].value for n in names}
+
+        return run
+
     def infer(
         self, feed: Dict[str, Any], outputs: Optional[Sequence[str]] = None
     ) -> Dict[str, np.ndarray]:
         names = tuple(outputs) if outputs else tuple(self.output_names)
+        self._check_feed(feed, names)
+        rows = {np.asarray(p).shape[0] if np.asarray(p).ndim else -1
+                for v in feed.values()
+                for p in (v if isinstance(v, tuple) else (v,))}
+        if 0 in rows:
+            if rows != {0}:
+                # a zero-row part next to populated parts is a client bug,
+                # not an empty request — silently replying empty would
+                # discard the populated rows
+                raise ValueError(
+                    f"feed mixes zero-row and populated inputs (batch "
+                    f"sizes {sorted(rows)}); an empty request must be "
+                    f"empty in every slot")
+            # zero input rows: shape-infer over a synthetic one-row feed
+            # and reply with correctly-shaped empty arrays — never a
+            # cryptic reshape error, never a degenerate B=0 compile.
+            # Cached like _fns: eval_shape is a full O(graph) trace, and
+            # the output shapes depend only on (names, per-row shapes)
+            key = (names, tuple(
+                (k, isinstance(v, tuple))
+                + tuple((np.asarray(p).shape[1:], str(np.asarray(p).dtype))
+                        for p in (v if isinstance(v, tuple) else (v,)))
+                for k, v in sorted(feed.items())))
+            res = self._empty_cache.get(key)
+            if res is None:
+                from paddle_tpu.nn.feeds import empty_outputs, zero_batch_like
+
+                res = empty_outputs(self._make_run(names), self.params,
+                                    self.state, zero_batch_like(feed))
+                if len(self._empty_cache) >= 64:
+                    # keys are client-controlled (per-row shapes): bound
+                    # the cache so shape-diverse empty traffic cannot
+                    # grow it without limit
+                    self._empty_cache.clear()
+                self._empty_cache[key] = res
+            return {k: np.asarray(v) for k, v in res.items()}
         fn = self._fns.get(names)
         if fn is None:
-            def run(params, state, feed):
-                outs, _ = self.topology.apply(
-                    params, state, feed, train=False, outputs=list(names)
-                )
-                return {n: outs[n].value for n in names}
-
-            fn = self._fns[names] = jax.jit(run)
+            with self._fns_lock:
+                fn = self._fns.get(names)
+                if fn is None:
+                    fn = self._fns[names] = jax.jit(self._make_run(names))
         res = fn(self.params, self.state, feed)
         return {k: np.asarray(v) for k, v in res.items()}
 
 
+def _read_member(z: zipfile.ZipFile, path: str, name: str) -> bytes:
+    """Read one zip member with integrity attribution: a missing member,
+    a bad CRC, or a torn compressed stream raises ``BundleCorruptError``
+    naming the member instead of a raw ``KeyError``/``BadZipFile``.
+    ``zipfile`` verifies the stored CRC-32 on every full read, so a
+    bit-flip anywhere in the payload is caught here."""
+    import zlib
+
+    try:
+        return z.read(name)
+    except KeyError:
+        raise BundleCorruptError(
+            f"bundle {path!r} is missing member {name!r} (truncated or "
+            f"damaged archive?)", path=path, member=name) from None
+    except (zipfile.BadZipFile, zlib.error, EOFError) as e:
+        raise BundleCorruptError(
+            f"bundle {path!r} member {name!r} is corrupt: {e}",
+            path=path, member=name) from e
+
+
 def load_inference_model(path: str) -> InferenceModel:
-    with zipfile.ZipFile(path, "r") as z:
-        manifest = json.loads(z.read("manifest.json"))
-        if manifest.get("magic") != _MAGIC:
+    try:
+        zf = zipfile.ZipFile(path, "r")
+    except FileNotFoundError:
+        raise  # a missing file is not a corrupt one
+    except (zipfile.BadZipFile, OSError) as e:
+        raise BundleCorruptError(
+            f"{path!r} is not a readable zip archive: {e}", path=path) from e
+    with zf as z:
+        try:
+            manifest = json.loads(_read_member(z, path, "manifest.json"))
+        except json.JSONDecodeError as e:
+            raise BundleCorruptError(
+                f"bundle {path!r} manifest.json does not parse: {e}",
+                path=path, member="manifest.json") from e
+        if not isinstance(manifest, dict) or manifest.get("magic") != _MAGIC:
             raise ValueError(f"{path!r} is not a paddle_tpu model bundle")
         mc = pb.ModelConfig()
-        mc.ParseFromString(z.read("model.pb"))
-        params = _npz_load(z.read("params.npz"))
-        state = _npz_load(z.read("state.npz")) if "state.npz" in z.namelist() else {}
+        try:
+            mc.ParseFromString(_read_member(z, path, "model.pb"))
+        except Exception as e:
+            if isinstance(e, BundleCorruptError):
+                raise
+            raise BundleCorruptError(
+                f"bundle {path!r} model.pb does not parse: {e}",
+                path=path, member="model.pb") from e
+        try:
+            params = _npz_load(_read_member(z, path, "params.npz"))
+        except BundleCorruptError:
+            raise
+        except Exception as e:  # np.load on a damaged npz payload
+            raise BundleCorruptError(
+                f"bundle {path!r} params.npz does not parse: {e}",
+                path=path, member="params.npz") from e
+        state = {}
+        if "state.npz" in z.namelist():
+            try:
+                state = _npz_load(_read_member(z, path, "state.npz"))
+            except BundleCorruptError:
+                raise
+            except Exception as e:
+                raise BundleCorruptError(
+                    f"bundle {path!r} state.npz does not parse: {e}",
+                    path=path, member="state.npz") from e
     return InferenceModel(mc, params, state, manifest)
 
 

@@ -83,7 +83,19 @@ class DataFeeder:
     def __call__(self, batch_rows: List[Tuple]) -> Dict[str, Any]:
         feed: Dict[str, Any] = {}
         for name, kind in self.types.items():
-            col = [row[self.feeding[name]] for row in batch_rows]
+            idx = self.feeding.get(name)
+            if idx is None:
+                raise ValueError(
+                    f"slot {name!r} is missing from the feeding map "
+                    f"{self.feeding} — every typed slot needs a field "
+                    f"index")
+            try:
+                col = [row[idx] for row in batch_rows]
+            except (IndexError, KeyError) as e:
+                raise ValueError(
+                    f"input rows do not carry slot {name!r} (field index "
+                    f"{idx}): a row has too few fields — feeding map is "
+                    f"{self.feeding}") from e
             if kind == "dense":
                 feed[name] = np.asarray(col, self.dtype)
             elif kind == "int":

@@ -1,0 +1,119 @@
+"""Synthetic feeds derived from a Topology's input specs.
+
+Lives in the nn tier (a pure Topology utility): consumers span every
+tier above — ``config.deploy`` and ``v2.infer`` for empty-input replies,
+and the serving runtime (re-exported as ``paddle_tpu.serving.feeds``).
+Three serving jobs need a feed *without* having seen real traffic yet:
+
+- the **warmup/readiness gate** primes the jit caches for every batch
+  bucket before the server reports ready (a cold compile on the first
+  user request would blow any deadline by seconds);
+- the **lint --serve preflight** traces the serving closure through the
+  jaxpr auditor at startup;
+- **empty-input requests** (``v2.infer(input=[])`` and zero-row
+  ``InferenceModel.infer`` feeds) must return correctly-shaped empty
+  outputs — the output shapes come from ``jax.eval_shape`` over a
+  one-row synthetic feed, with the batch dim zeroed.
+
+Every data-layer kind the feeder produces is covered (dense / int /
+image NHWC / sequences / nested / sparse COO), built from the layer's
+``size`` + ``data_spec`` + ``meta['hw']`` alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["example_feed", "zero_batch_like", "empty_outputs"]
+
+
+def example_feed(topology, *, batch: int = 1, seq_len: int = 8,
+                 nnz: int = 4) -> Dict[str, Any]:
+    """A valid all-zeros feed for every data layer of ``topology``.
+
+    Token ids are 0 (always in-vocab), lengths are full (no masking edge
+    cases at trace time), sparse bags carry one feature per row."""
+    feed: Dict[str, Any] = {}
+    B, T = int(batch), int(seq_len)
+    for layer in topology.data_layers:
+        spec = layer.data_spec or {}
+        size = max(int(layer.size), 1)
+        is_int = spec.get("dtype") == "int32"
+        sparse = spec.get("sparse")
+        if sparse and spec.get("is_seq"):
+            ids = np.zeros((B, T, nnz), np.int32)
+            bag = np.ones((B, T), np.int32)
+            lens = np.full((B,), T, np.int32)
+            if sparse == "float":
+                feed[layer.name] = (ids, np.zeros((B, T, nnz), np.float32),
+                                    bag, lens)
+            else:
+                feed[layer.name] = (ids, bag, lens)
+        elif sparse:
+            ids = np.zeros((B, nnz), np.int32)
+            bag = np.ones((B,), np.int32)
+            if sparse == "float":
+                feed[layer.name] = (ids, np.zeros((B, nnz), np.float32), bag)
+            else:
+                feed[layer.name] = (ids, bag)
+        elif spec.get("nested"):
+            To = Ti = max(2, min(T, 4))
+            if is_int:
+                value = np.zeros((B, To, Ti), np.int32)
+            else:
+                value = np.zeros((B, To, Ti, size), np.float32)
+            outer = np.full((B,), To, np.int32)
+            sub = np.full((B, To), Ti, np.int32)
+            feed[layer.name] = (value, outer, sub)
+        elif spec.get("is_seq"):
+            if is_int:
+                value = np.zeros((B, T), np.int32)
+            else:
+                value = np.zeros((B, T, size), np.float32)
+            feed[layer.name] = (value, np.full((B,), T, np.int32))
+        elif is_int:
+            feed[layer.name] = np.zeros((B, 1), np.int32)
+        elif layer.meta.get("hw"):
+            h, w = layer.meta["hw"]
+            feed[layer.name] = np.zeros((B, h, w, size), np.float32)
+        else:
+            feed[layer.name] = np.zeros((B, size), np.float32)
+    return feed
+
+
+def zero_batch_like(feed: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebuild a zero-row feed as a ONE-row feed of the same per-row
+    shapes (ints become ones — valid lengths/ids; floats become zeros),
+    for shape inference: ``jax.eval_shape`` over B=1 is well-defined
+    where a literal B=0 trace can hit degenerate reshapes."""
+    def one_row(a):
+        a = np.asarray(a)
+        shape = (1,) + a.shape[1:]
+        if a.dtype.kind in "iu":
+            return np.ones(shape, a.dtype)
+        return np.zeros(shape, a.dtype)
+
+    return {k: (tuple(one_row(p) for p in v) if isinstance(v, tuple)
+                else one_row(v))
+            for k, v in feed.items()}
+
+
+def empty_outputs(run_fn, params, state, feed_1row: Dict[str, Any]
+                  ) -> Any:
+    """Shape-infer ``run_fn(params, state, feed_1row)`` without compiling
+    or executing, then materialize the result pytree with the leading
+    (batch) dim set to 0 — the correctly-shaped empty reply for an
+    empty-input request."""
+    import jax
+
+    shapes = jax.eval_shape(run_fn, params, state, feed_1row)
+
+    def zero(s):
+        shape = tuple(s.shape)
+        shape = ((0,) + shape[1:]) if shape else shape
+        return np.zeros(shape, s.dtype)
+
+    return jax.tree_util.tree_map(zero, shapes)
+

@@ -25,9 +25,18 @@ sees, deterministically:
   ``corrupt_latest_checkpoint`` damages the newest pass dir between
   restarts.
 
-Used by tests/test_resilience.py and tests/test_gang.py to prove each
-recovery path end-to-end; equally usable interactively against a live
-save_dir.
+- serving (the overload-safe inference runtime, paddle_tpu/serving —
+  docs/serving.md): ``kill_worker`` crashes the supervised inference
+  worker with a batch in flight, ``latency_injection`` wraps a model
+  callable to stall chosen calls (the slow-backend / deadline-blowing
+  model), ``crash_calls`` makes chosen calls raise (the breaker-tripping
+  model), and ``slow_client`` paces a feed stream (the
+  trickle-submitting client admission control must not starve on).
+  Poisoned inference batches reuse ``nan_feed`` on the request feed.
+
+Used by tests/test_resilience.py, tests/test_gang.py, and
+tests/test_serving.py to prove each recovery path end-to-end; equally
+usable interactively against a live save_dir or server.
 """
 
 from __future__ import annotations
@@ -53,6 +62,10 @@ __all__ = [
     "hang_rank",
     "die_at",
     "stall_at",
+    "kill_worker",
+    "latency_injection",
+    "crash_calls",
+    "slow_client",
 ]
 
 
@@ -271,6 +284,74 @@ def stall_at(*, batch: int, pass_id: int = 0, marker: str,
             inner(e)
 
     return event_handler
+
+
+# ---------------------------------------------------------------------------
+# serving faults (paddle_tpu/serving; docs/serving.md)
+# ---------------------------------------------------------------------------
+
+
+def kill_worker(server) -> None:
+    """Crash the server's supervised inference worker with the NEXT
+    popped batch in flight (mid-batch, like a device wedge or OOM kill):
+    the in-flight requests must be failed with a typed ``WorkerCrashed``
+    — never silently dropped — and the supervisor must restart the
+    worker within its backoff budget."""
+    server.chaos_kill_worker()
+
+
+def _windowed(fn: Callable, at: int, times: int,
+              action: Callable[[int], None]) -> Callable:
+    """ONE call-window wrapper for the model-callable faults: counts
+    calls (0-based) across the wrapper's lifetime and runs ``action(i)``
+    before calls in ``[at, at+times)``.  ``functools.wraps`` is
+    load-bearing: the serving server dispatches tier options by
+    inspecting the callable's signature, and ``inspect.signature``
+    follows ``__wrapped__``."""
+    import functools
+
+    calls = [0]
+
+    @functools.wraps(fn)
+    def wrapped(feed, *rest):
+        i = calls[0]
+        calls[0] += 1
+        if at <= i < at + times:
+            action(i)
+        return fn(feed, *rest)
+
+    return wrapped
+
+
+def latency_injection(fn: Callable, *, at: int = 0, times: int = 1,
+                      delay_s: float = 0.2, sleep=_time.sleep) -> Callable:
+    """Wrap a model callable: calls ``at .. at+times-1`` stall ``delay_s``
+    before executing — the slow-backend fault that must surface as
+    ``DeadlineExceeded`` on the affected requests, not as a silent
+    latency cliff."""
+    return _windowed(fn, at, times, lambda i: sleep(delay_s))
+
+
+def crash_calls(fn: Callable, *, at: int = 0, times: int = 1,
+                exc: Callable[..., Exception] = RuntimeError) -> Callable:
+    """Wrap a model callable: calls ``at .. at+times-1`` raise ``exc`` —
+    the deterministically-failing backend that must trip the circuit
+    breaker after its threshold and recover via half-open probes once
+    the fault window passes."""
+    def action(i):
+        raise exc(f"chaos: injected model failure on call {i}")
+
+    return _windowed(fn, at, times, action)
+
+
+def slow_client(feeds: Iterable, *, delay_s: float = 0.05,
+                sleep=_time.sleep) -> Iterable:
+    """Yield request feeds with ``delay_s`` between them — the trickling
+    client: admission control must keep accepting (no starvation, no
+    spurious shedding) when load arrives slowly."""
+    for f in feeds:
+        yield f
+        sleep(delay_s)
 
 
 def preempt_at(handler, *, batch: int, pass_id: int = 0,

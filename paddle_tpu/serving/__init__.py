@@ -1,0 +1,70 @@
+"""``paddle_tpu.serving`` — the overload-safe inference runtime.
+
+PR 2/4 made *training* survive crashes, preemption, and hung ranks; this
+package gives the inference tier the same treatment (docs/serving.md):
+
+- **batching** — a bounded, deadline-aware micro-batching queue that
+  coalesces requests into the shape buckets deploy already compiles
+  (pad-to-bucket; never a fresh compile on the hot path);
+- **admission control** — queue-overflow and infeasible-deadline
+  requests are rejected *immediately* with typed ``ShedError`` /
+  ``DeadlineExceeded``; every accepted request is guaranteed a reply or
+  a typed error (the t5x/Orbax "reply-or-error, never silently drop"
+  contract the checkpoint tier already follows);
+- **breaker** — a circuit breaker around the compiled forward
+  (consecutive-failure trip, half-open probes);
+- **worker** — a supervised worker loop: crash/hang -> bounded-backoff
+  restart, with a warmup/readiness gate (compile caches primed before
+  the server reports ready);
+- **degradation** — under overload, generation requests step down the
+  configured tier ladder (greedy / shorter max_len) before shedding;
+- **observability** — rolling p50/p99, queue depth, shed/timeout/breaker
+  counters behind ``InferenceServer.healthz()``;
+- **preflight** — the jaxpr auditor's host-transfer/constant-bloat
+  checks over the serving closure at startup (``lint --serve``).
+
+Chaos-proven by tests/test_serving.py: worker kill mid-batch, NaN poison
+batches, latency injection, and overload bursts all resolve every request
+with a reply or a typed error.  CLI: ``python -m paddle_tpu serve``.
+"""
+
+from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
+                                       InferenceFailed, InvalidRequestError,
+                                       ServerClosed, ServingError, ShedError,
+                                       WorkerCrashed)
+from paddle_tpu.serving.batching import (BatchQueue, Request, ServingFuture,
+                                         batch_bucket, canonicalize_feed,
+                                         merge_feeds, split_outputs)
+from paddle_tpu.serving.breaker import CircuitBreaker
+from paddle_tpu.serving.metrics import ServerMetrics
+from paddle_tpu.serving.server import InferenceServer
+from paddle_tpu.serving.worker import WorkerSupervisor
+from paddle_tpu.serving.preflight import (SERVING_CHECKS, audit_serving,
+                                          check_serving)
+from paddle_tpu.serving import feeds
+
+__all__ = [
+    "ServingError",
+    "InvalidRequestError",
+    "ShedError",
+    "DeadlineExceeded",
+    "CircuitOpenError",
+    "WorkerCrashed",
+    "InferenceFailed",
+    "ServerClosed",
+    "ServingFuture",
+    "Request",
+    "BatchQueue",
+    "canonicalize_feed",
+    "merge_feeds",
+    "split_outputs",
+    "batch_bucket",
+    "CircuitBreaker",
+    "ServerMetrics",
+    "InferenceServer",
+    "WorkerSupervisor",
+    "SERVING_CHECKS",
+    "audit_serving",
+    "check_serving",
+    "feeds",
+]

@@ -1,0 +1,300 @@
+"""Bounded, deadline-aware dynamic micro-batching.
+
+The reference served a bare forward per request (paddle/capi); on TPU the
+economics invert — a compiled forward at batch 8 costs barely more than
+batch 1, but a *fresh compile* on the hot path costs seconds.  So the
+queue coalesces requests into the same shape buckets the deploy tier
+already compiles (``data.feeder.bucket_length`` for sequence dims, a
+power-of-two ladder for the batch dim) and pads by **replicating** rows
+— never inventing a new shape, never a degenerate zero-length sequence.
+
+Admission is bounded: ``offer`` raises :class:`ShedError` the moment the
+queue is full — the Clipper-style alternative (queue everything, time
+everything out) converts overload into 100% deadline misses.  Requests
+whose deadline expires while queued are swept out at pop time and
+completed with :class:`DeadlineExceeded`; they never reach the device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.data.feeder import bucket_length
+from paddle_tpu.serving.errors import ShedError
+
+__all__ = ["ServingFuture", "Request", "BatchQueue", "canonicalize_feed",
+           "merge_feeds", "split_outputs", "batch_bucket"]
+
+
+class ServingFuture:
+    """Reply slot for one request: exactly one of a result dict or a typed
+    error, set once (late writers lose — a request failed by a worker
+    crash stays failed even if the abandoned worker later completes)."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[Dict[str, np.ndarray]] = None
+        self._error: Optional[Exception] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _complete(self, result=None, error: Optional[Exception] = None) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._error = error
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def error(self, timeout: Optional[float] = None) -> Optional[Exception]:
+        """Wait and return the typed error (None on success) — the
+        non-raising probe the chaos tests use to assert zero drops."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still in flight")
+        return self._error
+
+
+@dataclass
+class Request:
+    feed: Dict[str, Any]          # canonicalized (seq dims bucket-padded)
+    rows: int
+    signature: Tuple
+    future: ServingFuture
+    deadline: Optional[float]     # absolute, clock() domain; None = no deadline
+    t_submit: float
+    deadline_ms: Optional[float] = None   # original budget, for reporting
+    tier: int = 0                 # degradation tier chosen at execution
+
+
+# ---------------------------------------------------------------------------
+# shape canonicalization: requests batch together iff signatures match
+# ---------------------------------------------------------------------------
+
+
+def _pad_dim1(arr: np.ndarray, to: int) -> np.ndarray:
+    if arr.ndim < 2 or arr.shape[1] >= to:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[1] = (0, to - arr.shape[1])
+    return np.pad(arr, pad)
+
+
+def canonicalize_feed(feed: Dict[str, Any]) -> Tuple[Dict[str, Any], int, Tuple]:
+    """Normalize one request's feed into its shape bucket.
+
+    Tuple-valued inputs are the framework's sequence/sparse convention
+    ``(value [B, T, ...], lengths/nnz [B], ...)``: every rank>=2 part has
+    its dim-1 (timesteps / nnz width) padded up to the feeder's bucket
+    ladder, so two requests with T=9 and T=13 both land in the T=16
+    bucket and batch together.  Zero-padding beyond ``lengths`` is
+    masked by the topology exactly as training feeds are.  Returns
+    ``(canonical_feed, rows, signature)``.
+    """
+    canon: Dict[str, Any] = {}
+    rows = None
+    sig: List[Tuple] = []
+    for name in sorted(feed):
+        v = feed[name]
+        parts = list(v) if isinstance(v, tuple) else [v]
+        # structure rides the signature: {'x': v} and {'x': (v,)} carry
+        # identical arrays but incompatible canon structures — they must
+        # never coalesce into one merge template
+        sig.append((name, len(parts) if isinstance(v, tuple) else -1))
+        out_parts = []
+        for p in parts:
+            a = np.asarray(p)
+            if a.ndim == 0:
+                raise ValueError(
+                    f"serving feed {name!r} must be batched arrays "
+                    f"(got a scalar)")
+            if isinstance(v, tuple) and a.ndim >= 2:
+                a = _pad_dim1(a, bucket_length(a.shape[1]))
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ValueError(
+                    f"serving feed has inconsistent batch dims: {name!r} "
+                    f"carries {a.shape[0]} rows, expected {rows}")
+            out_parts.append(a)
+            sig.append((name, a.shape[1:], str(a.dtype)))
+        canon[name] = tuple(out_parts) if isinstance(v, tuple) else out_parts[0]
+    if rows is None:
+        raise ValueError("serving feed is empty")
+    return canon, rows, tuple(sig)
+
+
+def batch_bucket(rows: int, max_batch: int) -> int:
+    """Smallest power-of-two >= rows, capped at max_batch — the batch-dim
+    analog of ``bucket_length``: a bounded set of compiled batch shapes."""
+    b = 1
+    while b < rows and b < max_batch:
+        b *= 2
+    return min(b, max_batch)
+
+
+def _pad_rows(arr: np.ndarray, to: int) -> np.ndarray:
+    if arr.shape[0] >= to:
+        return arr
+    # replicate the last row: real (already-valid) data, so padding can
+    # never introduce a zero-length sequence or out-of-vocab id
+    reps = np.repeat(arr[-1:], to - arr.shape[0], axis=0)
+    return np.concatenate([arr, reps], axis=0)
+
+
+def merge_feeds(reqs: List[Request], max_batch: int
+                ) -> Tuple[Dict[str, Any], List[Tuple[int, int]]]:
+    """Concatenate same-signature request feeds along the batch dim and
+    pad to the power-of-two batch bucket.  Returns the merged feed plus
+    per-request ``(start, stop)`` row slices for splitting outputs."""
+    slices: List[Tuple[int, int]] = []
+    row = 0
+    for r in reqs:
+        slices.append((row, row + r.rows))
+        row += r.rows
+    bucket = batch_bucket(row, max_batch)
+    merged: Dict[str, Any] = {}
+    template = reqs[0].feed
+    for name, v in template.items():
+        if isinstance(v, tuple):
+            parts = []
+            for i in range(len(v)):
+                cat = np.concatenate([r.feed[name][i] for r in reqs], axis=0)
+                parts.append(_pad_rows(cat, bucket))
+            merged[name] = tuple(parts)
+        else:
+            cat = np.concatenate([r.feed[name] for r in reqs], axis=0)
+            merged[name] = _pad_rows(cat, bucket)
+    return merged, slices
+
+
+def split_outputs(outputs: Dict[str, np.ndarray],
+                  slices: List[Tuple[int, int]]) -> List[Dict[str, np.ndarray]]:
+    res = []
+    for a, b in slices:
+        per: Dict[str, np.ndarray] = {}
+        for k, v in outputs.items():
+            arr = np.asarray(v)
+            # rank-0 outputs (a cost/metric head) have no batch dim to
+            # slice: every request in the batch receives the scalar
+            per[k] = arr if arr.ndim == 0 else arr[a:b]
+        res.append(per)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# the bounded queue
+# ---------------------------------------------------------------------------
+
+
+class BatchQueue:
+    """FIFO of :class:`Request` with a hard depth bound and shape-aware
+    batch extraction.  The head request defines the batch's signature;
+    the pop waits up to ``batch_delay_s`` for more same-signature rows
+    (or until the batch bucket is full), then sweeps expired requests
+    out.  Single-producer-safe and multi-producer-safe; one consumer
+    (the supervised worker) at a time."""
+
+    def __init__(self, max_queue: int) -> None:
+        self.max_queue = int(max_queue)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def offer(self, req: Request) -> None:
+        with self._cv:
+            if self._closed:
+                raise ShedError("queue is closed")
+            if len(self._q) >= self.max_queue:
+                raise ShedError(
+                    f"queue full ({self.max_queue} requests) — shedding")
+            self._q.append(req)
+            self._cv.notify_all()
+
+    def pop_batch(self, *, max_rows: int, batch_delay_s: float,
+                  timeout: float, est_service_s: float = 0.0,
+                  clock=time.monotonic
+                  ) -> Tuple[List[Request], List[Request]]:
+        """Extract one batch.  Returns ``(batch, expired)``: ``batch`` is
+        same-signature requests totalling <= ``max_rows`` rows, oldest
+        first; ``expired`` are same-signature requests whose deadline
+        cannot survive ``est_service_s`` more seconds — the caller must
+        complete those with ``DeadlineExceeded`` (never silently drop).
+        Both empty on timeout or close."""
+        hard_deadline = clock() + timeout
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    return [], []
+                rem = hard_deadline - clock()
+                if rem <= 0:
+                    return [], []
+                self._cv.wait(min(rem, 0.05))
+            sig = self._q[0].signature
+            # coalescing window: wait for more same-signature rows
+            window_end = clock() + batch_delay_s
+            while not self._closed:
+                rows = sum(r.rows for r in self._q if r.signature == sig)
+                if rows >= max_rows:
+                    break
+                rem = window_end - clock()
+                if rem <= 0:
+                    break
+                self._cv.wait(min(rem, 0.05))
+            batch: List[Request] = []
+            keep: List[Request] = []
+            expired: List[Request] = []
+            now = clock()
+            rows = 0
+            for r in self._q:
+                if r.signature != sig:
+                    # other-signature requests are swept too once plainly
+                    # dead — already-expired work must not occupy the
+                    # bounded queue and shed live traffic
+                    if r.deadline is not None and now > r.deadline:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                elif r.deadline is not None and now + est_service_s > r.deadline:
+                    expired.append(r)
+                elif rows + r.rows <= max_rows:
+                    batch.append(r)
+                    rows += r.rows
+                else:
+                    keep.append(r)
+            self._q = deque(keep)
+            self._cv.notify_all()
+            return batch, expired
+
+    def close(self) -> List[Request]:
+        """Close the queue and return every still-queued request so the
+        caller can fail them with a typed error."""
+        with self._cv:
+            self._closed = True
+            drained = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+        return drained

@@ -1,0 +1,95 @@
+"""``python -m paddle_tpu serve`` — the serving-runtime CLI.
+
+Usage:
+
+    python -m paddle_tpu serve --serve_bundle=model.ptz [--serve_* ...]
+    python -m paddle_tpu serve --serve_bundle=model.ptz --serve_smoke=16
+
+Loads a deploy bundle, builds an :class:`InferenceServer` from the
+``--serve_*`` flags, runs the warmup/readiness gate (plus the
+``--serve_preflight`` lint audit), then either serves until
+SIGTERM/SIGINT (printing a ``healthz()`` line periodically) or — with
+``--serve_smoke=N`` — pushes N synthetic requests through the full
+queue/batcher/worker path and exits 0 only if every one got a reply
+(the CI self-test mode used by tests/test_cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from typing import List, Optional
+
+__all__ = ["run"]
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    from paddle_tpu.config.deploy import load_inference_model
+    from paddle_tpu.serving.feeds import example_feed
+    from paddle_tpu.serving.server import InferenceServer
+    from paddle_tpu.utils import FLAGS, logger
+    from paddle_tpu.utils.devices import init
+    from paddle_tpu.utils.error import ConfigError
+
+    rest = init(list(argv or []))
+    if rest:
+        raise ConfigError(f"serve: unrecognized arguments: {rest}")
+    if not FLAGS.serve_bundle:
+        raise ConfigError("serve: --serve_bundle=<model.ptz> is required")
+
+    model = load_inference_model(FLAGS.serve_bundle)  # BundleCorruptError is typed
+    server = InferenceServer(
+        model,
+        max_batch=FLAGS.serve_max_batch,
+        batch_delay_ms=FLAGS.serve_batch_delay_ms,
+        max_queue=FLAGS.serve_queue_depth,
+        default_deadline_ms=FLAGS.serve_deadline_ms,
+        breaker_threshold=FLAGS.serve_breaker_threshold,
+        breaker_cooldown_s=FLAGS.serve_breaker_cooldown_s,
+        max_restarts=FLAGS.serve_max_restarts,
+        restart_backoff_s=FLAGS.serve_backoff_s,
+        hang_timeout_s=FLAGS.serve_hang_timeout_s,
+        nonfinite=FLAGS.serve_nonfinite,
+    )
+    logger.info("serve: warming up %r (batch buckets up to %d)",
+                FLAGS.serve_bundle, FLAGS.serve_max_batch)
+    server.start(preflight=FLAGS.serve_preflight)
+    print(json.dumps({"ready": server.ready, **server.healthz()},
+                     default=str))
+
+    try:
+        if FLAGS.serve_smoke > 0:
+            feed = example_feed(model.topology)
+            failures = 0
+            for i in range(FLAGS.serve_smoke):
+                try:
+                    server.infer(feed, deadline_ms=FLAGS.serve_deadline_ms)
+                except Exception as e:  # noqa: BLE001 — typed reply counts
+                    failures += 1
+                    logger.warning("serve smoke request %d failed: %s", i, e)
+            print(json.dumps(server.healthz(), default=str))
+            return 1 if failures else 0
+
+        # serve until SIGTERM/SIGINT (the preemption contract the training
+        # tier already follows: a signal ends the loop cleanly)
+        stop = threading.Event()
+
+        def _stop(signum, frame):
+            stop.set()
+
+        prev = {s: signal.signal(s, _stop)
+                for s in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            while not stop.is_set():
+                stop.wait(10.0)
+                print(json.dumps(server.healthz(), default=str), flush=True)
+                if server._state != server.RUNNING:
+                    logger.error("serve: server left RUNNING state; exiting")
+                    return 1
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+        return 0
+    finally:
+        server.close()

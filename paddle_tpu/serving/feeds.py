@@ -1,0 +1,12 @@
+"""Synthetic-feed helpers, re-exported for the serving tier.
+
+The implementation lives in :mod:`paddle_tpu.nn.feeds` — it is a pure
+Topology utility also consumed by the tiers *below* serving
+(``config.deploy`` empty-input replies, ``v2.infer``), so it must not
+live inside the serving package those tiers would then depend upward on.
+"""
+
+from paddle_tpu.nn.feeds import (empty_outputs, example_feed,
+                                 zero_batch_like)
+
+__all__ = ["example_feed", "zero_batch_like", "empty_outputs"]

@@ -1,0 +1,95 @@
+"""Observable health surface of the serving runtime.
+
+One lock-protected ``ServerMetrics`` instance per server: monotonic
+counters for every admission/ completion/ failure path, a rolling latency
+window with p50/p99, and the ``snapshot()`` dict that backs
+``InferenceServer.healthz()``.  Counters are named after the typed error
+that produced them so the health surface and the exception surface can
+never tell different stories.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["ServerMetrics"]
+
+#: counter names pre-seeded so a snapshot always carries the full schema
+#: (a dashboard should see shed=0, not a missing key, before the first shed)
+_COUNTERS = (
+    "submitted",        # every submit() call, accepted or not
+    "accepted",         # admitted to the queue
+    "completed",        # replied with outputs, inside the deadline
+    "shed",             # ShedError at admission (queue overflow / warming)
+    "invalid_request",      # InvalidRequestError (malformed / oversized)
+    "deadline_infeasible",  # DeadlineExceeded at admission
+    "deadline_expired",     # DeadlineExceeded after acceptance
+    "breaker_rejected",     # CircuitOpenError (admission or execution)
+    "breaker_trips",        # CLOSED -> OPEN transitions
+    "inference_failed",     # model raised / non-finite outputs
+    "worker_crashed",       # requests failed by a worker death/hang
+    "server_closed",        # requests drained by shutdown (queued/in-flight)
+    "worker_restarts",      # supervisor relaunches
+    "degraded",             # requests executed at a degraded tier (>0)
+    "batches",              # model invocations
+)
+
+
+class ServerMetrics:
+    def __init__(self, window: int = 512) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self._latencies = deque(maxlen=window)  # seconds, completed only
+        self._batch_rows = deque(maxlen=window)
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def observe_batch(self, rows: int) -> None:
+        with self._lock:
+            self._counters["batches"] += 1
+            self._batch_rows.append(rows)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    @staticmethod
+    def _pct_ms(lat_sorted, p: float) -> Optional[float]:
+        """Nearest-rank percentile of a sorted seconds list, in ms — THE
+        percentile definition; healthz and percentile_ms must agree."""
+        if not lat_sorted:
+            return None
+        n = len(lat_sorted)
+        idx = min(n - 1, max(0, int(round(p / 100.0 * n)) - 1))
+        return lat_sorted[idx] * 1e3
+
+    def percentile_ms(self, p: float) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+        return self._pct_ms(lat, p)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            lat = sorted(self._latencies)
+            rows = list(self._batch_rows)
+
+        def pct(p):
+            ms = self._pct_ms(lat, p)
+            return None if ms is None else round(ms, 3)
+
+        return {
+            "counters": counters,
+            "p50_ms": pct(50),
+            "p99_ms": pct(99),
+            "mean_batch_rows": (round(sum(rows) / len(rows), 2)
+                                if rows else None),
+        }

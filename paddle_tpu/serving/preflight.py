@@ -1,0 +1,62 @@
+"""Serving preflight — the lint gate at server startup.
+
+Same contract as ``v2.infer(audit=True)`` (docs/lint.md): the jitted
+serving closure is traced through the jaxpr auditor's host-transfer and
+constant-bloat checks before the server reports ready, and ERROR-severity
+findings fail startup.  A per-request host round-trip, or a parameter
+tensor silently folded into the executable as a constant, must never ship
+behind a health check that says "ready".
+
+Exposed both in-process (``InferenceServer.start(preflight=True)``) and
+offline (``python -m paddle_tpu lint --serve BUNDLE.ptz``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from paddle_tpu.serving.errors import ServingError
+
+__all__ = ["SERVING_CHECKS", "audit_serving", "check_serving"]
+
+#: params/state ride the call as ARGUMENTS in the serving closure, so a
+#: constant-bloat finding here is a real leak (unlike AOT export, where
+#: embedding the weights is the point)
+SERVING_CHECKS = ["host-transfer", "constant-bloat"]
+
+
+def audit_serving(model, *, example_feed: Optional[Dict[str, Any]] = None,
+                  outputs: Optional[Sequence[str]] = None,
+                  label: str = "serving") -> List:
+    """Trace the model's serving closure and return lint findings.
+
+    ``model`` is an ``InferenceModel`` (its topology provides a synthetic
+    example feed when none is given — serving.feeds).
+    """
+    from paddle_tpu.analysis import audit_fn
+
+    if example_feed is None:
+        from paddle_tpu.nn.feeds import example_feed as synth
+
+        example_feed = synth(model.topology)
+    names = tuple(outputs) if outputs else tuple(model.output_names)
+    # audit the EXACT closure the model serves (InferenceModel._make_run)
+    # — a re-implementation here could drift from the hot path and lint
+    # a closure that is no longer the one behind the server
+    run = model._make_run(names)
+    return audit_fn(run, model.params, model.state, example_feed,
+                    label=label, checks=SERVING_CHECKS)
+
+
+def check_serving(model, *, example_feed: Optional[Dict[str, Any]] = None,
+                  outputs: Optional[Sequence[str]] = None) -> None:
+    """Fail-fast form: raise :class:`ServingError` on ERROR findings."""
+    if not hasattr(model, "topology"):
+        return  # plain callables have no traceable closure to audit
+    from paddle_tpu.analysis import errors_summary
+
+    bad = errors_summary(audit_serving(model, example_feed=example_feed,
+                                       outputs=outputs))
+    if bad:
+        raise ServingError(
+            f"serving closure failed the preflight audit: {bad}")

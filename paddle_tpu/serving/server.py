@@ -1,0 +1,547 @@
+"""`InferenceServer` — the overload-safe runtime in front of a compiled
+forward (docs/serving.md).
+
+The pipeline per request:
+
+    submit() ── admission control ──> BatchQueue ──> supervised worker
+      │   (closed? breaker open?          │    (coalesce to shape bucket,
+      │    deadline feasible?             │     sweep expired, execute
+      │    queue bounded?)                │     behind the breaker)
+      └── typed rejection, immediately    └── reply or typed error
+
+Guarantees (proven under chaos in tests/test_serving.py):
+
+- **reply-or-typed-error** — every accepted request's future resolves to
+  outputs or to one of ``serving.errors``; rejections raise immediately
+  from ``submit``;
+- **no fresh compiles on the hot path** — requests execute at the shape
+  buckets primed by the warmup gate (sequence dims bucketed, batch dim a
+  power of two, rows padded by replication);
+- **deadline honesty** — a reply delivered after its deadline is
+  converted to ``DeadlineExceeded``, so the success-latency p99 is
+  bounded by the configured deadline *by construction*;
+- **graceful degradation** — under queue pressure, generation-style
+  models step down the configured tier ladder (e.g. beam -> greedy,
+  shorter max_len) before anything is shed.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving.batching import (BatchQueue, Request, ServingFuture,
+                                         canonicalize_feed, merge_feeds,
+                                         split_outputs)
+from paddle_tpu.serving.breaker import CircuitBreaker
+from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
+                                       InferenceFailed, InvalidRequestError,
+                                       ServerClosed, ShedError, WorkerCrashed)
+from paddle_tpu.serving.metrics import ServerMetrics
+from paddle_tpu.serving.worker import WorkerSupervisor
+from paddle_tpu.utils.log import logger
+
+__all__ = ["InferenceServer"]
+
+
+class _WorkerKilled(Exception):
+    """Chaos-injected worker death (resilience.chaos.kill_worker)."""
+
+
+def _has_nonfinite(outputs: Dict[str, Any]) -> bool:
+    for v in outputs.values():
+        a = np.asarray(v)
+        if a.dtype.kind == "f" and a.size and not np.all(np.isfinite(a)):
+            return True
+    return False
+
+
+class InferenceServer:
+    """Serve a compiled forward with batching, shedding, deadlines, and a
+    supervised worker.
+
+    ``model`` is an :class:`~paddle_tpu.config.deploy.InferenceModel`, or
+    any callable ``fn(feed) -> {name: array}``; a callable taking a
+    second argument receives the active degradation-tier options dict
+    (``fn(feed, tier_opts)``) — that is how generation backends accept
+    ``{"greedy": True, "max_len": 32}`` style step-downs.
+    """
+
+    RUNNING, FAILED, CLOSED = "running", "failed", "closed"
+
+    def __init__(
+        self,
+        model,
+        *,
+        outputs: Optional[Sequence[str]] = None,
+        max_batch: int = 8,
+        batch_delay_ms: float = 2.0,
+        max_queue: int = 64,
+        default_deadline_ms: float = 1000.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 5.0,
+        breaker_probes: int = 1,
+        max_restarts: int = 3,
+        restart_backoff_s: float = 0.05,
+        max_restart_backoff_s: float = 2.0,
+        hang_timeout_s: float = 0.0,
+        degrade: Optional[List[dict]] = None,
+        degrade_at: Optional[List[int]] = None,
+        nonfinite: str = "error",
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ) -> None:
+        if nonfinite not in ("error", "allow"):
+            raise ValueError("nonfinite must be 'error' or 'allow'")
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.batch_delay_s = float(batch_delay_ms) / 1e3
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.nonfinite = nonfinite
+        self._clock = clock
+        self._outputs = list(outputs) if outputs else None
+        self.metrics = ServerMetrics()
+        self.queue = BatchQueue(max_queue)
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
+            probes_to_close=breaker_probes, clock=clock)
+        self._runner = self._make_runner(model)
+        # degradation ladder: tier 0 = full service; thresholds default to
+        # evenly-spaced queue-depth watermarks
+        self.degrade = list(degrade or [])
+        if degrade_at is not None:
+            if len(degrade_at) != len(self.degrade):
+                raise ValueError("degrade_at must match degrade in length")
+            self.degrade_at = [int(d) for d in degrade_at]
+        else:
+            n = len(self.degrade)
+            self.degrade_at = [max(1, (max_queue * (i + 1)) // (n + 1))
+                               for i in range(n)]
+        self._service_ema: Optional[float] = None  # seconds per batch
+        self._state = self.RUNNING
+        self._ready = False
+        self._fail_reason: Optional[str] = None
+        self._in_flight: List[Request] = []
+        self._kill_worker = False
+        self.supervisor = WorkerSupervisor(
+            self._serve_once,
+            max_restarts=max_restarts,
+            backoff_s=restart_backoff_s,
+            max_backoff_s=max_restart_backoff_s,
+            hang_timeout_s=hang_timeout_s,
+            on_crash=self._on_worker_crash,
+            on_give_up=self._on_worker_give_up,
+            clock=clock,
+            sleep=sleep,
+        )
+
+    # ------------------------------------------------------------------
+    # model adapters
+    # ------------------------------------------------------------------
+
+    def _make_runner(self, model):
+        """Normalize the backend to ``runner(feed, tier_opts)``."""
+        infer = getattr(model, "infer", None)
+        if infer is not None and hasattr(model, "topology"):
+            def run(feed, tier_opts):
+                outs = self._outputs
+                if tier_opts.get("outputs"):
+                    outs = list(tier_opts["outputs"])
+                return infer(feed, outputs=outs)
+
+            return run
+        if not callable(model):
+            raise TypeError(
+                "model must be an InferenceModel or a callable "
+                "fn(feed[, tier_opts]) -> {name: array}")
+        try:
+            takes_tier = len(inspect.signature(model).parameters) >= 2
+        except (TypeError, ValueError):
+            takes_tier = False
+        if takes_tier:
+            return lambda feed, tier_opts: model(feed, tier_opts)
+        return lambda feed, tier_opts: model(feed)
+
+    # ------------------------------------------------------------------
+    # lifecycle: warmup/readiness gate -> running -> closed/failed
+    # ------------------------------------------------------------------
+
+    def start(self, *, warmup_feed=None, warmup: bool = True,
+              preflight: bool = False) -> "InferenceServer":
+        """Prime the compile caches, optionally run the lint preflight,
+        then start the supervised worker.
+
+        ``warmup_feed`` is one feed dict or a LIST of feed dicts.  Every
+        batch bucket of every given feed's canonical shape is compiled
+        before the server reports ready — a cold jit on the first user
+        request would blow any reasonable deadline.  Coverage follows
+        the feeds: a sequence model serves un-warmed sequence buckets
+        with one cold compile on first use, so pass a representative
+        feed per expected length bucket (e.g. T=16/64/256)."""
+        feeds = (warmup_feed if isinstance(warmup_feed, (list, tuple))
+                 else [warmup_feed] if warmup_feed is not None else [])
+        if preflight:
+            from paddle_tpu.serving.preflight import check_serving
+
+            check_serving(self.model,
+                          example_feed=feeds[0] if feeds else None,
+                          outputs=self._outputs)
+        if warmup:
+            self._warmup(feeds)
+        self.supervisor.start()
+        self._ready = True
+        return self
+
+    def _warmup(self, feeds: List[Dict[str, Any]]) -> None:
+        if not feeds and hasattr(self.model, "topology"):
+            from paddle_tpu.serving.feeds import example_feed
+
+            feeds = [example_feed(self.model.topology)]
+        if not feeds:
+            return  # plain callable without an example: nothing to prime
+        from paddle_tpu.serving.batching import _pad_rows, batch_bucket
+
+        # derived from batch_bucket itself so warmup can never drift from
+        # the hot path's bucket ladder: exactly the shapes merge_feeds
+        # can produce for any row count
+        buckets = sorted({batch_bucket(r, self.max_batch)
+                          for r in range(1, self.max_batch + 1)})
+        t0 = self._clock()
+        compiled = 0
+        for feed in feeds:
+            canon, _, _ = canonicalize_feed(feed)
+            # prime from a ONE-row slice: a multi-row warmup feed must
+            # not leave the small buckets cold
+            canon = {
+                name: (tuple(p[:1] for p in v) if isinstance(v, tuple)
+                       else v[:1])
+                for name, v in canon.items()
+            }
+            for bucket in buckets:
+                padded = {
+                    name: (tuple(_pad_rows(p, bucket) for p in v)
+                           if isinstance(v, tuple) else _pad_rows(v, bucket))
+                    for name, v in canon.items()
+                }
+                self._runner(padded, {})
+                compiled += 1
+        logger.info("serving warmup: %d bucket shape(s) over %d feed(s) "
+                    "compiled in %.2fs", compiled, len(feeds),
+                    self._clock() - t0)
+
+    @property
+    def ready(self) -> bool:
+        return self._ready and self._state == self.RUNNING
+
+    def close(self, join_timeout: float = 2.0) -> None:
+        if self._state == self.CLOSED:
+            return
+        self._state = self.CLOSED
+        self._fail_requests(
+            self.queue.close(),
+            lambda: ServerClosed("server shut down"), "server_closed")
+        self.supervisor.stop(join_timeout)
+        # the worker generation is retired: a batch still executing will
+        # discard its results instead of completing futures, so fail the
+        # in-flight requests too (set-once: a no-op for any the worker
+        # finished before the stop) — shutdown must not silently drop
+        in_flight, self._in_flight = self._in_flight, []
+        self._fail_requests(
+            in_flight,
+            lambda: ServerClosed("server shut down with the batch in flight"),
+            "server_closed")
+
+    # ------------------------------------------------------------------
+    # admission control
+    # ------------------------------------------------------------------
+
+    def submit(self, feed: Dict[str, Any],
+               deadline_ms: Optional[float] = None) -> ServingFuture:
+        """Admit one request (a dict feed with a leading batch dim on
+        every part) or raise a typed rejection immediately.  Returns a
+        :class:`ServingFuture` that is *guaranteed* to resolve."""
+        self.metrics.inc("submitted")
+        if self._state != self.RUNNING:
+            self.metrics.inc("server_closed")
+            raise ServerClosed(self._fail_reason or "server is closed")
+        if not self._ready:
+            self.metrics.inc("shed")
+            raise ShedError("server is still warming up (not ready)")
+        try:
+            canon, rows, sig = canonicalize_feed(feed)
+        except ValueError as e:
+            # malformed feeds reject typed like every other admission
+            # failure — a client's `except ServingError` accounting must
+            # see them (InvalidRequestError is also a ValueError)
+            self.metrics.inc("invalid_request")
+            raise InvalidRequestError(str(e)) from e
+        if rows > self.max_batch:
+            # an oversized request could never be selected by the batcher:
+            # admitting it would park it in the queue forever — reject it
+            # immediately instead (the client should split it)
+            self.metrics.inc("invalid_request")
+            raise InvalidRequestError(
+                f"request carries {rows} rows but the server batches at "
+                f"most {self.max_batch} — split the request")
+        if rows == 0:
+            # a zero-row request must never reach the device: merged it
+            # would break the warmed-bucket invariant (a B=0 compile on
+            # the hot path), and its crash would count toward the breaker.
+            # An InferenceModel replies empty WITHOUT executing (its
+            # shape-inferred empty path); raw callables reject typed.
+            if not hasattr(self.model, "topology"):
+                self.metrics.inc("invalid_request")
+                raise InvalidRequestError(
+                    "zero-row request on a backend without shape "
+                    "inference — nothing to execute")
+            fut = ServingFuture()
+            try:
+                fut._complete(result=self._runner(canon, {}))
+            except ValueError as e:
+                # a request bug (missing slot, bad structure) rejects the
+                # same way the populated admission path does — it is not
+                # a model failure and must not read as one on dashboards
+                self.metrics.inc("invalid_request")
+                raise InvalidRequestError(
+                    f"malformed empty request: {e}") from e
+            except Exception as e:  # noqa: BLE001 — typed, not breaker-fed
+                fut._complete(error=InferenceFailed(
+                    f"empty-request shape inference failed: "
+                    f"{type(e).__name__}: {e}"))
+                self.metrics.inc("inference_failed")
+                return fut
+            self.metrics.inc("accepted")
+            self.metrics.inc("completed")
+            return fut
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        now = self._clock()
+        deadline = now + deadline_ms / 1e3 if deadline_ms > 0 else None
+        if not self.breaker.allow():
+            self.metrics.inc("breaker_rejected")
+            raise CircuitOpenError(
+                "circuit breaker is open — backend failing; retry after "
+                f"{self.breaker.cooldown_s:.1f}s")
+        if deadline is not None and self._service_ema is not None:
+            # feasibility estimate: one service time, plus the queue's
+            # backlog in units of batches ahead of us
+            depth = self.queue.depth()
+            est = self._service_ema * (1.0 + depth / max(1, self.max_batch))
+            if now + est > deadline:
+                self.metrics.inc("deadline_infeasible")
+                raise DeadlineExceeded(
+                    f"infeasible deadline: {deadline_ms:.1f}ms budget vs "
+                    f"~{est * 1e3:.1f}ms estimated queue+service time")
+        req = Request(feed=canon, rows=rows, signature=sig,
+                      future=ServingFuture(), deadline=deadline,
+                      t_submit=now, deadline_ms=deadline_ms)
+        try:
+            self.queue.offer(req)
+        except ShedError:
+            self.metrics.inc("shed")
+            raise
+        self.metrics.inc("accepted")
+        return req.future
+
+    def infer(self, feed: Dict[str, Any],
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Synchronous submit + wait."""
+        fut = self.submit(feed, deadline_ms)
+        if timeout is None and deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if timeout is None:
+            timeout = (deadline_ms / 1e3 + 30.0) if deadline_ms > 0 else None
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------------
+    # the worker side
+    # ------------------------------------------------------------------
+
+    def _pick_tier(self, depth: int) -> int:
+        tier = 0
+        for i, watermark in enumerate(self.degrade_at):
+            if depth >= watermark:
+                tier = i + 1
+        return tier
+
+    def _fail_requests(self, reqs: List[Request], exc_factory,
+                       counter: str) -> None:
+        n = 0
+        for r in reqs:
+            if r.future._complete(error=exc_factory()):
+                n += 1
+        if n:
+            self.metrics.inc(counter, n)
+
+    def _serve_once(self, gen: int) -> None:
+        batch, expired = self.queue.pop_batch(
+            max_rows=self.max_batch,
+            batch_delay_s=self.batch_delay_s,
+            timeout=0.05,
+            est_service_s=self._service_ema or 0.0,
+            clock=self._clock)
+        self._fail_requests(
+            expired,
+            lambda: DeadlineExceeded("deadline expired while queued"),
+            "deadline_expired")
+        if not batch:
+            return
+        if not self.breaker.allow():
+            self._fail_requests(
+                batch, lambda: CircuitOpenError("circuit breaker is open"),
+                "breaker_rejected")
+            return
+        tier = self._pick_tier(self.queue.depth())
+        tier_opts = self.degrade[tier - 1] if tier else {}
+        if tier:
+            for r in batch:
+                r.tier = tier
+            self.metrics.inc("degraded", len(batch))
+        rows = sum(r.rows for r in batch)
+        # the batch is in flight from the moment it leaves the queue: a
+        # failure ANYWHERE past this point (merge included) must reach
+        # the crash handler with these futures still attributed
+        self._in_flight = batch
+        try:
+            merged, slices = merge_feeds(batch, self.max_batch)
+        except Exception as e:  # noqa: BLE001 — structural mismatch
+            self._fail_requests(
+                batch,
+                lambda: InvalidRequestError(
+                    f"requests could not be merged into one batch: "
+                    f"{type(e).__name__}: {e}"),
+                "invalid_request")
+            self._in_flight = []
+            return
+        self.supervisor.note_busy(gen)
+        try:
+            self._execute(gen, batch, merged, slices, rows, tier_opts)
+        except BaseException:
+            # crash/kill path: leave _in_flight populated — the monitor's
+            # crash handler fails those futures with WorkerCrashed; clearing
+            # here would turn a worker death into a silent drop
+            self.supervisor.note_idle(gen)
+            raise
+        if self.supervisor.current(gen):
+            self._in_flight = []
+        self.supervisor.note_idle(gen)
+
+    def _record_failure(self, gen: int) -> None:
+        # breaker state belongs to the LIVE worker: an abandoned (hung,
+        # replaced) worker that finally un-wedges must not pin failures
+        # or successes on the healthy backend serving current traffic
+        if not self.supervisor.current(gen):
+            return
+        trips_before = self.breaker.trips
+        self.breaker.record_failure()
+        if self.breaker.trips > trips_before:
+            self.metrics.inc("breaker_trips")
+
+    def _execute(self, gen: int, batch: List[Request], merged, slices,
+                 rows: int, tier_opts: dict) -> None:
+        if self._kill_worker:
+            self._kill_worker = False
+            raise _WorkerKilled("chaos: worker killed mid-batch")
+        t0 = self._clock()
+        try:
+            outputs = self._runner(merged, tier_opts)
+        except _WorkerKilled:
+            raise
+        except Exception as e:  # noqa: BLE001 — a model fault, not a crash
+            self._record_failure(gen)
+
+            def _mk(e=e):
+                err = InferenceFailed(
+                    f"model call failed: {type(e).__name__}: {e}")
+                err.__cause__ = e
+                return err
+
+            self._fail_requests(batch, _mk, "inference_failed")
+            return
+        dt = self._clock() - t0
+        if self.supervisor.current(gen):
+            self._service_ema = (dt if self._service_ema is None
+                                 else 0.8 * self._service_ema + 0.2 * dt)
+            self.metrics.observe_batch(rows)
+        if self.nonfinite == "error" and _has_nonfinite(outputs):
+            self._record_failure(gen)
+            self._fail_requests(
+                batch,
+                lambda: InferenceFailed(
+                    "model produced non-finite outputs (poisoned batch?)"),
+                "inference_failed")
+            return
+        if self.supervisor.current(gen):
+            self.breaker.record_success()
+        per_req = split_outputs(outputs, slices)
+        now = self._clock()
+        for r, out in zip(batch, per_req):
+            if not self.supervisor.current(gen):
+                return  # abandoned worker: its results are unwanted
+            if r.deadline is not None and now > r.deadline:
+                if r.future._complete(error=DeadlineExceeded(
+                        f"completed {1e3 * (now - r.deadline):.1f}ms past "
+                        f"the {r.deadline_ms:.1f}ms deadline")):
+                    self.metrics.inc("deadline_expired")
+            elif r.future._complete(result=out):
+                self.metrics.inc("completed")
+                self.metrics.observe_latency(now - r.t_submit)
+
+    # ------------------------------------------------------------------
+    # supervision callbacks + chaos hooks
+    # ------------------------------------------------------------------
+
+    def _on_worker_crash(self, exc: Exception) -> None:
+        in_flight, self._in_flight = self._in_flight, []
+        self._fail_requests(
+            in_flight,
+            lambda: WorkerCrashed(f"worker died mid-batch: {exc}"),
+            "worker_crashed")
+
+    def _on_worker_give_up(self, exc: Exception) -> None:
+        self._state = self.FAILED
+        self._fail_reason = (f"worker restart budget exhausted "
+                             f"({self.supervisor.max_restarts}): {exc}")
+        for r in self.queue.close():
+            r.future._complete(error=WorkerCrashed(self._fail_reason))
+            self.metrics.inc("worker_crashed")
+
+    def chaos_kill_worker(self) -> None:
+        """Chaos hook (``resilience.chaos.kill_worker``): the worker dies
+        with the next popped batch in flight — the mid-batch crash model
+        the supervisor must recover from."""
+        self._kill_worker = True
+
+    # ------------------------------------------------------------------
+    # health surface
+    # ------------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        snap = self.metrics.snapshot()
+        # the supervisor owns the relaunch count (it alone knows whether a
+        # crash led to a restart or exhausted the budget) — mirror it so
+        # the counter can never disagree with worker.restarts
+        snap["counters"]["worker_restarts"] = self.supervisor.restarts
+        return {
+            "ready": self.ready,
+            "state": self._state,
+            "queue_depth": self.queue.depth(),
+            "breaker": self.breaker.snapshot(),
+            "worker": {"alive": self.supervisor.alive(),
+                       "restarts": self.supervisor.restarts,
+                       "max_restarts": self.supervisor.max_restarts},
+            "service_ema_ms": (round(self._service_ema * 1e3, 3)
+                               if self._service_ema is not None else None),
+            **snap,
+        }
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -213,6 +213,40 @@ define_flag("gang_watchdog_s", 60.0, "gang supervisor: a rank whose "
             "gang is restarted (JAX collectives deadlock, not error, when "
             "a peer dies)")
 
+# Serving runtime (paddle_tpu/serving; docs/serving.md) — the
+# `python -m paddle_tpu serve` surface
+define_flag("serve_bundle", "", "model bundle (.ptz) to serve with "
+            "`python -m paddle_tpu serve`")
+define_flag("serve_max_batch", 8, "serving: max rows coalesced into one "
+            "compiled batch (batch buckets are powers of two up to this)")
+define_flag("serve_batch_delay_ms", 2.0, "serving: micro-batching window — "
+            "how long the worker waits to coalesce more same-shape requests")
+define_flag("serve_queue_depth", 64, "serving: bounded queue depth; a full "
+            "queue sheds new requests immediately (typed ShedError)")
+define_flag("serve_deadline_ms", 1000.0, "serving: default per-request "
+            "deadline; infeasible deadlines are rejected at admission "
+            "(0 = no deadline)")
+define_flag("serve_breaker_threshold", 5, "serving: consecutive batch "
+            "failures that trip the circuit breaker OPEN")
+define_flag("serve_breaker_cooldown_s", 5.0, "serving: seconds the breaker "
+            "stays OPEN before letting a half-open probe through")
+define_flag("serve_max_restarts", 3, "serving: worker restart budget before "
+            "the server reports failed and drains with typed errors")
+define_flag("serve_backoff_s", 0.5, "serving: base worker-restart backoff "
+            "(exponential, doubled per restart)")
+define_flag("serve_hang_timeout_s", 0.0, "serving: a batch in flight longer "
+            "than this marks the worker hung and replaces it (0 = off)")
+define_flag("serve_preflight", True, "serving: run the jaxpr auditor's "
+            "host-transfer/constant-bloat checks over the serving closure "
+            "at startup and fail fast on ERROR findings (lint --serve)")
+define_flag("serve_smoke", 0, "serving CLI: push N synthetic requests "
+            "through the server, print healthz, and exit (CI self-test; "
+            "0 = serve until SIGTERM)")
+define_flag("serve_nonfinite", "error", "serving: 'error' fails requests "
+            "whose outputs contain NaN/Inf (counts toward the breaker); "
+            "'allow' passes them through",
+            validator=lambda v: v in ("error", "allow"))
+
 # Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
 define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")
 define_flag("mesh_axes", "data", "comma-separated mesh axis names, e.g. 'data,model'")

@@ -23,7 +23,9 @@ def small_mnist(monkeypatch):
     # flags are process-global: restore around each test
     keep = {k: getattr(FLAGS, k) for k in
             ("job", "config", "num_passes", "save_dir", "start_pass",
-             "test_pass", "time_batches", "log_period")}
+             "test_pass", "time_batches", "log_period", "serve_bundle",
+             "serve_smoke", "serve_max_batch", "serve_deadline_ms",
+             "serve_preflight")}
     yield
     for k, v in keep.items():
         setattr(FLAGS, k, v)
@@ -67,6 +69,82 @@ def test_cli_help_lists_flags(capsys):
     assert ei.value.code == 0
     out = capsys.readouterr().out
     assert "lint" in out and "--gang_max_restarts" not in out
+
+
+def _serve_bundle(tmp_path):
+    """Train one batch of a tiny net and write a deploy bundle."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.config import merge_model
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.trainer import SGDTrainer
+
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    out = nn.fc(x, 3, act="softmax", name="out")
+    label = nn.data("label", size=1, dtype="int32")
+    cost = nn.classification_cost(out, label, name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+    tr.train_batch({"x": np.zeros((4, 4), np.float32),
+                    "label": np.zeros((4, 1), np.int32)})
+    path = str(tmp_path / "m.ptz")
+    merge_model(path, tr.topology, tr.params, tr.state, name="cli")
+    return path
+
+
+def test_cli_serve_smoke_roundtrip(tmp_path, capsys):
+    """`python -m paddle_tpu serve --serve_smoke=N`: load bundle, warm
+    up, run the preflight audit, push N requests through the full
+    queue/batcher/worker path, print healthz, exit 0."""
+    bundle = _serve_bundle(tmp_path)
+    rc = main(["serve", f"--serve_bundle={bundle}", "--serve_smoke=3",
+               "--serve_deadline_ms=60000"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    import json
+
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["ready"] is True  # readiness gate passed before serving
+    assert last["counters"]["completed"] == 3
+    assert last["counters"]["worker_crashed"] == 0
+    assert last["breaker"]["state"] == "closed"
+
+
+def test_cli_serve_requires_bundle_and_rejects_corrupt(tmp_path):
+    from paddle_tpu.config.deploy import BundleCorruptError
+
+    with pytest.raises(ConfigError, match="serve_bundle"):
+        main(["serve", "--serve_smoke=1"])
+    bad = tmp_path / "bad.ptz"
+    bad.write_bytes(b"this is not a zip archive")
+    with pytest.raises(BundleCorruptError):
+        main(["serve", f"--serve_bundle={bad}", "--serve_smoke=1"])
+
+
+def test_cli_lint_serve_preflight(tmp_path, capsys):
+    """`lint --serve BUNDLE` audits the serving closure (exit 0 on a
+    clean bundle, 1 on a corrupt one — corruption is an ERROR finding)."""
+    bundle = _serve_bundle(tmp_path)
+    assert main(["lint", "--serve", bundle]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "bad.ptz"
+    bad.write_bytes(b"garbage")
+    assert main(["lint", "--serve", str(bad)]) == 1
+    assert "serve-build" in capsys.readouterr().out
+
+
+def test_cli_help_lists_serve_flags(capsys):
+    """The serve subcommand's knobs ride the registered flag table —
+    including `serve --help` itself (the advertised invocation must print
+    the table, not die on an unrecognized argument)."""
+    assert main(["serve", "--help"]) == 0
+    assert "serve_bundle" in capsys.readouterr().out
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "python -m paddle_tpu serve" in out
+    for flag in ("--serve_bundle", "--serve_max_batch", "--serve_queue_depth",
+                 "--serve_deadline_ms", "--serve_breaker_threshold",
+                 "--serve_preflight", "--serve_smoke"):
+        assert flag in out, flag
 
 
 def test_cli_rejects_bad_args():

@@ -9,6 +9,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import zipfile
 
 import numpy as np
@@ -16,6 +17,7 @@ import pytest
 
 import paddle_tpu.nn as nn
 from paddle_tpu.config import export_aot, load_inference_model, merge_model
+from paddle_tpu.config.deploy import BundleCorruptError, export_aot_hlo
 from paddle_tpu.param.optimizers import Adam
 from paddle_tpu.trainer import SGDTrainer
 
@@ -79,3 +81,155 @@ def test_aot_roundtrip_without_framework(tmp_path, rng):
     assert r.returncode == 0, r.stderr[-2000:]
     got = np.load(out_npz)["logits"]
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bundle integrity (BundleCorruptError) + concurrent InferenceModel use
+# ---------------------------------------------------------------------------
+
+
+def _tiny_bundle(tmp_path, rng, name="robust"):
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    logits = nn.fc(x, 3, act="softmax", name="out")
+    label = nn.data("label", size=1, dtype="int32")
+    cost = nn.classification_cost(logits, label, name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+    tr.train_batch({"x": rng.randn(4, 4).astype(np.float32),
+                    "label": np.zeros((4, 1), np.int32)})
+    path = str(tmp_path / f"{name}.ptz")
+    merge_model(path, tr.topology, tr.params, tr.state, name=name)
+    return path
+
+
+def _rezip(src_path, dst_path, mutate):
+    """Copy a bundle zip member-by-member with ``mutate(name, data)``
+    deciding each member's new payload (None = drop the member)."""
+    with zipfile.ZipFile(src_path) as src, \
+            zipfile.ZipFile(dst_path, "w") as dst:
+        for info in src.infolist():
+            data = mutate(info.filename, src.read(info.filename))
+            if data is not None:
+                dst.writestr(info.filename, data)
+    return dst_path
+
+
+def test_bundle_chaos_corruption_is_typed(tmp_path, rng):
+    """Chaos-corruption: truncated archives, torn members, missing
+    members, and garbage payloads all surface as BundleCorruptError with
+    the failing member attributed — never a raw zipfile/KeyError."""
+    from paddle_tpu.resilience import chaos
+
+    bundle = _tiny_bundle(tmp_path, rng)
+    load_inference_model(bundle)  # sanity: pristine bundle loads
+
+    # whole-archive truncation (torn write of the artifact itself)
+    torn = str(tmp_path / "torn.ptz")
+    with open(bundle, "rb") as f:
+        data = f.read()
+    with open(torn, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(BundleCorruptError):
+        load_inference_model(torn)
+
+    # a bit-flipped / truncated member payload, attributed by name
+    for member in ("params.npz", "model.pb"):
+        bad = _rezip(bundle, str(tmp_path / f"bad-{member}.zip"),
+                     lambda n, d, m=member: d[: len(d) // 2] if n == m else d)
+        with pytest.raises(BundleCorruptError) as ei:
+            load_inference_model(bad)
+        assert ei.value.member == member, ei.value
+
+    # a missing member
+    gone = _rezip(bundle, str(tmp_path / "gone.ptz"),
+                  lambda n, d: None if n == "params.npz" else d)
+    with pytest.raises(BundleCorruptError) as ei:
+        load_inference_model(gone)
+    assert ei.value.member == "params.npz"
+
+    # manifest garbage parses as corruption, not JSONDecodeError
+    nojson = _rezip(bundle, str(tmp_path / "nojson.ptz"),
+                    lambda n, d: b"{not json" if n == "manifest.json" else d)
+    with pytest.raises(BundleCorruptError) as ei:
+        load_inference_model(nojson)
+    assert ei.value.member == "manifest.json"
+
+    # in-place bit-flip via the chaos harness on the archive file
+    flipped = str(tmp_path / "flipped.ptz")
+    with open(flipped, "wb") as f:
+        f.write(data)
+    chaos.corrupt_file(flipped)
+    with pytest.raises((BundleCorruptError, ValueError)):
+        load_inference_model(flipped)
+
+    # a valid zip that is NOT a bundle keeps the wrong-file-type error
+    notbundle = str(tmp_path / "not.ptz")
+    with zipfile.ZipFile(notbundle, "w") as z:
+        z.writestr("manifest.json", json.dumps({"magic": "something_else"}))
+    with pytest.raises(ValueError, match="not a paddle_tpu model bundle"):
+        load_inference_model(notbundle)
+
+
+def test_infer_empty_rows_and_missing_slot(tmp_path, rng):
+    m = load_inference_model(_tiny_bundle(tmp_path, rng))
+    out = m.infer({"x": np.zeros((0, 4), np.float32)}, outputs=["out"])
+    assert out["out"].shape == (0, 3) and out["out"].dtype == np.float32
+    with pytest.raises(ValueError, match="missing input slot"):
+        m.infer({}, outputs=["out"])
+    # unreachable training inputs (label) are NOT required for 'out'
+    m.infer({"x": np.zeros((2, 4), np.float32)}, outputs=["out"])
+    # a zero-row part next to populated parts is a client bug, not an
+    # empty request — rejecting beats silently discarding the real rows
+    with pytest.raises(ValueError, match="mixes zero-row"):
+        m.infer({"x": np.zeros((0, 4), np.float32),
+                 "label": np.zeros((2, 1), np.int32)})
+
+
+def test_concurrent_inference_model_mixed_shapes(tmp_path, rng):
+    """N threads hammering ONE InferenceModel with mixed shapes (plus
+    unroll-scan AOT exports contending for the _unrolled_scans lock)
+    must never interleave into a wrong result or deadlock — barrier
+    start so every thread hits the compile-cache races together."""
+    m = load_inference_model(_tiny_bundle(tmp_path, rng))
+    shapes = {1: rng.randn(1, 4).astype(np.float32),
+              2: rng.randn(2, 4).astype(np.float32),
+              5: rng.randn(5, 4).astype(np.float32)}
+    expected = {b: m.infer({"x": v}, outputs=["out"])["out"]
+                for b, v in shapes.items()}
+
+    n_infer, n_export, reps = 6, 2, 8
+    barrier = threading.Barrier(n_infer + n_export)
+    failures = []
+
+    def hammer(i):
+        b = sorted(shapes)[i % len(shapes)]
+        barrier.wait(timeout=60)
+        try:
+            for _ in range(reps):
+                got = m.infer({"x": shapes[b]}, outputs=["out"])["out"]
+                np.testing.assert_array_equal(got, expected[b])
+        except Exception as e:  # noqa: BLE001
+            failures.append((f"infer[{i}]", repr(e)))
+
+    def export(i):
+        barrier.wait(timeout=60)
+        try:
+            export_aot_hlo(m, str(tmp_path / f"hlo{i}"),
+                           {"x": shapes[1]}, outputs=["out"],
+                           unroll_scans=True)
+        except Exception as e:  # noqa: BLE001
+            failures.append((f"export[{i}]", repr(e)))
+
+    threads = ([threading.Thread(target=hammer, args=(i,))
+                for i in range(n_infer)]
+               + [threading.Thread(target=export, args=(i,))
+                  for i in range(n_export)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not failures, failures
+    assert all(not t.is_alive() for t in threads), "deadlocked thread"
+    # the lock released cleanly: a subsequent export still works
+    export_aot_hlo(m, str(tmp_path / "hlo-after"), {"x": shapes[2]},
+                   outputs=["out"], unroll_scans=True)

@@ -774,6 +774,159 @@ def bench_pallas_decode_ab(rtt, peak):
     }
 
 
+def bench_serving_continuous_ab(rtt, peak):
+    """A/B continuous slot-based batching (serving/slots.py) vs lock-step
+    bucket batching under a mixed-length synthetic trace: 90% short
+    requests (4-token decode budgets) with every 10th a full-``max_len``
+    straggler — the hostage pattern of real generation traffic.  Bucket
+    mode runs groups of ``S`` requests lock-step to the LONGEST budget in
+    the group (every short request in a straggler's batch pays the
+    straggler's 48 steps); continuous mode recycles each short request's
+    slot the moment it finishes.  Both paths drive the SAME fused engine
+    (``decode_step``/``beam_decode`` share one step implementation), so
+    the delta is pure scheduling.  Reports aggregate emitted tokens/s and
+    per-request latency p50/p99 (wall clock from the burst arrival —
+    host-side scheduling overhead included, honestly), plus the slot
+    table's mean occupancy.  Winner requires BOTH higher tokens/s and
+    lower p99; ``default_flag`` mirrors ``--serve_continuous``."""
+    import time as _t
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import Seq2SeqAttention
+    from paddle_tpu.serving.batching import (Request, ServingFuture,
+                                             canonicalize_feed)
+    from paddle_tpu.serving.slots import Seq2SeqSlotBackend, SlotScheduler
+    from paddle_tpu.utils.flags import FLAGS
+
+    S, K, SRC, L_SHORT, L_LONG, N = 8, 4, 16, 4, 48, 32
+    m = Seq2SeqAttention(src_vocab=2048, trg_vocab=2048, emb_dim=128,
+                         enc_dim=128, dec_dim=128, att_dim=128)
+    params = m.init(jax.random.PRNGKey(0))
+    backend = Seq2SeqSlotBackend(m, params, src_len=SRC, beam_size=K,
+                                 max_len=L_LONG)
+
+    def make_requests():
+        # fresh seed per call: warmup and BOTH A/B arms replay the
+        # IDENTICAL trace, so the measured delta is pure scheduling
+        rng = np.random.RandomState(0)
+        reqs = []
+        for i in range(N):
+            ids = rng.randint(3, 2048, (1, SRC)).astype(np.int32)
+            lens = np.asarray([SRC], np.int32)
+            canon, rows, sig = canonicalize_feed({"src": (ids, lens)})
+            limit = L_LONG if i % 10 == 9 else L_SHORT
+            reqs.append(Request(feed=canon, rows=rows, signature=sig,
+                                future=ServingFuture(), deadline=None,
+                                t_submit=0.0, max_len=limit))
+        return reqs
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, max(0, int(round(p / 100 * len(xs))) - 1))]
+
+    # -- continuous: harvest -> admit -> one fused step, repeat ------------
+    sched = SlotScheduler(backend, slots=S)
+    for b in (1, 2, 4, 8):      # prime prefill/write at every row bucket
+        warm = make_requests()[:b]
+        sched.admit(warm)
+        sched.reset()
+    one = make_requests()[:1]
+    one[0].max_len = 1
+    sched.admit(one)
+    sched.step()                # prime step
+    sched.harvest()             # prime finalize/release
+    sched.reset()
+
+    reqs = make_requests()
+    pending = deque(reqs)
+    lat_cont, occ = {}, []
+    t0 = _t.perf_counter()
+    while pending or sched.occupied():
+        for req, _out, _steps in sched.harvest():
+            lat_cont[id(req)] = _t.perf_counter() - t0
+        free = sched.free_count()
+        take, rows = [], 0
+        while pending and rows + pending[0].rows <= free:
+            r = pending.popleft()
+            take.append(r)
+            rows += r.rows
+        if take:
+            sched.admit(take)
+        if sched.occupied():
+            occ.append(sched.occupied() / S)
+            sched.step()
+    cont_wall = _t.perf_counter() - t0
+    tokens = sum(r.rows * r.max_len for r in reqs)
+    cont_tps = tokens / cont_wall
+
+    # -- bucket: groups of S, lock-step to the group's longest budget ------
+    def run_bucket(reqs, record):
+        t0 = _t.perf_counter()
+        for i in range(0, len(reqs), S):
+            group = reqs[i:i + S]
+            ids = np.concatenate([np.asarray(r.feed["src"][0])
+                                  for r in group])
+            lens = np.concatenate([np.asarray(r.feed["src"][1])
+                                   for r in group])
+            if len(group) < S:   # pad by replication, as merge_feeds does
+                reps = S - len(group)
+                ids = np.concatenate([ids] + [ids[-1:]] * reps)
+                lens = np.concatenate([lens] + [lens[-1:]] * reps)
+            max_l = max(r.max_len for r in group)
+            toks, _ = m.beam_search(params, jnp.asarray(ids),
+                                    jnp.asarray(lens), beam_size=K,
+                                    max_len=max_l)
+            np.asarray(toks)     # sync: the batch is done for EVERYONE
+            if record is not None:
+                now = _t.perf_counter() - t0
+                for r in group:
+                    record[id(r)] = now
+
+    for warm_l in (L_SHORT, L_LONG):   # prime both compiled budgets
+        w = make_requests()[:S]
+        for r in w:
+            r.max_len = warm_l
+        run_bucket(w, None)
+    reqs_b = make_requests()
+    lat_bucket = {}
+    t0 = _t.perf_counter()
+    run_bucket(reqs_b, lat_bucket)
+    bucket_wall = _t.perf_counter() - t0
+    bucket_tps = tokens / bucket_wall
+
+    cont_p50, cont_p99 = (pct(list(lat_cont.values()), p) for p in (50, 99))
+    buck_p50, buck_p99 = (pct(list(lat_bucket.values()), p)
+                          for p in (50, 99))
+    if cont_tps > 1.05 * bucket_tps and cont_p99 < buck_p99:
+        winner = "continuous"
+    elif bucket_tps > 1.05 * cont_tps and buck_p99 < cont_p99:
+        winner = "bucket"
+    elif abs(cont_tps - bucket_tps) <= 0.05 * max(cont_tps, bucket_tps):
+        winner = "tie"
+    else:
+        winner = "mixed"
+    return {
+        "metric": f"serving_continuous_ab_tok_per_sec"
+                  f"(S{S},K{K},N{N},90pct_short{L_SHORT},long{L_LONG})",
+        "short": "serving_continuous_ab",
+        "value": round(cont_tps, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(cont_tps / bucket_tps, 3),
+        "mfu": None,
+        "bucket_tok_s": round(bucket_tps, 1),
+        "continuous_p50_ms": round(cont_p50 * 1e3, 3),
+        "continuous_p99_ms": round(cont_p99 * 1e3, 3),
+        "bucket_p50_ms": round(buck_p50 * 1e3, 3),
+        "bucket_p99_ms": round(buck_p99 * 1e3, 3),
+        "mean_slot_occupancy": round(sum(occ) / max(1, len(occ)), 4),
+        "winner": winner,
+        "default_flag": bool(FLAGS.serve_continuous),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -817,6 +970,7 @@ def main() -> None:
         safe(bench_googlenet, batch_size=256),
         safe(bench_pallas_lstm_ab),
         safe(bench_pallas_decode_ab),
+        safe(bench_serving_continuous_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

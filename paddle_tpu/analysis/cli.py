@@ -18,6 +18,11 @@ closure is audited with the serving check set (host transfers on the
 request path, >1 MiB folded constants — weights must ride as arguments,
 not baked into the executable), the same gate
 ``InferenceServer.start(preflight=True)`` applies before reporting ready.
+A ``--serve`` run ALSO audits the continuous-batching ``decode_step``
+closure (the slot-table fused step, serving/slots.py) with the decode
+check set — a host transfer there fires once per token per resident
+request, the same contract as ``audit_decode``; both readout variants
+are traced (the kernel in interpret mode off-TPU).
 
 ``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
 generation path (Seq2SeqAttention.beam_search over the fused decode
@@ -150,6 +155,24 @@ def _audit_serving_bundle(bundle: str) -> List[Finding]:
                     f"{type(e).__name__}: {e}")]
 
 
+def _audit_slot_step_closure() -> List[Finding]:
+    """The continuous-batching half of ``--serve``: audit the compiled
+    ``decode_step`` closure over a slot table at a compact flagship shape
+    (serving.slots.audit_slot_backend — same check set and contract as
+    ``--decode``).  One audit per lint run, independent of how many
+    bundles were given: the step program is the serving tier's, not a
+    bundle's."""
+    try:
+        from paddle_tpu.serving.slots import audit_slot_backend
+
+        return audit_slot_backend()
+    except Exception as e:  # a step that fails to BUILD is a finding
+        return [Finding(
+            check="serve-build", severity="ERROR", file="serve_slots",
+            message=f"slot decode_step closure failed to build: "
+                    f"{type(e).__name__}: {e}")]
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu lint",
@@ -198,6 +221,9 @@ def run(argv: Optional[List[str]] = None) -> int:
         findings.extend(_audit_decode_closure(ns.decode))
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
+    if ns.serve:
+        # --serve also gates the continuous path's fused step (once)
+        findings.extend(_audit_slot_step_closure())
 
     if ns.allowlist:
         findings = apply_allowlist(findings, load_allowlist(ns.allowlist))

@@ -62,6 +62,11 @@ from paddle_tpu.ops.decode import (
     greedy_decode,
     beam_gather,
     decode_kernel_config,
+    decode_step,
+    init_slot_carry,
+    write_slot,
+    release_slot,
+    finalize_slots,
 )
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
 from paddle_tpu.ops.sparse import (

@@ -63,6 +63,11 @@ __all__ = [
     "greedy_decode",
     "beam_gather",
     "decode_kernel_config",
+    "decode_step",
+    "init_slot_carry",
+    "write_slot",
+    "release_slot",
+    "finalize_slots",
 ]
 
 #: the reference's kill score for impossible candidates (nn/recurrent.py
@@ -282,6 +287,185 @@ def _resolve_early_exit(early_exit: Optional[bool]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# the slot-table single-step API (continuous batching; docs/serving.md)
+# ---------------------------------------------------------------------------
+#
+# The carry of a fixed-capacity decode table of S slots, each holding one
+# request's K beams — the recurrent/attention state is the KV-cache
+# analogue.  A dict pytree so the whole table jits as one argument:
+#
+#   tokens   [S, K, max_len+1] i32   EOS-prefilled token buffers, BOS at 0
+#   logp     [S, K] f32              cumulative beam log-probs
+#   state    pytree, leading dim S*K (beam-tiled model carry; leaves may
+#                                     also be [S, K, ...])
+#   finished [S, K] bool             per-beam EOS mask
+#   active   [S] bool                slot occupancy (host-managed)
+#   step     [S] i32                 per-slot step count
+#
+# ``decode_step`` advances every ACTIVE slot by one token — inactive slots
+# are frozen bit-for-bit, so a harvested-but-not-yet-refilled slot holds
+# its result untouched across steps.  Because every per-row computation in
+# the engine (readout matmul, top-k, gather) is row-independent, an active
+# slot advances exactly as the same request would inside a solo
+# ``beam_decode`` batch: per-request outputs are bit-identical regardless
+# of which other requests share the table (pinned by
+# tests/test_serving_slots.py).
+
+
+def decode_step(step_fn: Callable, readout, carry: dict, *, vocab_size: int,
+                eos: int = 1, use_kernel: Optional[bool] = None) -> dict:
+    """ONE fused decode step over a slot table (the reusable body of
+    ``beam_decode``'s loop).  ``step_fn(tokens [S*K] i32, state) ->
+    (readout_input, new_state)`` exactly as in ``beam_decode``; per-slot
+    ``active``/``step`` masks freeze finished/unoccupied slots and let
+    every slot run at its own position in its token buffer."""
+    tokens, logp = carry["tokens"], carry["logp"]
+    state, finished = carry["state"], carry["finished"]
+    active, step = carry["active"], carry["step"]
+    S, K, Lp1 = tokens.shape
+    kr = min(K, vocab_size)        # per-row candidates: top-K needs ≤ V
+    fin_toks, fin_vals = _eos_candidates(vocab_size, kr, eos)
+
+    # each slot reads the token at ITS OWN step position
+    y = jnp.take_along_axis(
+        tokens, jnp.broadcast_to(step[:, None, None], (S, K, 1)).astype(
+            jnp.int32), axis=2)[..., 0]
+    r_in, state_new = step_fn(y.reshape(S * K), state)
+    vals, idx, lse = readout(r_in, kr, use_kernel=use_kernel)
+    row_logp = (vals - lse[:, None]).reshape(S, K, kr)
+    row_idx = idx.reshape(S, K, kr)
+    # finished beams may only emit EOS at zero cost (per-slot EOS masking)
+    row_logp = jnp.where(finished[..., None], fin_vals[None, None], row_logp)
+    row_idx = jnp.where(finished[..., None], fin_toks[None, None], row_idx)
+    flat = (logp[..., None] + row_logp).reshape(S, K * kr)
+    new_logp, flat_ix = lax.top_k(flat, K)
+    beam_ix = flat_ix // kr
+    tok = jnp.take_along_axis(row_idx.reshape(S, K * kr), flat_ix, axis=1)
+    # one packed gather reorders the whole carry
+    tokens_g, state_g, finished_g = beam_gather(
+        (tokens, state_new, finished), beam_ix)
+    pos = (jnp.arange(Lp1, dtype=jnp.int32)[None, :]
+           == (step + 1)[:, None])                      # [S, Lp1]
+    tokens_g = jnp.where(pos[:, None, :], tok[:, :, None], tokens_g)
+    finished_g = finished_g | (tok == eos)
+
+    # freeze inactive slots bit-for-bit (state leaves may be [S*K, ...] or
+    # [S, K, ...] — beam_gather's contract)
+    row_keep = jnp.repeat(active, K)
+
+    def _sel(new, old):
+        if new.shape[0] == S * K:
+            m = row_keep.reshape((S * K,) + (1,) * (new.ndim - 1))
+        else:
+            m = active.reshape((S,) + (1,) * (new.ndim - 1))
+        return jnp.where(m, new, old)
+
+    return {
+        "tokens": jnp.where(active[:, None, None], tokens_g, tokens),
+        "logp": jnp.where(active[:, None], new_logp, logp),
+        "state": jax.tree_util.tree_map(_sel, state_g, state),
+        "finished": jnp.where(active[:, None], finished_g, finished),
+        "active": active,
+        "step": jnp.where(active, step + 1, step),
+    }
+
+
+def init_slot_carry(state_template, *, slots: int, beam_size: int,
+                    max_len: int, eos: int = 1) -> dict:
+    """An EMPTY slot table: every slot inactive and finished, token buffers
+    EOS-prefilled, state leaves zero-filled at the beam-tiled shapes.
+    ``state_template`` is a per-sequence state pytree with leading dim 1 on
+    every leaf (arrays or ``ShapeDtypeStruct``s — e.g. from
+    ``jax.eval_shape`` over a prefill)."""
+    S, K = int(slots), int(beam_size)
+
+    def make(leaf):
+        return jnp.zeros((S * K,) + tuple(leaf.shape[1:]), leaf.dtype)
+
+    return {
+        "tokens": jnp.full((S, K, max_len + 1), eos, jnp.int32),
+        "logp": jnp.tile(
+            jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)[None], (S, 1)),
+        "state": jax.tree_util.tree_map(make, state_template),
+        "finished": jnp.ones((S, K), bool),
+        "active": jnp.zeros((S,), bool),
+        "step": jnp.zeros((S,), jnp.int32),
+    }
+
+
+def write_slot(carry: dict, slot, state0, *, bos: int = 0,
+               eos: int = 1, row=0) -> dict:
+    """Prefill: admit one request into slot ``slot`` WITHOUT recompiling —
+    ``slot`` and ``row`` are traced scalars, so one compiled program serves
+    every slot index.  ``state0`` is a prefill-output pytree with a leading
+    batch dim; row ``row`` of it is beam-tiled to K rows and written over
+    the slot's rows [slot*K, slot*K+K).  The slot's token buffer, scores,
+    and masks are reset; it comes back active at step 0."""
+    tokens, logp = carry["tokens"], carry["logp"]
+    S, K, Lp1 = tokens.shape
+    slot = jnp.asarray(slot, jnp.int32)
+    row = jnp.asarray(row, jnp.int32)
+
+    def put(table, leaf):
+        one = lax.dynamic_slice_in_dim(leaf, row, 1, axis=0)
+        tiled = jnp.repeat(one, K, axis=0).astype(table.dtype)
+        return lax.dynamic_update_slice_in_dim(table, tiled, slot * K, axis=0)
+
+    row_tokens = jnp.full((1, K, Lp1), eos, jnp.int32).at[:, :, 0].set(bos)
+    row_logp = jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)[None]
+    return {
+        "tokens": lax.dynamic_update_slice(tokens, row_tokens, (slot, 0, 0)),
+        "logp": lax.dynamic_update_slice(logp, row_logp, (slot, 0)),
+        "state": jax.tree_util.tree_map(put, carry["state"], state0),
+        "finished": carry["finished"].at[slot].set(jnp.zeros((K,), bool)),
+        "active": carry["active"].at[slot].set(True),
+        "step": carry["step"].at[slot].set(0),
+    }
+
+
+def release_slot(carry: dict, slot) -> dict:
+    """Free slot ``slot`` (harvest or eviction): inactive + all-finished,
+    so ``decode_step`` freezes it until the next ``write_slot``."""
+    K = carry["tokens"].shape[1]
+    slot = jnp.asarray(slot, jnp.int32)
+    return dict(
+        carry,
+        active=carry["active"].at[slot].set(False),
+        finished=carry["finished"].at[slot].set(jnp.ones((K,), bool)),
+    )
+
+
+def _finalize(tokens, logp, *, eos: int, length_penalty: float):
+    """The shared decode epilogue: strip BOS, apply the length penalty,
+    sort beams best-first.  ``beam_decode`` and the slot harvest MUST go
+    through this one implementation — per-request bit-identity between the
+    two paths is structural, not coincidental."""
+    out = tokens[:, :, 1:]
+    if length_penalty > 0:
+        lengths = jnp.sum((out != eos).astype(jnp.float32), axis=-1) + 1.0
+        scores = logp / jnp.power(lengths, length_penalty)
+    else:
+        scores = logp
+    order = jnp.argsort(-scores, axis=1)
+    out = jnp.take_along_axis(out, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return out, scores
+
+
+def finalize_slots(carry: dict, *, eos: int = 1,
+                   length_penalty: float = 0.0):
+    """Harvest view of the WHOLE table: ``(tokens [S, K, max_len],
+    scores [S, K])`` sorted best-first per slot — the slot analog of
+    ``beam_decode``'s return.  Positions a slot never reached are
+    EOS-prefilled, so slicing a harvested slot to its request's own
+    ``max_len`` yields exactly the solo ``beam_decode(max_len=...)``
+    output (length counts, and hence penalized scores, agree because the
+    tail is all EOS)."""
+    return _finalize(carry["tokens"], carry["logp"], eos=eos,
+                     length_penalty=length_penalty)
+
+
+# ---------------------------------------------------------------------------
 # drivers
 # ---------------------------------------------------------------------------
 
@@ -302,57 +486,37 @@ def beam_decode(step_fn: Callable, readout, state0, *, batch_size: int,
     Returns ``(tokens [B, K, max_len], scores [B, K])`` sorted best-first —
     the exact output contract (and, token-for-token, the exact output) of
     the pre-engine scan path.  ``early_exit``/``use_kernel`` default to
-    FLAGS.decode_early_exit / the ``decode_kernel_config`` gate."""
+    FLAGS.decode_early_exit / the ``decode_kernel_config`` gate.
+
+    The loop body IS :func:`decode_step` over an always-active slot table
+    of B slots — the whole-batch and continuous-batching paths share one
+    step implementation."""
     B, K, V = batch_size, beam_size, vocab_size
-    kr = min(K, V)                 # per-row candidates: top-K needs ≤ V
     early = _resolve_early_exit(early_exit)
 
     state = jax.tree_util.tree_map(lambda x: jnp.repeat(x, K, axis=0), state0)
-    logp = jnp.tile(jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)[None],
-                    (B, 1))
-    tokens = jnp.full((B, K, max_len + 1), eos, jnp.int32)
-    tokens = tokens.at[:, :, 0].set(bos)
-    finished = jnp.zeros((B, K), bool)
-    fin_toks, fin_vals = _eos_candidates(V, kr, eos)
+    sc = {
+        "tokens": jnp.full((B, K, max_len + 1), eos, jnp.int32)
+                     .at[:, :, 0].set(bos),
+        "logp": jnp.tile(
+            jnp.asarray([0.0] + [NEG] * (K - 1), jnp.float32)[None], (B, 1)),
+        "state": state,
+        "finished": jnp.zeros((B, K), bool),
+        "active": jnp.ones((B,), bool),
+        "step": jnp.zeros((B,), jnp.int32),
+    }
 
     def body(carry):
-        t, tokens, logp, state, finished = carry
-        y = lax.dynamic_index_in_dim(tokens, t, axis=2, keepdims=False)
-        r_in, state_new = step_fn(y.reshape(B * K), state)
-        vals, idx, lse = readout(r_in, kr, use_kernel=use_kernel)
-        row_logp = (vals - lse[:, None]).reshape(B, K, kr)
-        row_idx = idx.reshape(B, K, kr)
-        # finished beams may only emit EOS at zero cost
-        row_logp = jnp.where(finished[..., None], fin_vals[None, None],
-                             row_logp)
-        row_idx = jnp.where(finished[..., None], fin_toks[None, None],
-                            row_idx)
-        flat = (logp[..., None] + row_logp).reshape(B, K * kr)
-        new_logp, flat_ix = lax.top_k(flat, K)
-        beam_ix = flat_ix // kr
-        tok = jnp.take_along_axis(row_idx.reshape(B, K * kr), flat_ix,
-                                  axis=1)
-        # one packed gather reorders the whole carry
-        tokens, state_new, finished = beam_gather(
-            (tokens, state_new, finished), beam_ix)
-        tokens = tokens.at[:, :, t + 1].set(tok)
-        finished = finished | (tok == eos)
-        return t + 1, tokens, new_logp, state_new, finished
+        t, sc = carry
+        return t + 1, decode_step(step_fn, readout, sc, vocab_size=V,
+                                  eos=eos, use_kernel=use_kernel)
 
-    carry = (jnp.asarray(0, jnp.int32), tokens, logp, state, finished)
-    _, tokens, logp, _, _ = _loop(
-        lambda c: jnp.logical_not(jnp.all(c[4])), body, carry, max_len,
-        early)
-    out = tokens[:, :, 1:]
-    if length_penalty > 0:
-        lengths = jnp.sum((out != eos).astype(jnp.float32), axis=-1) + 1.0
-        scores = logp / jnp.power(lengths, length_penalty)
-    else:
-        scores = logp
-    order = jnp.argsort(-scores, axis=1)
-    out = jnp.take_along_axis(out, order[..., None], axis=1)
-    scores = jnp.take_along_axis(scores, order, axis=1)
-    return out, scores
+    carry = (jnp.asarray(0, jnp.int32), sc)
+    _, sc = _loop(
+        lambda c: jnp.logical_not(jnp.all(c[1]["finished"])), body, carry,
+        max_len, early)
+    return _finalize(sc["tokens"], sc["logp"], eos=eos,
+                     length_penalty=length_penalty)
 
 
 def greedy_decode(step_fn: Callable, readout, state0, *, batch_size: int,

@@ -30,8 +30,10 @@ sees, deterministically:
   worker with a batch in flight, ``latency_injection`` wraps a model
   callable to stall chosen calls (the slow-backend / deadline-blowing
   model), ``crash_calls`` makes chosen calls raise (the breaker-tripping
-  model), and ``slow_client`` paces a feed stream (the
-  trickle-submitting client admission control must not starve on).
+  model), ``slow_client`` paces a feed stream (the trickle-submitting
+  client admission control must not starve on), and
+  ``straggler_request`` marks a generation request adversarial never-EOS
+  (the batch-hostage model continuous batching must contain).
   Poisoned inference batches reuse ``nan_feed`` on the request feed.
 
 Used by tests/test_resilience.py, tests/test_gang.py, and
@@ -66,6 +68,7 @@ __all__ = [
     "latency_injection",
     "crash_calls",
     "slow_client",
+    "straggler_request",
 ]
 
 
@@ -342,6 +345,30 @@ def crash_calls(fn: Callable, *, at: int = 0, times: int = 1,
         raise exc(f"chaos: injected model failure on call {i}")
 
     return _windowed(fn, at, times, action)
+
+
+def straggler_request(feed: dict, *, bias: float = -1e9,
+                      key: str = "eos_bias") -> dict:
+    """Mark a generation request adversarial NEVER-EOS: a copy of ``feed``
+    whose per-request EOS-logit bias is pinned to the kill score, so no
+    beam can ever emit EOS and the request decodes to its full
+    ``max_len`` — the hostage scenario continuous batching exists to
+    contain (under lock-step bucket batching one such request holds every
+    co-batched request for the entire ``max_len``; under slot batching
+    its neighbors harvest and reply the moment their own beams finish —
+    asserted in tests/test_serving_slots.py).
+
+    ``feed[key]`` is the serving convention (``serving.slots
+    .EOS_BIAS_KEY``): a ``[rows, 1]`` float the backend adds to the EOS
+    logit per request.  Backends opt in by reading it in their step — the
+    test-tier toy LM does; a backend that ignores the key simply cannot
+    be sabotaged this way."""
+    out = dict(feed)
+    first = next(iter(out.values()))
+    arr = first[0] if isinstance(first, tuple) else first
+    rows = int(np.asarray(arr).shape[0])
+    out[key] = np.full((rows, 1), float(bias), np.float32)
+    return out
 
 
 def slow_client(feeds: Iterable, *, delay_s: float = 0.05,

@@ -18,6 +18,11 @@ package gives the inference tier the same treatment (docs/serving.md):
   the server reports ready);
 - **degradation** — under overload, generation requests step down the
   configured tier ladder (greedy / shorter max_len) before shedding;
+- **continuous batching** — ``mode="generation"``: a persistent
+  fixed-capacity decode slot table driven one fused step at a time,
+  finished requests' slots recycled to queued requests between steps
+  (slots.py; the Orca/vLLM iteration-level discipline — no request ever
+  waits on a longer neighbor's decode);
 - **observability** — rolling p50/p99, queue depth, shed/timeout/breaker
   counters behind ``InferenceServer.healthz()``;
 - **preflight** — the jaxpr auditor's host-transfer/constant-bloat
@@ -41,6 +46,8 @@ from paddle_tpu.serving.server import InferenceServer
 from paddle_tpu.serving.worker import WorkerSupervisor
 from paddle_tpu.serving.preflight import (SERVING_CHECKS, audit_serving,
                                           check_serving)
+from paddle_tpu.serving.slots import (Seq2SeqSlotBackend, SlotBackend,
+                                      SlotScheduler, audit_slot_backend)
 from paddle_tpu.serving import feeds
 
 __all__ = [
@@ -66,5 +73,9 @@ __all__ = [
     "SERVING_CHECKS",
     "audit_serving",
     "check_serving",
+    "SlotBackend",
+    "Seq2SeqSlotBackend",
+    "SlotScheduler",
+    "audit_slot_backend",
     "feeds",
 ]

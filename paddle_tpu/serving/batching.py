@@ -80,6 +80,8 @@ class Request:
     t_submit: float
     deadline_ms: Optional[float] = None   # original budget, for reporting
     tier: int = 0                 # degradation tier chosen at execution
+    max_len: Optional[int] = None  # generation mode: per-request decode
+    #                                budget (None = the backend's max_len)
 
 
 # ---------------------------------------------------------------------------
@@ -158,10 +160,16 @@ def _pad_rows(arr: np.ndarray, to: int) -> np.ndarray:
 
 
 def merge_feeds(reqs: List[Request], max_batch: int
-                ) -> Tuple[Dict[str, Any], List[Tuple[int, int]]]:
+                ) -> Tuple[Dict[str, Any], List[Tuple[int, int]], int]:
     """Concatenate same-signature request feeds along the batch dim and
-    pad to the power-of-two batch bucket.  Returns the merged feed plus
-    per-request ``(start, stop)`` row slices for splitting outputs."""
+    pad to the power-of-two batch bucket.  Returns ``(merged, slices,
+    rows)``: the merged feed, per-request ``(start, stop)`` row slices for
+    splitting outputs, and the TRUE total row count.  Rows are padded by
+    REPLICATION (real, already-valid data — see ``_pad_rows``), which
+    makes pad rows indistinguishable from real ones downstream; ``rows``
+    is how consumers that must never treat a pad row as a result — the
+    slot scheduler admitting prefill rows into decode slots — know where
+    the real data ends (``merged`` rows ``[rows:]`` are replicas)."""
     slices: List[Tuple[int, int]] = []
     row = 0
     for r in reqs:
@@ -180,7 +188,7 @@ def merge_feeds(reqs: List[Request], max_batch: int
         else:
             cat = np.concatenate([r.feed[name] for r in reqs], axis=0)
             merged[name] = _pad_rows(cat, bucket)
-    return merged, slices
+    return merged, slices, row
 
 
 def split_outputs(outputs: Dict[str, np.ndarray],

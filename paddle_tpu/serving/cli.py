@@ -4,6 +4,7 @@ Usage:
 
     python -m paddle_tpu serve --serve_bundle=model.ptz [--serve_* ...]
     python -m paddle_tpu serve --serve_bundle=model.ptz --serve_smoke=16
+    python -m paddle_tpu serve --serve_continuous --serve_smoke=16
 
 Loads a deploy bundle, builds an :class:`InferenceServer` from the
 ``--serve_*`` flags, runs the warmup/readiness gate (plus the
@@ -12,6 +13,15 @@ SIGTERM/SIGINT (printing a ``healthz()`` line periodically) or — with
 ``--serve_smoke=N`` — pushes N synthetic requests through the full
 queue/batcher/worker path and exits 0 only if every one got a reply
 (the CI self-test mode used by tests/test_cli.py).
+
+``--serve_continuous`` exercises the continuous slot-batching path
+(docs/serving.md "Continuous batching") and is a smoke-only surface for
+now: a compact in-process seq2seq backend is admitted N mixed-length
+requests (short decode budgets interleaved with full-``max_len``
+stragglers — the hostage trace) through the slot scheduler, and the run
+exits 0 only on zero silent drops.  Bundle-based continuous serving
+needs a generation head on the bundle; production deployments build a
+``SlotBackend`` and an ``InferenceServer(mode="generation")`` in-process.
 """
 
 from __future__ import annotations
@@ -22,6 +32,66 @@ import threading
 from typing import List, Optional
 
 __all__ = ["run"]
+
+
+def _continuous_smoke() -> int:
+    """The ``--serve_continuous --serve_smoke=N`` CI self-test: N
+    mixed-length requests through the full admit/step/harvest loop —
+    every one must resolve (reply or typed error) and none may be
+    silently dropped; short requests must not be held hostage by the
+    co-resident stragglers."""
+    import numpy as np
+
+    from paddle_tpu.serving.server import InferenceServer
+    from paddle_tpu.serving.slots import example_slot_backend
+    from paddle_tpu.utils import FLAGS, logger
+
+    backend = example_slot_backend(beam_size=2, src_len=8, max_len=16,
+                                   vocab=256, dim=32)
+    server = InferenceServer(
+        backend,
+        mode="generation",
+        slots=FLAGS.serve_slots,
+        batch_delay_ms=FLAGS.serve_batch_delay_ms,
+        max_queue=FLAGS.serve_queue_depth,
+        default_deadline_ms=FLAGS.serve_deadline_ms,
+        breaker_threshold=FLAGS.serve_breaker_threshold,
+        breaker_cooldown_s=FLAGS.serve_breaker_cooldown_s,
+        max_restarts=FLAGS.serve_max_restarts,
+        restart_backoff_s=FLAGS.serve_backoff_s,
+        hang_timeout_s=FLAGS.serve_hang_timeout_s,
+        nonfinite=FLAGS.serve_nonfinite,
+    )
+    server.start(preflight=FLAGS.serve_preflight)
+    print(json.dumps({"ready": server.ready, **server.healthz()},
+                     default=str))
+    rng = np.random.RandomState(0)
+    failures = dropped = 0
+    try:
+        futs = []
+        for i in range(FLAGS.serve_smoke):
+            ids = rng.randint(3, 256, (1, 8)).astype(np.int32)
+            lens = np.asarray([4 + (i % 5)], np.int32)
+            # 90% short budgets, every 10th a full-max_len straggler
+            max_len = backend.max_len if i % 10 == 9 else 3
+            futs.append(server.submit(
+                {"src": (ids, lens)},
+                deadline_ms=FLAGS.serve_deadline_ms, max_len=max_len))
+        for i, f in enumerate(futs):
+            try:
+                err = f.error(FLAGS.serve_deadline_ms / 1e3 + 60.0)
+            except TimeoutError:
+                dropped += 1   # a future that never resolves IS a drop
+                logger.error("continuous smoke request %d never resolved", i)
+                continue
+            if err is not None:
+                failures += 1
+                logger.warning("continuous smoke request %d failed: %s",
+                               i, err)
+        print(json.dumps(server.healthz(), default=str))
+        return 1 if (failures or dropped) else 0
+    finally:
+        server.close()
 
 
 def run(argv: Optional[List[str]] = None) -> int:
@@ -35,6 +105,14 @@ def run(argv: Optional[List[str]] = None) -> int:
     rest = init(list(argv or []))
     if rest:
         raise ConfigError(f"serve: unrecognized arguments: {rest}")
+    if FLAGS.serve_continuous:
+        if FLAGS.serve_smoke <= 0:
+            raise ConfigError(
+                "serve: --serve_continuous is a smoke-only CLI surface "
+                "(pass --serve_smoke=N); production continuous serving "
+                "builds InferenceServer(mode='generation') over a "
+                "SlotBackend in-process — docs/serving.md")
+        return _continuous_smoke()
     if not FLAGS.serve_bundle:
         raise ConfigError("serve: --serve_bundle=<model.ptz> is required")
 

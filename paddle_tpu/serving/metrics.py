@@ -34,6 +34,10 @@ _COUNTERS = (
     "worker_restarts",      # supervisor relaunches
     "degraded",             # requests executed at a degraded tier (>0)
     "batches",              # model invocations
+    # continuous batching (generation mode; serving/slots.py)
+    "gen_steps",            # fused decode_step calls over the slot table
+    "slot_recycled",        # slots freed (harvest or eviction) for reuse
+    "slot_evicted",         # slots released by mid-generation deadline expiry
 )
 
 
@@ -43,6 +47,8 @@ class ServerMetrics:
         self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
         self._latencies = deque(maxlen=window)  # seconds, completed only
         self._batch_rows = deque(maxlen=window)
+        self._occupancy = deque(maxlen=window)  # occupied/capacity per step
+        self._req_steps = deque(maxlen=window)  # decode steps per request
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -56,6 +62,18 @@ class ServerMetrics:
         with self._lock:
             self._counters["batches"] += 1
             self._batch_rows.append(rows)
+
+    def observe_slots(self, occupied: int, capacity: int) -> None:
+        """Slot-table occupancy at one fused step (generation mode) — the
+        utilization the recycle loop exists to maximize."""
+        with self._lock:
+            self._occupancy.append(occupied / max(1, capacity))
+
+    def observe_request_steps(self, steps: int) -> None:
+        """Decode steps one completed request consumed (its slot-residency
+        in step units)."""
+        with self._lock:
+            self._req_steps.append(int(steps))
 
     def count(self, name: str) -> int:
         with self._lock:
@@ -81,6 +99,8 @@ class ServerMetrics:
             counters = dict(self._counters)
             lat = sorted(self._latencies)
             rows = list(self._batch_rows)
+            occ = list(self._occupancy)
+            steps = list(self._req_steps)
 
         def pct(p):
             ms = self._pct_ms(lat, p)
@@ -92,4 +112,8 @@ class ServerMetrics:
             "p99_ms": pct(99),
             "mean_batch_rows": (round(sum(rows) / len(rows), 2)
                                 if rows else None),
+            "mean_slot_occupancy": (round(sum(occ) / len(occ), 4)
+                                    if occ else None),
+            "mean_request_steps": (round(sum(steps) / len(steps), 2)
+                                   if steps else None),
         }

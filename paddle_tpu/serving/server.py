@@ -23,6 +23,18 @@ Guarantees (proven under chaos in tests/test_serving.py):
 - **graceful degradation** — under queue pressure, generation-style
   models step down the configured tier ladder (e.g. beam -> greedy,
   shorter max_len) before anything is shed.
+
+Two execution modes share that contract:
+
+- ``mode="bucket"`` (default): one-shot compiled forwards, coalesced into
+  precompiled shape buckets — state lives per call;
+- ``mode="generation"``: continuous slot-based batching over a
+  :class:`~paddle_tpu.serving.slots.SlotBackend` — a persistent decode
+  table advanced one fused step at a time, finished requests' slots
+  recycled to queued requests *between steps* (serving/slots.py;
+  docs/serving.md "Continuous batching").  One long request no longer
+  holds its batch hostage: short requests harvest and reply the moment
+  their own beams finish.
 """
 
 from __future__ import annotations
@@ -39,7 +51,8 @@ from paddle_tpu.serving.batching import (BatchQueue, Request, ServingFuture,
 from paddle_tpu.serving.breaker import CircuitBreaker
 from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
                                        InferenceFailed, InvalidRequestError,
-                                       ServerClosed, ShedError, WorkerCrashed)
+                                       ServerClosed, ServingError, ShedError,
+                                       WorkerCrashed)
 from paddle_tpu.serving.metrics import ServerMetrics
 from paddle_tpu.serving.worker import WorkerSupervisor
 from paddle_tpu.utils.log import logger
@@ -68,6 +81,14 @@ class InferenceServer:
     second argument receives the active degradation-tier options dict
     (``fn(feed, tier_opts)``) — that is how generation backends accept
     ``{"greedy": True, "max_len": 32}`` style step-downs.
+
+    With ``mode="generation"``, ``model`` is a
+    :class:`~paddle_tpu.serving.slots.SlotBackend` and the worker runs
+    the continuous slot loop (harvest -> admit -> one fused decode step)
+    instead of one-shot bucket calls; ``slots`` bounds both the decode
+    table and admission (a request's rows must fit the table), and the
+    degradation ladder's ``{"max_len": n}`` tiers cap the decode budget
+    of newly admitted requests under queue pressure.
     """
 
     RUNNING, FAILED, CLOSED = "running", "failed", "closed"
@@ -76,6 +97,8 @@ class InferenceServer:
         self,
         model,
         *,
+        mode: str = "bucket",
+        slots: int = 8,
         outputs: Optional[Sequence[str]] = None,
         max_batch: int = 8,
         batch_delay_ms: float = 2.0,
@@ -96,7 +119,13 @@ class InferenceServer:
     ) -> None:
         if nonfinite not in ("error", "allow"):
             raise ValueError("nonfinite must be 'error' or 'allow'")
+        if mode not in ("bucket", "generation"):
+            raise ValueError("mode must be 'bucket' or 'generation'")
         self.model = model
+        self.mode = mode
+        if mode == "generation":
+            # the slot table bounds admission: a request's rows must fit it
+            max_batch = int(slots)
         self.max_batch = int(max_batch)
         self.batch_delay_s = float(batch_delay_ms) / 1e3
         self.default_deadline_ms = float(default_deadline_ms)
@@ -108,7 +137,19 @@ class InferenceServer:
         self.breaker = CircuitBreaker(
             threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
             probes_to_close=breaker_probes, clock=clock)
-        self._runner = self._make_runner(model)
+        self._scheduler = None
+        if mode == "generation":
+            from paddle_tpu.serving.slots import SlotScheduler
+
+            if not (hasattr(model, "prefill") and hasattr(model, "step_fn")):
+                raise TypeError(
+                    "mode='generation' needs a SlotBackend (prefill/"
+                    "step_fn/readout — serving/slots.py), got "
+                    f"{type(model).__name__}")
+            self._scheduler = SlotScheduler(model, slots=slots, clock=clock)
+            self._runner = None
+        else:
+            self._runner = self._make_runner(model)
         # degradation ladder: tier 0 = full service; thresholds default to
         # evenly-spaced queue-depth watermarks
         self.degrade = list(degrade or [])
@@ -127,13 +168,19 @@ class InferenceServer:
         self._in_flight: List[Request] = []
         self._kill_worker = False
         self.supervisor = WorkerSupervisor(
-            self._serve_once,
+            (self._serve_generation_once if mode == "generation"
+             else self._serve_once),
             max_restarts=max_restarts,
             backoff_s=restart_backoff_s,
             max_backoff_s=max_restart_backoff_s,
             hang_timeout_s=hang_timeout_s,
             on_crash=self._on_worker_crash,
             on_give_up=self._on_worker_give_up,
+            # a relaunched generation worker starts from a FRESH table: the
+            # crash may have left the carry poisoned, and its resident
+            # requests were already failed typed by on_crash
+            on_relaunch=(self._scheduler.reset if self._scheduler is not None
+                         else None),
             clock=clock,
             sleep=sleep,
         )
@@ -184,13 +231,29 @@ class InferenceServer:
         feeds = (warmup_feed if isinstance(warmup_feed, (list, tuple))
                  else [warmup_feed] if warmup_feed is not None else [])
         if preflight:
-            from paddle_tpu.serving.preflight import check_serving
+            if self.mode == "generation":
+                # generation preflight: the compiled decode_step closure is
+                # the hot path — a host transfer there fires once per token
+                # per request (same contract as `lint --serve` / audit_decode)
+                from paddle_tpu.analysis import errors_summary
+                from paddle_tpu.serving.slots import audit_slot_backend
 
-            check_serving(self.model,
-                          example_feed=feeds[0] if feeds else None,
-                          outputs=self._outputs)
+                bad = errors_summary(audit_slot_backend(
+                    self.model, slots=self._scheduler.slots))
+                if bad:
+                    raise ServingError(
+                        f"slot decode_step failed the preflight audit: {bad}")
+            else:
+                from paddle_tpu.serving.preflight import check_serving
+
+                check_serving(self.model,
+                              example_feed=feeds[0] if feeds else None,
+                              outputs=self._outputs)
         if warmup:
-            self._warmup(feeds)
+            if self.mode == "generation":
+                self._warmup_generation(feeds)
+            else:
+                self._warmup(feeds)
         self.supervisor.start()
         self._ready = True
         return self
@@ -232,6 +295,48 @@ class InferenceServer:
                     "compiled in %.2fs", compiled, len(feeds),
                     self._clock() - t0)
 
+    def _warmup_generation(self, feeds: List[Dict[str, Any]]) -> None:
+        """Prime the continuous path's whole compile surface before ready:
+        prefill+write at every admission row bucket of every feed shape,
+        plus one full admit -> step -> harvest cycle (step, finalize,
+        release).  A cold compile between steps would stall every resident
+        slot, not just the admitted request."""
+        from paddle_tpu.serving.batching import batch_bucket
+
+        sched = self._scheduler
+        if not feeds:
+            feeds = [self.model.example_feed(1)]
+        buckets = sorted({batch_bucket(r, self.max_batch)
+                          for r in range(1, self.max_batch + 1)})
+        t0 = self._clock()
+        for feed in feeds:
+            canon, _, sig = canonicalize_feed(feed)
+            one = {
+                name: (tuple(p[:1] for p in v) if isinstance(v, tuple)
+                       else v[:1])
+                for name, v in canon.items()
+            }
+
+            def synth(n):
+                return [Request(feed=one, rows=1, signature=sig,
+                                future=ServingFuture(), deadline=None,
+                                t_submit=t0, max_len=1)
+                        for _ in range(n)]
+
+            for bucket in buckets:
+                sched.admit(synth(min(bucket, sched.slots)))
+                sched.reset()
+        # one full cycle: step + finalize + release compile here
+        sched.admit(synth(1))
+        sched.step()
+        sched.harvest()
+        sched.reset()
+        # the synthetic traffic must not read as served traffic on healthz
+        sched.admitted = sched.recycled = sched.steps_run = 0
+        logger.info("generation warmup: %d admission bucket(s) over %d "
+                    "feed(s) + 1 step cycle compiled in %.2fs",
+                    len(buckets), len(feeds), self._clock() - t0)
+
     @property
     def ready(self) -> bool:
         return self._ready and self._state == self.RUNNING
@@ -259,10 +364,14 @@ class InferenceServer:
     # ------------------------------------------------------------------
 
     def submit(self, feed: Dict[str, Any],
-               deadline_ms: Optional[float] = None) -> ServingFuture:
+               deadline_ms: Optional[float] = None,
+               max_len: Optional[int] = None) -> ServingFuture:
         """Admit one request (a dict feed with a leading batch dim on
         every part) or raise a typed rejection immediately.  Returns a
-        :class:`ServingFuture` that is *guaranteed* to resolve."""
+        :class:`ServingFuture` that is *guaranteed* to resolve.
+
+        ``max_len`` (generation mode) is the request's own decode budget;
+        it must fit the slot table's depth (the backend's ``max_len``)."""
         self.metrics.inc("submitted")
         if self._state != self.RUNNING:
             self.metrics.inc("server_closed")
@@ -270,6 +379,17 @@ class InferenceServer:
         if not self._ready:
             self.metrics.inc("shed")
             raise ShedError("server is still warming up (not ready)")
+        if max_len is not None:
+            depth = getattr(self.model, "max_len", None)
+            if self.mode != "generation":
+                self.metrics.inc("invalid_request")
+                raise InvalidRequestError(
+                    "max_len is a generation-mode request option")
+            if max_len < 1 or (depth is not None and max_len > depth):
+                self.metrics.inc("invalid_request")
+                raise InvalidRequestError(
+                    f"request max_len {max_len} outside the slot table's "
+                    f"depth 1..{depth} — raise the backend's max_len")
         try:
             canon, rows, sig = canonicalize_feed(feed)
         except ValueError as e:
@@ -337,7 +457,8 @@ class InferenceServer:
                     f"~{est * 1e3:.1f}ms estimated queue+service time")
         req = Request(feed=canon, rows=rows, signature=sig,
                       future=ServingFuture(), deadline=deadline,
-                      t_submit=now, deadline_ms=deadline_ms)
+                      t_submit=now, deadline_ms=deadline_ms,
+                      max_len=max_len)
         try:
             self.queue.offer(req)
         except ShedError:
@@ -348,9 +469,10 @@ class InferenceServer:
 
     def infer(self, feed: Dict[str, Any],
               deadline_ms: Optional[float] = None,
-              timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+              timeout: Optional[float] = None,
+              max_len: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Synchronous submit + wait."""
-        fut = self.submit(feed, deadline_ms)
+        fut = self.submit(feed, deadline_ms, max_len=max_len)
         if timeout is None and deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         if timeout is None:
@@ -407,7 +529,7 @@ class InferenceServer:
         # the crash handler with these futures still attributed
         self._in_flight = batch
         try:
-            merged, slices = merge_feeds(batch, self.max_batch)
+            merged, slices, _ = merge_feeds(batch, self.max_batch)
         except Exception as e:  # noqa: BLE001 — structural mismatch
             self._fail_requests(
                 batch,
@@ -440,6 +562,177 @@ class InferenceServer:
         self.breaker.record_failure()
         if self.breaker.trips > trips_before:
             self.metrics.inc("breaker_trips")
+
+    # ------------------------------------------------------------------
+    # the generation worker: continuous slot loop (serving/slots.py)
+    # ------------------------------------------------------------------
+
+    def _complete_harvested(self, gen: int, req: Request, outputs,
+                            steps: int) -> None:
+        """Reply to one harvested request with the bucket path's exact
+        deadline/nonfinite honesty."""
+        now = self._clock()
+        if (self.nonfinite == "error"
+                and not np.all(np.isfinite(outputs["scores"]))):
+            # rows are independent in the slot table, so poison stays in
+            # its own request — co-resident slots are unaffected
+            self._record_failure(gen)
+            if req.future._complete(error=InferenceFailed(
+                    "decode produced non-finite scores (poisoned "
+                    "request?)")):
+                self.metrics.inc("inference_failed")
+            return
+        if self.supervisor.current(gen):
+            self.breaker.record_success()
+        if req.deadline is not None and now > req.deadline:
+            if req.future._complete(error=DeadlineExceeded(
+                    f"completed {1e3 * (now - req.deadline):.1f}ms past "
+                    f"the {req.deadline_ms:.1f}ms deadline")):
+                self.metrics.inc("deadline_expired")
+        elif req.future._complete(result=outputs):
+            self.metrics.inc("completed")
+            dt = now - req.t_submit
+            self.metrics.observe_latency(dt)
+            self.metrics.observe_request_steps(steps)
+            if self.supervisor.current(gen):
+                self._service_ema = (dt if self._service_ema is None
+                                     else 0.8 * self._service_ema + 0.2 * dt)
+
+    def _serve_generation_once(self, gen: int) -> None:
+        """One cycle of the continuous loop: evict expired slots, harvest
+        finished ones, admit queued requests into the freed slots, run ONE
+        fused decode step for every occupied slot.  Every phase keeps the
+        bucket path's reply-or-typed-error guarantees."""
+        sched = self._scheduler
+        live = lambda: self.supervisor.current(gen)  # noqa: E731
+        # deadline plane first: an expired resident can never reply in
+        # time, and its slot is capacity short requests are waiting on
+        evicted = sched.evict_expired(self._clock(), commit=live)
+        if evicted:
+            self._fail_requests(
+                [r for r, _ in evicted],
+                lambda: DeadlineExceeded("deadline expired mid-generation "
+                                         "(slot evicted)"),
+                "deadline_expired")
+            freed = sum(n for _, n in evicted)
+            self.metrics.inc("slot_evicted", freed)
+            self.metrics.inc("slot_recycled", freed)
+        # harvest synchronizes on the device (the previous step's async
+        # dispatch materializes here, not in step()) — it must sit inside
+        # the busy window or a wedged device never trips hang detection
+        self.supervisor.note_busy(gen)
+        try:
+            harvested = sched.harvest(commit=live)
+        finally:
+            self.supervisor.note_idle(gen)
+        for req, outputs, steps in harvested:
+            if not live():
+                return  # abandoned worker: its results are unwanted
+            self.metrics.inc("slot_recycled", req.rows)
+            self._complete_harvested(gen, req, outputs, steps)
+        # admit into freed slots (the PR 5 queue/deadline/shed machinery,
+        # at slot granularity): with residents decoding, the pop must not
+        # block — the coalescing window only applies to an idle table.
+        # The pop runs even with a FULL table (max_rows=0 selects
+        # nothing): its sweep must keep evicting already-expired queued
+        # requests, or dead work occupies the bounded queue and sheds
+        # live traffic for up to a straggler's whole decode
+        free = sched.free_count()
+        occupied = sched.occupied()
+        batch, expired = self.queue.pop_batch(
+            max_rows=free,
+            batch_delay_s=self.batch_delay_s if occupied == 0 else 0.0,
+            timeout=0.05 if occupied == 0 else 0.0,
+            est_service_s=self._service_ema or 0.0,
+            clock=self._clock)
+        self._fail_requests(
+            expired,
+            lambda: DeadlineExceeded("deadline expired while queued"),
+            "deadline_expired")
+        if batch and not self.breaker.allow():
+            self._fail_requests(
+                batch,
+                lambda: CircuitOpenError("circuit breaker is open"),
+                "breaker_rejected")
+            batch = []
+        if batch:
+            tier = self._pick_tier(self.queue.depth())
+            tier_opts = self.degrade[tier - 1] if tier else {}
+            if tier:
+                for r in batch:
+                    r.tier = tier
+                self.metrics.inc("degraded", len(batch))
+            # the popped batch joins the in-flight set BEFORE the
+            # device-bound prefill: a crash or hang inside admit must
+            # fail these futures too, never silently drop them
+            self._in_flight = sched.resident_requests() + batch
+            self.supervisor.note_busy(gen)
+            try:
+                sched.admit(batch,
+                            limit_cap=tier_opts.get("max_len"),
+                            commit=live)
+            except _WorkerKilled:
+                raise
+            except ValueError as e:
+                # a malformed admitted feed (e.g. source longer than the
+                # table's fixed src_len) is a CLIENT bug: reject typed
+                # like bucket-mode merge failures, never feed the breaker
+                # (a retrying client could otherwise trip it and take
+                # down healthy traffic)
+                self._fail_requests(
+                    batch,
+                    lambda: InvalidRequestError(
+                        f"request cannot enter the slot table: {e}"),
+                    "invalid_request")
+            except Exception as e:  # noqa: BLE001 — a model fault
+                self._record_failure(gen)
+
+                def _mk(e=e):
+                    err = InferenceFailed(
+                        f"prefill failed: {type(e).__name__}: {e}")
+                    err.__cause__ = e
+                    return err
+
+                self._fail_requests(batch, _mk, "inference_failed")
+            finally:
+                self.supervisor.note_idle(gen)
+        # the table's residents are the in-flight set: a worker death past
+        # this point must fail exactly these futures (WorkerCrashed)
+        self._in_flight = sched.resident_requests()
+        if not self._in_flight:
+            return
+        if self._kill_worker:
+            self._kill_worker = False
+            raise _WorkerKilled("chaos: worker killed mid-step")
+        self.supervisor.note_busy(gen)
+        try:
+            ran = sched.step(commit=live)
+        except _WorkerKilled:
+            self.supervisor.note_idle(gen)
+            raise
+        except Exception as e:  # noqa: BLE001 — a model fault, not a crash
+            self.supervisor.note_idle(gen)
+            self._record_failure(gen)
+            residents = sched.reset()
+
+            def _mk(e=e):
+                err = InferenceFailed(
+                    f"decode step failed: {type(e).__name__}: {e}")
+                err.__cause__ = e
+                return err
+
+            self._fail_requests(residents, _mk, "inference_failed")
+            self._in_flight = []
+            return
+        except BaseException:
+            # crash/kill path: leave _in_flight populated for the crash
+            # handler (reply-or-typed-error through worker death)
+            self.supervisor.note_idle(gen)
+            raise
+        self.supervisor.note_idle(gen)
+        if ran:
+            self.metrics.inc("gen_steps")
+            self.metrics.observe_slots(sched.occupied(), sched.slots)
 
     def _execute(self, gen: int, batch: List[Request], merged, slices,
                  rows: int, tier_opts: dict) -> None:
@@ -526,9 +819,10 @@ class InferenceServer:
         # crash led to a restart or exhausted the budget) — mirror it so
         # the counter can never disagree with worker.restarts
         snap["counters"]["worker_restarts"] = self.supervisor.restarts
-        return {
+        out = {
             "ready": self.ready,
             "state": self._state,
+            "mode": self.mode,
             "queue_depth": self.queue.depth(),
             "breaker": self.breaker.snapshot(),
             "worker": {"alive": self.supervisor.alive(),
@@ -538,6 +832,18 @@ class InferenceServer:
                                if self._service_ema is not None else None),
             **snap,
         }
+        if self._scheduler is not None:
+            sched = self._scheduler
+            occupied = sched.occupied()
+            out["slots"] = {
+                "capacity": sched.slots,
+                "occupied": occupied,
+                "free": sched.free_count(),
+                "admitted": sched.admitted,
+                "recycled": sched.recycled,
+                "steps": sched.steps_run,
+            }
+        return out
 
     def __enter__(self) -> "InferenceServer":
         return self

@@ -1,0 +1,494 @@
+"""Slot-based continuous batching — iteration-level scheduling for the
+decode engine (docs/serving.md "Continuous batching").
+
+The bucket path coalesces whole requests and runs each batch lock-step to
+completion, so one long request holds its entire batch hostage — fatal
+for tail latency under generation traffic (a max_len straggler multiplies
+every co-batched request's latency by the straggler's length).  Here the
+unit of scheduling is ONE DECODE STEP, the Orca/vLLM discipline mapped
+onto the TPU-native engine:
+
+- a persistent fixed-capacity decode table of ``S`` slots (the
+  recurrent/attention carry as the KV-cache analogue; each slot holds one
+  request's ``K`` beams) lives across calls in ``SlotScheduler.carry``;
+- ``decode_step`` (ops/decode.py) advances every occupied slot by one
+  token in one compiled call — ONE program for any mix of requests;
+- between steps the host harvests finished slots (all beams EOS, or the
+  request's own ``max_len`` reached), recycles them to queued requests
+  via ``write_slot`` (slot index is traced — no recompile per slot), and
+  evicts slots whose deadline already passed;
+- per-request outputs are **bit-identical** to a solo
+  :func:`~paddle_tpu.ops.decode.beam_decode` run regardless of admission
+  order or neighbors, because every per-row computation in the engine is
+  row-independent and frozen slots are held bit-for-bit
+  (tests/test_serving_slots.py pins this).
+
+``SlotScheduler`` is the host-side driver consumed by
+``InferenceServer(mode="generation")`` (serving/server.py); it owns no
+futures and no metrics — it reports events and the server applies the
+PR 5 admission/deadline/breaker machinery to them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving.batching import Request, merge_feeds
+
+__all__ = ["SlotBackend", "Seq2SeqSlotBackend", "SlotScheduler",
+           "audit_slot_backend", "example_slot_backend"]
+
+#: serving convention for the adversarial never-EOS fault
+#: (resilience.chaos.straggler_request): backends that support it read
+#: this feed key as an additive per-request EOS-logit bias
+EOS_BIAS_KEY = "eos_bias"
+
+
+class SlotBackend:
+    """Protocol of a generation backend servable through the slot table.
+
+    Concrete backends provide::
+
+        beam_size       K — beams per slot (fixed for the table's lifetime)
+        max_len         table depth: the longest decode any slot can run
+        vocab_size      target vocabulary
+        bos, eos        special token ids
+        length_penalty  harvest-time score normalization (0 = off)
+        readout         ops.decode LinearReadout / LogitsReadout instance
+
+        prefill(feed)       canonical request feed -> per-sequence state
+                            pytree, leading dim = the feed's rows (NOT
+                            beam-tiled; the engine tiles at write_slot)
+        step_fn(tokens, state) -> (readout_input, new_state)
+                            the ops.decode step protocol over S*K rows
+        example_feed(rows)  synthetic one-bucket feed for warmup/audit
+    """
+
+    beam_size: int = 3
+    max_len: int = 32
+    vocab_size: int = 0
+    bos: int = 0
+    eos: int = 1
+    length_penalty: float = 0.0
+    use_kernel: Optional[bool] = None
+
+    def prefill(self, feed: Dict[str, Any]):
+        raise NotImplementedError
+
+    def step_fn(self, tokens, state):
+        raise NotImplementedError
+
+    def example_feed(self, rows: int = 1) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class Seq2SeqSlotBackend(SlotBackend):
+    """The flagship backend: :class:`~paddle_tpu.models.seq2seq
+    .Seq2SeqAttention` behind the slot table.
+
+    The per-slot state is the full decode context — attention GRU carry
+    ``s`` plus the beam-tiled encoder outputs/projections/mask the step
+    re-reads every token (the KV-cache analogue).  Prefill runs the
+    encoder at a FIXED source length ``src_len`` (requests padded up to
+    it; ``mask_from_lengths`` hides the padding exactly as in training),
+    so every admitted request produces identically-shaped slot state and
+    the step program never recompiles.
+    """
+
+    def __init__(self, model, params, *, src_len: int, beam_size: int = 3,
+                 max_len: int = 32, length_penalty: float = 0.0,
+                 use_kernel: Optional[bool] = None, feed_name: str = "src"):
+        from paddle_tpu.data.feeder import bucket_length
+        from paddle_tpu.models.seq2seq import BOS, EOS
+
+        if src_len != bucket_length(src_len):
+            # serving canonicalizes every request's sequence dim UP the
+            # feeder bucket ladder — a table narrower than the smallest
+            # bucket its own traffic lands in could never admit anything
+            raise ValueError(
+                f"src_len {src_len} is not a feeder bucket "
+                f"(bucket_length -> {bucket_length(src_len)}); canonical "
+                f"request feeds could never fit the slot table")
+        self.model, self.params = model, params
+        self.src_len = int(src_len)
+        self.beam_size = int(beam_size)
+        self.max_len = int(max_len)
+        self.length_penalty = float(length_penalty)
+        self.use_kernel = use_kernel
+        self.feed_name = feed_name
+        self.vocab_size = int(model.trg_vocab)
+        self.bos, self.eos = BOS, EOS
+        import paddle_tpu.ops as O
+
+        self.readout = O.LinearReadout(params["out_w"], params["out_b"])
+
+    def prefill(self, feed):
+        import jax.numpy as jnp
+
+        import paddle_tpu.ops as O
+
+        ids, lens = feed[self.feed_name]
+        ids = jnp.asarray(ids, jnp.int32)
+        lens = jnp.asarray(lens, jnp.int32).reshape(-1)
+        if ids.shape[1] > self.src_len:
+            raise ValueError(
+                f"request source length {ids.shape[1]} exceeds the slot "
+                f"table's fixed src_len {self.src_len}")
+        if ids.shape[1] < self.src_len:
+            ids = jnp.pad(ids, ((0, 0), (0, self.src_len - ids.shape[1])),
+                          constant_values=self.eos)
+        mask = O.mask_from_lengths(lens, self.src_len)
+        enc, enc_proj, s0 = self.model.encode(self.params, ids, mask)
+        return {"s": s0, "enc": enc, "enc_proj": enc_proj, "mask": mask}
+
+    def step_fn(self, tokens, state):
+        import paddle_tpu.ops as O
+
+        y_emb = O.embedding_lookup(self.params["trg_emb"], tokens)
+        s_new, _ = self.model._dec_step(
+            self.params, y_emb, state["s"], state["enc"], state["enc_proj"],
+            state["mask"])
+        return s_new, dict(state, s=s_new)
+
+    def example_feed(self, rows: int = 1):
+        ids = np.full((rows, self.src_len), 3, np.int32)
+        lens = np.full((rows,), self.src_len, np.int32)
+        return {self.feed_name: (ids, lens)}
+
+
+# ---------------------------------------------------------------------------
+# the host-side slot table driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _SlotEntry:
+    request: Request
+    row: int          # which row of its (possibly multi-row) request
+    limit: int        # per-request max_len, <= the table depth
+    t_admit: float
+
+
+@dataclass
+class _PendingRequest:
+    request: Request
+    rows: int
+    results: List[Optional[Tuple[np.ndarray, np.ndarray]]] = field(
+        default_factory=list)
+    steps: int = 0    # max decode steps across the request's rows
+
+
+class SlotScheduler:
+    """Drive a :class:`SlotBackend` through the slot table.
+
+    Owns the device carry plus the host bookkeeping (slot -> request/row,
+    per-request result assembly, free list).  All compiled closures —
+    step, write, release, finalize, prefill — are built once; prefill
+    compiles per (row-bucket, seq-bucket) feed shape exactly like the
+    bucket path, all primed by the server's warmup gate.
+
+    Thread discipline: one worker drives the scheduler at a time; the
+    short bookkeeping sections take ``_lock`` so a supervisor
+    ``reset()`` (worker relaunch) can never interleave with them, and the
+    device step is committed only when the caller's ``commit()`` check
+    still holds — an abandoned (hung-then-replaced) worker that wakes up
+    mid-step must not clobber the fresh worker's table.
+    """
+
+    def __init__(self, backend: SlotBackend, *, slots: int,
+                 clock=time.monotonic):
+        import jax
+
+        from paddle_tpu.ops.decode import (decode_step, finalize_slots,
+                                           init_slot_carry, release_slot,
+                                           write_slot)
+
+        if slots < 1:
+            raise ValueError("slot table needs at least 1 slot")
+        self.backend = backend
+        self.slots = int(slots)
+        self._clock = clock
+        self._lock = threading.Lock()
+
+        # step NEVER donates its carry: the commit-rejected (abandoned
+        # worker) path discards the result and keeps the input.  Write and
+        # release always commit, so on TPU the old table is donated and
+        # the dynamic_update_slice lowers in place instead of copying the
+        # whole table per admitted row (CPU ignores donation).
+        donate = ((0,) if jax.default_backend() in ("tpu", "axon") else ())
+        self._step_jit = jax.jit(lambda c: decode_step(
+            backend.step_fn, backend.readout, c,
+            vocab_size=backend.vocab_size, eos=backend.eos,
+            use_kernel=backend.use_kernel))
+        self._write_jit = jax.jit(
+            lambda c, slot, s0, row: write_slot(
+                c, slot, s0, bos=backend.bos, eos=backend.eos, row=row),
+            donate_argnums=donate)
+        self._release_jit = jax.jit(release_slot, donate_argnums=donate)
+        self._final_jit = jax.jit(lambda c: finalize_slots(
+            c, eos=backend.eos, length_penalty=backend.length_penalty))
+        self._prefill_jit = jax.jit(backend.prefill)
+
+        tpl = jax.eval_shape(backend.prefill, backend.example_feed(1))
+        self._init_carry = lambda: init_slot_carry(
+            tpl, slots=self.slots, beam_size=backend.beam_size,
+            max_len=backend.max_len, eos=backend.eos)
+        self.carry = self._init_carry()
+        self._entries: List[Optional[_SlotEntry]] = [None] * self.slots
+        self._free: List[int] = list(range(self.slots - 1, -1, -1))
+        self._pending: Dict[int, _PendingRequest] = {}
+        self.steps_run = 0
+        self.recycled = 0       # slots freed (harvest + eviction)
+        self.admitted = 0       # slots filled
+
+    # -- occupancy ---------------------------------------------------------
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def occupied(self) -> int:
+        with self._lock:
+            return self.slots - len(self._free)
+
+    def resident_requests(self) -> List[Request]:
+        """The distinct requests currently holding slots (oldest first) —
+        the server's in-flight set for crash attribution."""
+        with self._lock:
+            return [p.request for p in self._pending.values()]
+
+    def reset(self) -> List[Request]:
+        """Fresh table (worker relaunch): drops every resident request's
+        state and returns those requests so the caller can fail them typed
+        (usually already done by the crash handler — futures are
+        set-once, so double-failing is a no-op)."""
+        with self._lock:
+            dropped = [p.request for p in self._pending.values()]
+            self.carry = self._init_carry()
+            self._entries = [None] * self.slots
+            self._free = list(range(self.slots - 1, -1, -1))
+            self._pending.clear()
+            return dropped
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, reqs: List[Request], *,
+              limit_cap: Optional[int] = None,
+              commit: Callable[[], bool] = lambda: True) -> int:
+        """Prefill ``reqs`` in ONE merged encoder call and write each REAL
+        row into a free slot.  ``merge_feeds`` pads rows by replication up
+        to the batch bucket; the per-request ``slices`` (true row counts —
+        the satellite contract) are what gets written, so a replicated pad
+        row can never occupy a slot or be harvested as a result.  The
+        caller guarantees ``sum(rows) <= free_count()``.  Returns slots
+        filled (0 when ``commit()`` no longer holds after the device-bound
+        prefill — an abandoned worker must not write into the fresh
+        worker's table; its requests were already failed by the crash
+        handler).  Raises on prefill failure (a model fault — nothing was
+        admitted; the caller fails the batch typed)."""
+        if not reqs:
+            return 0
+        merged, slices, rows = merge_feeds(reqs, self.slots)
+        state0 = self._prefill_jit(merged)
+        now = self._clock()
+        n = 0
+        with self._lock:
+            if not commit():
+                return 0
+            if sum(b - a for a, b in slices) > len(self._free):
+                raise RuntimeError(
+                    f"admit overflow: {rows} rows into "
+                    f"{len(self._free)} free slots")
+            for req, (a, b) in zip(reqs, slices):
+                limit = min(req.max_len or self.backend.max_len,
+                            self.backend.max_len,
+                            limit_cap or self.backend.max_len)
+                limit = max(1, int(limit))
+                self._pending[id(req)] = _PendingRequest(
+                    request=req, rows=b - a,
+                    results=[None] * (b - a))
+                for row in range(a, b):
+                    slot = self._free.pop()
+                    self.carry = self._write_jit(self.carry, slot, state0,
+                                                 row)
+                    self._entries[slot] = _SlotEntry(req, row - a, limit,
+                                                     now)
+                    n += 1
+            self.admitted += n
+        return n
+
+    # -- the fused step ----------------------------------------------------
+
+    def step(self, commit: Callable[[], bool] = lambda: True) -> bool:
+        """Run one fused decode step for every occupied slot.  The new
+        carry is committed only if ``commit()`` still holds after the
+        device call returns (abandoned-worker discipline)."""
+        new = self._step_jit(self.carry)
+        with self._lock:
+            if not commit():
+                return False
+            self.carry = new
+            self.steps_run += 1
+        return True
+
+    # -- harvest + eviction ------------------------------------------------
+
+    def _release(self, slot: int) -> None:
+        # callers hold _lock
+        self.carry = self._release_jit(self.carry, slot)
+        self._entries[slot] = None
+        self._free.append(slot)
+        self.recycled += 1
+
+    def _drop_request(self, req: Request) -> int:
+        # callers hold _lock: release EVERY slot the request occupies
+        n = 0
+        for slot, e in enumerate(self._entries):
+            if e is not None and e.request is req:
+                self._release(slot)
+                n += 1
+        self._pending.pop(id(req), None)
+        return n
+
+    def evict_expired(self, now: float,
+                      commit: Callable[[], bool] = lambda: True
+                      ) -> List[Tuple[Request, int]]:
+        """Release every slot whose request's deadline has passed
+        mid-generation; returns ``(request, slots_freed)`` pairs (each
+        request once) so the caller completes them with
+        ``DeadlineExceeded``.  ``slots_freed`` counts the slots actually
+        released NOW — rows of a multi-row request that already harvested
+        are not re-counted."""
+        with self._lock:
+            if not commit():
+                return []
+            expired = []
+            for e in self._entries:
+                if (e is not None and e.request.deadline is not None
+                        and now > e.request.deadline
+                        and not any(r is e.request for r, _ in expired)):
+                    expired.append((e.request, 0))
+            return [(req, self._drop_request(req)) for req, _ in expired]
+
+    def done_slots(self) -> List[int]:
+        """Slots whose request finished: all beams EOS, or the request's
+        own ``max_len`` reached.  One host sync over two tiny arrays —
+        skipped entirely on an empty table (the sync would otherwise
+        block on the previous step's async dispatch every idle cycle)."""
+        with self._lock:
+            if not any(e is not None for e in self._entries):
+                return []
+        fin = np.asarray(self.carry["finished"]).all(axis=1)
+        stepc = np.asarray(self.carry["step"])
+        with self._lock:
+            return [i for i, e in enumerate(self._entries)
+                    if e is not None and (fin[i] or stepc[i] >= e.limit)]
+
+    def harvest(self, commit: Callable[[], bool] = lambda: True
+                ) -> List[Tuple[Request, Optional[Dict[str, Any]], int]]:
+        """Collect finished slots, recycle them, and assemble completed
+        requests.  Returns ``(request, outputs, steps)`` triples — outputs
+        ``{"tokens": [rows, K, limit] i32, "scores": [rows, K] f32}``
+        sliced to the request's own ``max_len`` and bit-identical to a
+        solo ``beam_decode`` run of the same request."""
+        done = self.done_slots()
+        if not done:
+            return []
+        toks_d, scores_d = self._final_jit(self.carry)
+        toks, scores = np.asarray(toks_d), np.asarray(scores_d)
+        stepc = np.asarray(self.carry["step"])
+        out: List[Tuple[Request, Optional[Dict[str, Any]], int]] = []
+        with self._lock:
+            if not commit():
+                return []
+            for slot in done:
+                e = self._entries[slot]
+                if e is None:       # raced with an eviction
+                    continue
+                pend = self._pending.get(id(e.request))
+                self._release(slot)
+                if pend is None:
+                    continue
+                pend.results[e.row] = (toks[slot][:, :e.limit],
+                                       scores[slot])
+                pend.steps = max(pend.steps, int(stepc[slot]))
+                if all(r is not None for r in pend.results):
+                    self._pending.pop(id(e.request))
+                    out.append((
+                        pend.request,
+                        {"tokens": np.stack([r[0] for r in pend.results]),
+                         "scores": np.stack([r[1] for r in pend.results])},
+                        pend.steps))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# audit + self-test helpers
+# ---------------------------------------------------------------------------
+
+
+def example_slot_backend(*, slots: int = 4, beam_size: int = 4,
+                         src_len: int = 8, max_len: int = 8,
+                         vocab: int = 1024, dim: int = 128,
+                         use_kernel: Optional[bool] = None
+                         ) -> Seq2SeqSlotBackend:
+    """A compact flagship-shaped backend (lane-aligned dims — structure,
+    not perf) for the lint audit and the CLI continuous smoke test."""
+    import jax
+
+    from paddle_tpu.models import Seq2SeqAttention
+
+    m = Seq2SeqAttention(src_vocab=vocab, trg_vocab=vocab, emb_dim=dim,
+                         enc_dim=dim, dec_dim=dim, att_dim=dim)
+    params = m.init(jax.random.PRNGKey(0))
+    return Seq2SeqSlotBackend(m, params, src_len=src_len,
+                              beam_size=beam_size, max_len=max_len,
+                              use_kernel=use_kernel)
+
+
+def audit_slot_backend(backend: Optional[SlotBackend] = None, *,
+                       slots: int = 4, label: str = "serve_slots"):
+    """Audit the compiled ``decode_step`` closure over a slot table —
+    same contract as ``analysis.audit_decode`` (host transfers inside the
+    step are an ERROR: one per token per request at serving rates), used
+    by ``python -m paddle_tpu lint --serve`` and the generation-mode
+    server preflight.  Both readout variants are traced where the kernel
+    gate admits the shape (the kernel in interpret mode off-TPU)."""
+    import jax
+
+    from paddle_tpu.analysis import Finding, audit_decode
+    from paddle_tpu.ops.decode import (_forced_kernel_config, decode_step,
+                                       init_slot_carry)
+
+    backend = backend or example_slot_backend(slots=slots)
+    tpl = jax.eval_shape(backend.prefill, backend.example_feed(1))
+    carry = init_slot_carry(tpl, slots=slots, beam_size=backend.beam_size,
+                            max_len=backend.max_len, eos=backend.eos)
+    depth = getattr(getattr(backend, "readout", None), "w", None)
+    depth = None if depth is None else int(depth.shape[0])
+    findings = []
+    variants = [(False, "xla_topk")]
+    if (depth is not None and _forced_kernel_config(
+            slots * backend.beam_size, depth, backend.vocab_size,
+            min(backend.beam_size, backend.vocab_size)) is not None):
+        variants.insert(0, (True, "kernel"))
+    for use_kernel, tag in variants:
+        try:
+            findings.extend(audit_decode(
+                lambda c, uk=use_kernel: decode_step(
+                    backend.step_fn, backend.readout, c,
+                    vocab_size=backend.vocab_size, eos=backend.eos,
+                    use_kernel=uk),
+                carry, label=f"{label}[{tag}]"))
+        except Exception as e:  # a step that fails to TRACE is a finding
+            findings.append(Finding(
+                check="serve-build", severity="ERROR",
+                file=f"{label}[{tag}]",
+                message=f"slot decode_step failed to trace: "
+                        f"{type(e).__name__}: {e}"))
+    return findings

@@ -41,6 +41,7 @@ class WorkerSupervisor:
         poll_s: float = 0.01,
         on_crash: Callable[[Exception], None],
         on_give_up: Callable[[Exception], None],
+        on_relaunch: Optional[Callable[[], None]] = None,
         clock=time.monotonic,
         sleep=time.sleep,
     ) -> None:
@@ -52,6 +53,7 @@ class WorkerSupervisor:
         self.poll_s = float(poll_s)
         self._on_crash = on_crash
         self._on_give_up = on_give_up
+        self._on_relaunch = on_relaunch
         self._clock = clock
         self._sleep = sleep
         self.restarts = 0
@@ -101,6 +103,15 @@ class WorkerSupervisor:
             gen = self._generation
             self._crash_exc = None
             self._busy_since = None
+        if gen > 1 and self._on_relaunch is not None:
+            # stateful workers (the generation-mode slot table) rebuild
+            # their state BEFORE the replacement starts serving: a crashed
+            # step may have left the carry poisoned, and the in-flight
+            # requests it held were already failed typed by on_crash
+            self._on_relaunch()
+        with self._lock:
+            if gen != self._generation:
+                return  # stop() raced the relaunch: stay down
             self._worker = threading.Thread(
                 target=self._worker_main, args=(gen,),
                 name=f"serving-worker-{gen}", daemon=True)

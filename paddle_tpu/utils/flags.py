@@ -246,6 +246,15 @@ define_flag("serve_nonfinite", "error", "serving: 'error' fails requests "
             "whose outputs contain NaN/Inf (counts toward the breaker); "
             "'allow' passes them through",
             validator=lambda v: v in ("error", "allow"))
+define_flag("serve_continuous", False, "serving: continuous slot-based "
+            "batching for generation backends — finished requests' decode "
+            "slots are recycled to queued requests between fused steps "
+            "(docs/serving.md); bucket mode stays the default for one-shot "
+            "forwards and AOT-unrollable deploys")
+define_flag("serve_slots", 8, "serving: decode slot capacity of the "
+            "continuous-batching table (each slot holds one request's "
+            "beams; also the admission row bound in generation mode)",
+            validator=lambda v: v >= 1)
 
 # Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
 define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")

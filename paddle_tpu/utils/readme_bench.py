@@ -43,6 +43,7 @@ _UNITS = [
     ("alexnet", "ms/batch"),
     ("googlenet", "ms/batch"),
     ("pallas_", "ms (best variant)"),
+    ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
 ]
 
 

@@ -25,7 +25,7 @@ def small_mnist(monkeypatch):
             ("job", "config", "num_passes", "save_dir", "start_pass",
              "test_pass", "time_batches", "log_period", "serve_bundle",
              "serve_smoke", "serve_max_batch", "serve_deadline_ms",
-             "serve_preflight")}
+             "serve_preflight", "serve_continuous", "serve_slots")}
     yield
     for k, v in keep.items():
         setattr(FLAGS, k, v)
@@ -109,6 +109,36 @@ def test_cli_serve_smoke_roundtrip(tmp_path, capsys):
     assert last["breaker"]["state"] == "closed"
 
 
+def test_cli_serve_continuous_smoke_zero_silent_drops(capsys):
+    """`serve --serve_continuous --serve_smoke=N`: N mixed-length
+    requests (short budgets + full-max_len stragglers) through the
+    continuous slot path; exit 0 only when every request resolved and
+    none failed — the CI self-test of the recycle loop."""
+    rc = main(["serve", "--serve_continuous", "--serve_smoke=11",
+               "--serve_slots=3", "--serve_deadline_ms=60000"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    import json
+
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["ready"] is True and first["mode"] == "generation"
+    assert last["counters"]["completed"] == 11
+    assert last["counters"]["worker_crashed"] == 0
+    # slots were recycled (11 requests through a 3-slot table) and the
+    # health surface carries the occupancy/recycle signals
+    assert last["slots"]["capacity"] == 3
+    assert last["slots"]["recycled"] == 11
+    assert last["mean_slot_occupancy"] is not None
+
+
+def test_cli_serve_continuous_requires_smoke():
+    """Bundle-based continuous serving is not wired (bundles carry no
+    generation head): --serve_continuous without --serve_smoke must fail
+    fast with the pointer to the in-process API, never half-serve."""
+    with pytest.raises(ConfigError, match="serve_continuous|smoke"):
+        main(["serve", "--serve_continuous"])
+
+
 def test_cli_serve_requires_bundle_and_rejects_corrupt(tmp_path):
     from paddle_tpu.config.deploy import BundleCorruptError
 
@@ -143,7 +173,8 @@ def test_cli_help_lists_serve_flags(capsys):
     assert "python -m paddle_tpu serve" in out
     for flag in ("--serve_bundle", "--serve_max_batch", "--serve_queue_depth",
                  "--serve_deadline_ms", "--serve_breaker_threshold",
-                 "--serve_preflight", "--serve_smoke"):
+                 "--serve_preflight", "--serve_smoke", "--serve_continuous",
+                 "--serve_slots"):
         assert flag in out, flag
 
 

@@ -1,0 +1,538 @@
+"""Continuous batching: slot-based in-flight sequence scheduling
+(paddle_tpu/serving/slots.py + ops/decode.py decode_step; docs/serving.md).
+
+The acceptance bar:
+
+- **bit-identity** — every request's tokens AND scores are bit-identical
+  to a solo ``beam_decode`` run of that request, regardless of admission
+  order, slot reuse, neighbors, or capacity (down to the 1-slot
+  degenerate table);
+- **no hostage** — short requests admitted alongside a chaos
+  ``straggler_request`` (adversarial never-EOS, decodes to full max_len)
+  complete within their deadlines and BEFORE the straggler — the exact
+  scenario lock-step bucket batching cannot serve;
+- **deadline eviction** — a resident request whose deadline expires
+  mid-generation is evicted typed (``DeadlineExceeded``) and its slot
+  recycled;
+- **fault isolation** — a NaN-poisoned request fails typed while
+  co-resident requests stay bit-identical (rows are independent in the
+  slot table); a worker kill mid-step fails residents typed, the
+  relaunched worker starts from a FRESH table and serves correctly;
+- **pad-row hygiene** — ``merge_feeds``' replication padding never
+  occupies a slot or surfaces as a harvested result (true-row-count
+  satellite).
+
+Every test runs under a hard ``signal.alarm``, like test_serving.py.
+"""
+
+import signal
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops as O
+from paddle_tpu.ops.decode import LogitsReadout, beam_decode
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import (DeadlineExceeded, InferenceFailed,
+                                InferenceServer, ServingError, SlotBackend,
+                                SlotScheduler, WorkerCrashed,
+                                audit_slot_backend)
+from paddle_tpu.serving.batching import (Request, ServingFuture,
+                                         canonicalize_feed, merge_feeds)
+
+HARD_TIMEOUT_S = 120
+
+V, H, K = 12, 8, 3
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout():
+    def _abort(signum, frame):
+        raise RuntimeError(f"slot test exceeded {HARD_TIMEOUT_S}s")
+
+    prev = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(HARD_TIMEOUT_S)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, prev)
+
+
+class ToyLM(SlotBackend):
+    """EOS-prone GRU LM behind the slot protocol.  The per-request state
+    is the GRU carry plus an EOS-logit bias read from the feed — the
+    ``chaos.straggler_request`` convention (bias -1e9 = never-EOS)."""
+
+    beam_size, vocab_size, bos, eos = K, V, 0, 1
+    length_penalty = 0.0
+    use_kernel = None
+
+    def __init__(self, rng, *, max_len=10, eos_boost=3.0):
+        self.max_len = max_len
+        self.p = {
+            "emb": jnp.asarray(0.5 * rng.randn(V, H).astype(np.float32)),
+            "wx": jnp.asarray(0.5 * rng.randn(H, 3 * H).astype(np.float32)),
+            "wh": jnp.asarray(0.5 * rng.randn(H, 3 * H).astype(np.float32)),
+            "out": jnp.asarray(rng.randn(H, V).astype(np.float32)),
+            "outb": jnp.asarray(
+                np.eye(1, V, 1)[0].astype(np.float32) * eos_boost),
+        }
+        self.readout = LogitsReadout()
+
+    def prefill(self, feed):
+        return {"h": jnp.asarray(feed["h"], jnp.float32),
+                "bias": jnp.asarray(feed["eos_bias"], jnp.float32)}
+
+    def step_fn(self, tokens, state):
+        e = jnp.take(self.p["emb"], tokens, axis=0)
+        h2 = O.gru_step(O.linear(e, self.p["wx"]), state["h"], self.p["wh"])
+        logits = O.linear(h2, self.p["out"], self.p["outb"])
+        logits = logits.at[:, self.eos].add(state["bias"][:, 0])
+        return logits, dict(state, h=h2)
+
+    def example_feed(self, rows=1):
+        return {"h": np.zeros((rows, H), np.float32),
+                "eos_bias": np.zeros((rows, 1), np.float32)}
+
+
+def _feed(rng, rows=1, bias=0.0):
+    f = {"h": rng.randn(rows, H).astype(np.float32),
+         "eos_bias": np.full((rows, 1), bias, np.float32)}
+    return f
+
+
+def _request(feed, *, max_len=None, deadline=None, t_submit=0.0):
+    canon, rows, sig = canonicalize_feed(feed)
+    return Request(feed=canon, rows=rows, signature=sig,
+                   future=ServingFuture(), deadline=deadline,
+                   t_submit=t_submit, max_len=max_len)
+
+
+def _solo(backend, feed, max_len):
+    """The oracle: the SAME request through the whole-batch engine."""
+    state0 = backend.prefill(feed)
+    toks, scores = beam_decode(
+        backend.step_fn, backend.readout, state0,
+        batch_size=int(np.asarray(feed["h"]).shape[0]),
+        beam_size=backend.beam_size, vocab_size=backend.vocab_size,
+        max_len=max_len, bos=backend.bos, eos=backend.eos)
+    return np.asarray(toks), np.asarray(scores)
+
+
+def _drain(sched, entries):
+    """Drive a raw scheduler until every admitted request harvests;
+    ``entries`` maps id(request) -> request.  Returns id -> outputs."""
+    results = {}
+    while sched.occupied() or len(results) < len(entries):
+        for req, out, _steps in sched.harvest():
+            results[id(req)] = out
+        if sched.occupied():
+            sched.step()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# bit-identity through slot recycling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", ["forward", "reversed"],
+                         ids=["admit_in_order", "admit_reversed"])
+def test_slot_outputs_bit_identical_to_solo_any_admission_order(rng, order):
+    """Every request's tokens/scores must equal a solo beam_decode run
+    BIT-FOR-BIT no matter which slots it lands in, which requests it
+    shares the table with, or in which order requests are admitted —
+    row-independence is the whole correctness argument of the design."""
+    be = ToyLM(rng, max_len=10)
+    feeds = [_feed(rng) for _ in range(5)]
+    limits = [6, 10, 4, 10, 7]
+    feeds[1] = chaos.straggler_request(feeds[1])    # never-EOS resident
+    reqs = [_request(f, max_len=l) for f, l in zip(feeds, limits)]
+    if order == "reversed":
+        reqs, feeds, limits = reqs[::-1], feeds[::-1], limits[::-1]
+
+    sched = SlotScheduler(be, slots=2)
+    results = {}
+    pending = list(reqs)
+    while pending or sched.occupied():
+        for req, out, _ in sched.harvest():
+            results[id(req)] = out
+        while pending and sched.free_count() >= pending[0].rows:
+            sched.admit([pending.pop(0)])
+        if sched.occupied():
+            sched.step()
+
+    assert len(results) == len(reqs)
+    for req, feed, limit in zip(reqs, feeds, limits):
+        solo_t, solo_s = _solo(be, feed, limit)
+        got = results[id(req)]
+        np.testing.assert_array_equal(got["tokens"], solo_t)
+        np.testing.assert_array_equal(got["scores"], solo_s)
+    # capacity 2 served 5 requests: slots were recycled, not grown
+    assert sched.recycled == len(reqs)
+    assert sched.free_count() == 2
+
+
+def test_capacity_one_degenerate_table(rng):
+    """S=1: pure sequential recycling — still bit-identical, still every
+    request served."""
+    be = ToyLM(rng, max_len=8)
+    feeds = [_feed(rng) for _ in range(4)]
+    reqs = [_request(f, max_len=8) for f in feeds]
+    sched = SlotScheduler(be, slots=1)
+    results = {}
+    pending = list(reqs)
+    while pending or sched.occupied():
+        for req, out, _ in sched.harvest():
+            results[id(req)] = out
+        if pending and sched.free_count():
+            sched.admit([pending.pop(0)])
+        if sched.occupied():
+            sched.step()
+    for req, feed in zip(reqs, feeds):
+        solo_t, solo_s = _solo(be, feed, 8)
+        np.testing.assert_array_equal(results[id(req)]["tokens"], solo_t)
+        np.testing.assert_array_equal(results[id(req)]["scores"], solo_s)
+    assert sched.recycled == 4
+
+
+def test_multirow_request_spans_slots_and_pad_rows_never_surface(rng):
+    """A 3-row request occupies 3 slots; merge_feeds pads the prefill
+    batch to the 4-bucket by replicating the last row — the replica must
+    NEVER occupy a slot or appear in the harvested outputs (the
+    true-row-count satellite)."""
+    be = ToyLM(rng, max_len=6)
+    feed = _feed(rng, rows=3)
+    req = _request(feed, max_len=6)
+    merged, slices, rows = merge_feeds([req], 4)
+    assert rows == 3 and slices == [(0, 3)]
+    assert np.asarray(merged["h"]).shape[0] == 4          # padded bucket
+    np.testing.assert_array_equal(merged["h"][3], merged["h"][2])  # replica
+
+    sched = SlotScheduler(be, slots=4)
+    sched.admit([req])
+    assert sched.occupied() == 3          # the pad row took no slot
+    results = _drain(sched, {id(req): req})
+    out = results[id(req)]
+    assert out["tokens"].shape == (3, K, 6)   # 3 real rows, no replica
+    solo_t, solo_s = _solo(be, feed, 6)
+    np.testing.assert_array_equal(out["tokens"], solo_t)
+    np.testing.assert_array_equal(out["scores"], solo_s)
+
+
+# ---------------------------------------------------------------------------
+# the hostage scenario (chaos straggler) + deadline eviction
+# ---------------------------------------------------------------------------
+
+
+def _gen_server(be, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("batch_delay_ms", 0.0)
+    kw.setdefault("max_queue", 32)
+    kw.setdefault("default_deadline_ms", 60000.0)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("max_restart_backoff_s", 0.05)
+    return InferenceServer(be, mode="generation", **kw)
+
+
+def test_straggler_request_does_not_hostage_short_requests(rng):
+    """THE tentpole scenario: an adversarial never-EOS request decoding
+    to the full table depth shares the table with short EOS-prone
+    requests.  The shorts must (a) succeed within their deadlines —
+    deadline honesty converts late replies to DeadlineExceeded, so a None
+    error IS proof — and (b) complete while the straggler is still
+    decoding.  Under lock-step bucket batching every one of them would
+    wait the straggler's full max_len."""
+    be = ToyLM(rng, max_len=200, eos_boost=8.0)   # shorts finish in ~1 step
+    srv = _gen_server(be, slots=3)
+    srv.start()
+    with srv:
+        done_at = {}
+
+        straggler = chaos.straggler_request(_feed(rng))
+        f_strag = srv.submit(straggler, deadline_ms=120000.0)
+        shorts = [srv.submit(_feed(rng), deadline_ms=15000.0)
+                  for _ in range(6)]
+        for i, f in enumerate(shorts):
+            assert f.error(60) is None, f"short {i} missed its deadline"
+            done_at[i] = time.monotonic()
+        t_shorts_done = max(done_at.values())
+        assert not f_strag.done(), \
+            "straggler finished before the shorts — not a straggler"
+        assert f_strag.error(120) is None
+        t_straggler_done = time.monotonic()
+        assert t_shorts_done < t_straggler_done
+        out = f_strag.result(0)
+        # never-EOS: decoded to the FULL table depth, no EOS anywhere
+        assert out["tokens"].shape == (1, K, 200)
+        assert not np.any(out["tokens"] == be.eos)
+        hz = srv.healthz()
+    assert hz["counters"]["completed"] == 7
+    assert hz["counters"]["slot_evicted"] == 0
+    assert hz["slots"]["recycled"] >= 7
+
+
+def test_deadline_expired_slot_evicted_mid_generation(rng):
+    """A resident whose deadline passes mid-decode is evicted typed and
+    its slot recycled to waiting work."""
+    be = ToyLM(rng, max_len=5000)
+    srv = _gen_server(be, slots=1)
+    srv.start()
+    with srv:
+        strag = chaos.straggler_request(_feed(rng))
+        f = srv.submit(strag, deadline_ms=30.0)     # expires mid-decode
+        err = f.error(60)
+        assert isinstance(err, DeadlineExceeded), err
+        assert "mid-generation" in str(err)
+        # the slot came back: an EOS-prone short is served after eviction
+        ok = srv.submit(_feed(rng), max_len=4, deadline_ms=60000.0)
+        assert ok.error(60) is None
+        hz = srv.healthz()
+    assert hz["counters"]["slot_evicted"] == 1
+    assert hz["counters"]["completed"] == 1
+
+
+def test_scheduler_evict_expired_releases_all_rows(rng):
+    """Unit-level eviction: a 2-row resident expires -> BOTH slots free,
+    the request reported exactly once."""
+    be = ToyLM(rng, max_len=50)
+    sched = SlotScheduler(be, slots=4, clock=lambda: 100.0)
+    req = _request(chaos.straggler_request(_feed(rng, rows=2)),
+                   deadline=100.5)
+    sched.admit([req])
+    sched.step()
+    assert sched.occupied() == 2
+    assert sched.evict_expired(100.4) == []       # not expired yet
+    evicted = sched.evict_expired(101.0)
+    # reported once, with the count of slots actually freed
+    assert len(evicted) == 1 and evicted[0][0] is req and evicted[0][1] == 2
+    assert sched.occupied() == 0 and sched.free_count() == 4
+    assert sched.evict_expired(102.0) == []       # idempotent
+
+
+# ---------------------------------------------------------------------------
+# fault isolation: NaN poison, worker kill, step failure
+# ---------------------------------------------------------------------------
+
+
+def test_expired_queued_request_swept_while_table_full(rng):
+    """The deadline sweep must keep running when zero slots are free:
+    a queued request whose deadline passes behind a table-monopolizing
+    straggler is failed typed promptly — it must not squat in the bounded
+    queue until a slot frees (shedding live traffic meanwhile)."""
+    be = ToyLM(rng, max_len=2000)
+    srv = _gen_server(be, slots=1)
+    srv.start()
+    with srv:
+        f_strag = srv.submit(chaos.straggler_request(_feed(rng)),
+                             deadline_ms=120000.0)
+        f_queued = srv.submit(_feed(rng), deadline_ms=50.0)
+        err = f_queued.error(10)
+        assert isinstance(err, DeadlineExceeded), err
+        assert "queued" in str(err)
+        # swept while the straggler still holds the table, not after
+        assert not f_strag.done()
+        assert srv.healthz()["counters"]["slot_evicted"] == 0
+        assert f_strag.error(120) is None
+
+
+def test_overlong_source_rejected_typed_without_feeding_breaker(rng):
+    """A source longer than the slot table's fixed src_len is a CLIENT
+    bug: the reply is InvalidRequestError and the breaker stays
+    untouched — a retrying misbehaving client must not trip it and take
+    down healthy traffic."""
+    import jax
+
+    from paddle_tpu.models import Seq2SeqAttention
+    from paddle_tpu.serving import InvalidRequestError, Seq2SeqSlotBackend
+
+    m = Seq2SeqAttention(src_vocab=64, trg_vocab=64, emb_dim=8, enc_dim=8,
+                         dec_dim=8, att_dim=8)
+    params = m.init(jax.random.PRNGKey(0))
+    # a table narrower than the smallest feeder bucket can never admit
+    # canonicalized traffic: rejected at construction, not at serve time
+    with pytest.raises(ValueError, match="feeder bucket"):
+        Seq2SeqSlotBackend(m, params, src_len=4, beam_size=2, max_len=3)
+    be = Seq2SeqSlotBackend(m, params, src_len=8, beam_size=2, max_len=3)
+    srv = _gen_server(be, slots=1, breaker_threshold=2)
+    srv.start()
+    with srv:
+        def src_feed(t):
+            return {"src": (np.full((1, t), 3, np.int32),
+                            np.asarray([t], np.int32))}
+
+        for _ in range(3):          # would trip threshold=2 if breaker-fed
+            err = srv.submit(src_feed(9)).error(60)   # buckets to T=16 > 8
+            assert isinstance(err, InvalidRequestError), err
+            assert "src_len" in str(err)
+        assert srv.breaker.snapshot()["consecutive_failures"] == 0
+        assert srv.breaker.state == "closed"
+        assert srv.submit(src_feed(6)).error(60) is None   # healthy traffic
+    assert srv.metrics.count("invalid_request") == 3
+    assert srv.metrics.count("completed") == 1
+
+
+def test_nan_poisoned_request_isolated_to_its_own_slot(rng):
+    """Rows are independent in the slot table: a NaN-poisoned request
+    fails typed while a co-resident healthy request stays bit-identical
+    to its solo run — the poison never crosses slots."""
+    be = ToyLM(rng, max_len=6)
+    srv = _gen_server(be, slots=4)
+    srv.start()
+    with srv:
+        healthy_feed = _feed(rng)
+        f_bad = srv.submit(chaos.nan_feed(_feed(rng)), max_len=6)
+        f_ok = srv.submit(healthy_feed, max_len=6)
+        err = f_bad.error(60)
+        assert isinstance(err, InferenceFailed) and "non-finite" in str(err)
+        assert f_ok.error(60) is None
+        solo_t, solo_s = _solo(be, healthy_feed, 6)
+        out = f_ok.result(0)
+        np.testing.assert_array_equal(out["tokens"], solo_t)
+        np.testing.assert_array_equal(out["scores"], solo_s)
+        assert srv.metrics.count("inference_failed") == 1
+
+
+def test_worker_kill_mid_step_resets_table_and_recovers(rng):
+    """chaos.kill_worker with residents decoding: the residents fail
+    typed WorkerCrashed (never silently dropped), the relaunched worker
+    starts from a FRESH table, and post-restart requests are served
+    bit-identical."""
+    be = ToyLM(rng, max_len=50)
+    srv = _gen_server(be, slots=2, max_restarts=3)
+    srv.start()
+    with srv:
+        chaos.kill_worker(srv)
+        f = srv.submit(chaos.straggler_request(_feed(rng)))
+        err = f.error(60)
+        assert isinstance(err, WorkerCrashed), err
+        assert srv.metrics.count("worker_crashed") >= 1
+        deadline = time.monotonic() + 10
+        while not srv.supervisor.alive() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.supervisor.alive()
+        feed = _feed(rng)
+        f2 = srv.submit(feed, max_len=5)
+        assert f2.error(60) is None
+        solo_t, solo_s = _solo(be, feed, 5)
+        np.testing.assert_array_equal(f2.result(0)["tokens"], solo_t)
+        np.testing.assert_array_equal(f2.result(0)["scores"], solo_s)
+        # the fresh table is empty apart from what it served
+        assert srv.healthz()["slots"]["occupied"] == 0
+
+
+def test_hung_admit_fails_popped_batch_typed_and_replaces_worker(rng):
+    """A worker wedged inside admission (the device-bound prefill) holds
+    a popped batch that is not yet resident: hang detection must fail
+    THOSE futures typed too (they join the in-flight set before admit),
+    the woken stale worker must not write into the fresh table (admit's
+    commit guard), and the replacement worker must serve correctly."""
+    import threading
+
+    release = threading.Event()
+    woke = threading.Event()
+    hang_now = [False]
+    be = ToyLM(rng, max_len=8)
+    srv = _gen_server(be, slots=2, hang_timeout_s=0.1,
+                      restart_backoff_s=0.01)
+    srv.start()
+    orig_admit = srv._scheduler.admit
+
+    def hanging_admit(reqs, **kw):
+        if hang_now[0]:
+            hang_now[0] = False
+            release.wait(30)          # the device-wedge model
+            woke.set()
+        return orig_admit(reqs, **kw)
+
+    srv._scheduler.admit = hanging_admit
+    with srv:
+        hang_now[0] = True
+        f = srv.submit(_feed(rng), max_len=4)
+        err = f.error(60)
+        assert isinstance(err, WorkerCrashed) and "hung" in str(err), err
+        deadline = time.monotonic() + 10
+        while not srv.supervisor.alive() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        release.set()                 # the abandoned thread wakes...
+        assert woke.wait(10)
+        time.sleep(0.05)              # ...and admit discards its write
+        feed = _feed(rng)
+        f2 = srv.submit(feed, max_len=4)
+        assert f2.error(60) is None
+        solo_t, _ = _solo(be, feed, 4)
+        np.testing.assert_array_equal(f2.result(0)["tokens"], solo_t)
+        hz = srv.healthz()
+        assert hz["slots"]["occupied"] == 0
+        # the hung batch's request never became resident anywhere
+        assert hz["counters"]["worker_crashed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission plumbing: degradation ladder, oversized, audit, healthz
+# ---------------------------------------------------------------------------
+
+
+def test_degradation_ladder_caps_decode_budget(rng):
+    """Under queue pressure the generation ladder caps newly admitted
+    requests' max_len — shorter service instead of shedding."""
+    be = ToyLM(rng, max_len=64)
+    srv = _gen_server(be, slots=1, max_queue=16,
+                      degrade=[{"max_len": 2}], degrade_at=[2])
+    srv.start()
+    with srv:
+        stragglers = [srv.submit(chaos.straggler_request(_feed(rng)))
+                      for _ in range(8)]
+        outs = []
+        for f in stragglers:
+            err = f.error(120)
+            assert err is None or isinstance(err, ServingError)
+            if err is None:
+                outs.append(f.result(0)["tokens"].shape[2])
+        hz = srv.healthz()
+    # the ladder engaged: some requests were decoded at the capped budget
+    assert hz["counters"]["degraded"] > 0
+    assert any(l == 2 for l in outs), outs
+
+
+def test_oversized_and_overlong_requests_rejected_typed(rng):
+    from paddle_tpu.serving import InvalidRequestError
+
+    be = ToyLM(rng, max_len=8)
+    srv = _gen_server(be, slots=2)
+    srv.start()
+    with srv:
+        with pytest.raises(InvalidRequestError, match="split the request"):
+            srv.submit(_feed(rng, rows=3))      # rows > slots
+        with pytest.raises(InvalidRequestError, match="max_len"):
+            srv.submit(_feed(rng), max_len=9)   # beyond the table depth
+        with pytest.raises(InvalidRequestError, match="zero-row"):
+            srv.submit(_feed(rng, rows=0))
+        assert srv.submit(_feed(rng, rows=2), max_len=8).error(60) is None
+
+
+def test_slot_step_audit_is_error_free():
+    """The compiled decode_step closure must be host-transfer-free — the
+    lint --serve gate (audit_decode contract) and the generation-mode
+    preflight."""
+    findings = audit_slot_backend()
+    assert not [f for f in findings if f.severity == "ERROR"], findings
+
+
+def test_healthz_surfaces_slot_occupancy_and_recycling(rng):
+    be = ToyLM(rng, max_len=6)
+    srv = _gen_server(be, slots=2)
+    srv.start()
+    with srv:
+        for _ in range(4):
+            assert srv.submit(_feed(rng), max_len=4).error(60) is None
+        hz = srv.healthz()
+    assert hz["mode"] == "generation"
+    assert hz["slots"]["capacity"] == 2
+    assert hz["slots"]["admitted"] == 4
+    assert hz["slots"]["recycled"] == 4
+    assert hz["counters"]["gen_steps"] == hz["slots"]["steps"] > 0
+    assert hz["counters"]["slot_recycled"] == 4
+    assert 0 < hz["mean_slot_occupancy"] <= 1.0
+    assert hz["mean_request_steps"] is not None

@@ -927,6 +927,90 @@ def bench_serving_continuous_ab(rtt, peak):
     }
 
 
+def bench_sharded_embedding_ab(rtt, peak):
+    """A/B the pserver all-to-all sharded-embedding lookup
+    (paddle_tpu/pserver/lookup.py) vs the previous psum-of-zeros broadcast
+    on a large-vocab config over the full device mesh.  The psum variant
+    has every shard gather the FULL id set (zeros for foreign rows) and
+    all-reduce [N, D] — O(shards) redundant gather work; the all-to-all
+    exchanges one balanced [N] id hop + one [N, D] row hop.  Same table,
+    same ids, outputs asserted equal before timing, so the delta is pure
+    exchange strategy.  ``vs_baseline`` = psum_ms / a2a_ms (>1 = a2a
+    faster); there is no gating flag (the a2a IS the implementation —
+    ``sharded_embedding_lookup`` is a shim over it), so ``default_flag``
+    reports True.  NOTE the CPU virtual mesh undersells the a2a: its
+    "collectives" are in-process memcpys, so the psum's O(shards)
+    redundant gathers cost nothing while the a2a pays real sort/bucket
+    work — judge the winner from a TPU driver capture, where the psum
+    moves shards x [N, D] over ICI."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import compat
+    from paddle_tpu.pserver import all_to_all_lookup
+    from paddle_tpu.utils.devices import make_mesh
+
+    n_dev = len(jax.devices())
+    shards = 8 if n_dev >= 8 else n_dev
+    V, D, N = 1 << 16, 64, 8192
+    mesh = make_mesh((shards,), ("model",))
+    rs = np.random.RandomState(0)
+    table = jax.device_put(
+        jnp.asarray(rs.randn(V, D).astype(np.float32)),
+        jax.sharding.NamedSharding(mesh, P("model", None)))
+    ids = jnp.asarray(rs.randint(0, V, (N,)), jnp.int32)
+
+    def psum_body(shard, ids, *, axis):
+        idx = lax.axis_index(axis)
+        vs = shard.shape[0]
+        local = ids - idx * vs
+        inb = (local >= 0) & (local < vs)
+        rows = jnp.take(shard, jnp.clip(local, 0, vs - 1), axis=0)
+        return lax.psum(rows * inb[..., None].astype(rows.dtype), axis)
+
+    psum_fn = jax.jit(compat.shard_map(
+        functools.partial(psum_body, axis="model"), mesh=mesh,
+        in_specs=(P("model", None), P()), out_specs=P(), check_vma=False))
+    a2a_fn = jax.jit(
+        lambda t, i: all_to_all_lookup(mesh, t, i, axis="model"))
+
+    ref = jax.block_until_ready(psum_fn(table, ids))
+    out = jax.block_until_ready(a2a_fn(table, ids))
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+    def timeit(fn, reps=20):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(table, ids))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    psum_s = timeit(psum_fn)
+    a2a_s = timeit(a2a_fn)
+    if a2a_s < 0.95 * psum_s:
+        winner = "a2a"
+    elif psum_s < 0.95 * a2a_s:
+        winner = "psum"
+    else:
+        winner = "tie"
+    return {
+        "metric": f"sharded_embedding_ab_ms(V{V},D{D},N{N},S{shards})",
+        "short": "sharded_embedding_ab",
+        "value": round(a2a_s * 1e3, 3),
+        "unit": "ms",
+        "mfu": None,
+        "vs_baseline": round(psum_s / a2a_s, 3),
+        "psum_ms": round(psum_s * 1e3, 3),
+        "winner": winner,
+        "default_flag": True,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -971,6 +1055,7 @@ def main() -> None:
         safe(bench_pallas_lstm_ab),
         safe(bench_pallas_decode_ab),
         safe(bench_serving_continuous_ab),
+        safe(bench_sharded_embedding_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

@@ -17,7 +17,8 @@ from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
                                             hlo_control_flow, walk_eqns)
 from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
                                              audit_decode, audit_fn,
-                                             audit_jaxpr)
+                                             audit_jaxpr,
+                                             audit_no_dense_rows)
 from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
                                           lint_source)
 
@@ -36,6 +37,7 @@ __all__ = [
     "audit_jaxpr",
     "audit_fn",
     "audit_decode",
+    "audit_no_dense_rows",
     "DECODE_CHECKS",
     "JAXPR_CHECKS",
     "AST_CHECKS",

@@ -7,6 +7,7 @@ Usage:
     python -m paddle_tpu lint --config conf.py --allowlist .tpu-lint-allow
     python -m paddle_tpu lint --decode B,S,K,L
     python -m paddle_tpu lint --serve model.ptz
+    python -m paddle_tpu lint --pserver V,D,N,S
 
 ``--path DIR`` runs the AST trace-safety linter over the tree;
 ``--config CONF.py`` additionally builds the config's trainer and audits
@@ -23,6 +24,13 @@ closure (the slot-table fused step, serving/slots.py) with the decode
 check set — a host transfer there fires once per token per resident
 request, the same contract as ``audit_decode``; both readout variants
 are traced (the kernel in interpret mode off-TPU).
+
+``--pserver [V,D,N,S]`` audits the sharded-embedding tier's compiled
+all-to-all lookup and row-sparse apply closures (paddle_tpu/pserver) with
+the serving check set, and additionally asserts the "never densify"
+contract: no ``[V, D]``-shaped gradient or optimizer temp may appear in
+the sparse-apply jaxpr, and no broadcast may conjure a per-shard dense
+buffer (``analysis.audit_no_dense_rows``).
 
 ``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
 generation path (Seq2SeqAttention.beam_search over the fused decode
@@ -186,6 +194,10 @@ def run(argv: Optional[List[str]] = None) -> int:
                    metavar="B,S,K,L",
                    help="audit the flagship fused-decode closure "
                         "(kernel + XLA-fallback variants) at these shapes")
+    p.add_argument("--pserver", nargs="?", const="", default=None,
+                   metavar="V,D,N,S",
+                   help="audit the pserver lookup/sparse-apply closures "
+                        "and gate the never-densify contract")
     p.add_argument("--serve", action="append", default=[],
                    metavar="BUNDLE.ptz",
                    help="serving preflight: audit a deploy bundle's "
@@ -202,7 +214,8 @@ def run(argv: Optional[List[str]] = None) -> int:
 
     targets = list(ns.path)
     configs = list(ns.config)
-    if not targets and not configs and ns.decode is None and not ns.serve:
+    if (not targets and not configs and ns.decode is None
+            and ns.pserver is None and not ns.serve):
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -219,6 +232,10 @@ def run(argv: Optional[List[str]] = None) -> int:
         findings.extend(_audit_config(conf))
     if ns.decode is not None:
         findings.extend(_audit_decode_closure(ns.decode))
+    if ns.pserver is not None:
+        from paddle_tpu.pserver import audit_pserver
+
+        findings.extend(audit_pserver(ns.pserver))
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
     if ns.serve:

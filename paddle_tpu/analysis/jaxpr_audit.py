@@ -41,8 +41,8 @@ import numpy as np
 from paddle_tpu.analysis.findings import Finding
 from paddle_tpu.analysis.jaxpr_walk import walk_eqns
 
-__all__ = ["audit_jaxpr", "audit_fn", "audit_decode", "DECODE_CHECKS",
-           "JAXPR_CHECKS", "CONSTANT_BLOAT_BYTES"]
+__all__ = ["audit_jaxpr", "audit_fn", "audit_decode", "audit_no_dense_rows",
+           "DECODE_CHECKS", "JAXPR_CHECKS", "CONSTANT_BLOAT_BYTES"]
 
 #: constants folded into the executable above this size are flagged
 CONSTANT_BLOAT_BYTES = 1 << 20
@@ -303,6 +303,60 @@ def audit_fn(fn: Callable, *args: Any, label: str = "step", mesh=None,
 #: and unsharded-op needs a training mesh to mean anything.)
 DECODE_CHECKS: Sequence[str] = ("host-transfer", "constant-bloat",
                                 "unaligned-pallas-tile")
+
+
+#: primitives that MATERIALIZE a fresh array (vs transform an existing one)
+#: — the ways a sparse program accidentally densifies a table
+_MATERIALIZE_PRIMS = ("broadcast_in_dim", "iota")
+
+#: container/routing primitives whose outvars merely CARRY operands through
+#: (the sharded table legitimately rides shard_map, the bad-step guard's
+#: cond, scans, jit boundaries).  Their BODIES are still walked — a
+#: densifying eqn inside is flagged on its own leaf primitive.
+_CARRIER_PRIMS = frozenset({
+    "shard_map", "cond", "while", "scan", "pjit", "xla_call", "core_call",
+    "closed_call", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "remat", "remat2",
+    "checkpoint", "custom_vjp_call_custom_transpose", "device_put",
+    "sharding_constraint", "optimization_barrier",
+})
+
+
+def audit_no_dense_rows(closed, *, full_rows: int,
+                        shard_rows: Optional[int] = None,
+                        label: str = "step") -> List[Finding]:
+    """The pserver "never densify" gate: ERROR on any equation that
+    produces a ``[V, ...]``-shaped value (``full_rows`` = the GLOBAL padded
+    vocab — under shard_map no legal per-shard value carries it), and on
+    any broadcast/iota that conjures a fresh ``[Vs, ...]`` per-shard dense
+    temp (``shard_rows``) — a zeros-of-table-shape gradient or optimizer
+    buffer.  Gathers/scatters ON the table shard itself are legal: they
+    transform the existing (donated) buffer rather than materialize a new
+    one."""
+    out: List[Finding] = []
+    for eqn, path in walk_eqns(closed.jaxpr, label):
+        prim = eqn.primitive.name
+        for v in eqn.outvars:
+            shape = getattr(getattr(v, "aval", None), "shape", ())
+            if len(shape) >= 2 and shape[0] == full_rows \
+                    and prim not in _CARRIER_PRIMS:
+                out.append(Finding(
+                    check="dense-table-temp", severity="ERROR", where=path,
+                    message=f"{eqn.primitive.name} materializes a "
+                            f"full-table value "
+                            f"{'x'.join(map(str, shape))} (vocab dim "
+                            f"{full_rows}) — the sparse path must never "
+                            f"densify the table"))
+            elif (shard_rows is not None and len(shape) >= 2
+                  and shape[0] == shard_rows
+                  and prim in _MATERIALIZE_PRIMS):
+                out.append(Finding(
+                    check="dense-table-temp", severity="ERROR", where=path,
+                    message=f"{eqn.primitive.name} conjures a per-shard "
+                            f"dense temp {'x'.join(map(str, shape))} "
+                            f"(shard rows {shard_rows}) — row-sparse "
+                            f"updates must stay O(touched-rows)"))
+    return out
 
 
 def audit_decode(fn: Callable, *args: Any, label: str = "decode",

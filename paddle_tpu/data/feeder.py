@@ -77,7 +77,10 @@ class DataFeeder:
         self.max_nnz = max_nnz
         self.dtype = dtype
         #: running count of sparse features dropped by max_len/max_nnz
-        #: truncation (logged whenever a batch drops any)
+        #: truncation (logged whenever a batch drops any).  Surfaced as an
+        #: observable, not just a log line: the trainer mirrors it into
+        #: ``_last_extras['dropped_features']`` each batch, and a serving
+        #: process that ``attach_feeder()``s reports it in ``healthz()``.
         self.dropped_features = 0
 
     def __call__(self, batch_rows: List[Tuple]) -> Dict[str, Any]:

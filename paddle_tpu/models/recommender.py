@@ -25,14 +25,22 @@ __all__ = ["movielens_net", "movielens_feature_net", "ML_SCHEMA"]
 
 def movielens_net(n_users: int = ML_SCHEMA["n_users"],
                   n_movies: int = ML_SCHEMA["n_movies"], *, emb_dim: int = 64,
-                  hid_dim: int = 64):
+                  hid_dim: int = 64, sparse_grad: bool = False):
     """Two embedding towers -> fc -> dot regression to rating. Returns
-    (cost, prediction)."""
+    (cost, prediction).
+
+    ``sparse_grad=True`` marks the id towers row-sparse — the
+    recommender-scale proving workload for the pserver tier: with a mesh
+    carrying the pserver axis, user/movie tables shard their (possibly
+    100M+-row) vocab across devices and train with all-to-all lookups and
+    row-sparse updates (docs/pserver.md)."""
     uid = nn.data("user_id", size=n_users, dtype="int32")
     mid = nn.data("movie_id", size=n_movies, dtype="int32")
     rating = nn.data("score", size=1)
-    u_emb = nn.embedding(uid, emb_dim, name="user_emb")
-    m_emb = nn.embedding(mid, emb_dim, name="movie_emb")
+    u_emb = nn.embedding(uid, emb_dim, name="user_emb",
+                         sparse_grad=sparse_grad)
+    m_emb = nn.embedding(mid, emb_dim, name="movie_emb",
+                         sparse_grad=sparse_grad)
     u_fc = nn.fc(u_emb, hid_dim, act="relu", name="user_fc")
     m_fc = nn.fc(m_emb, hid_dim, act="relu", name="movie_fc")
     both = nn.concat([u_fc, m_fc], name="towers")

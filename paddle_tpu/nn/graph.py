@@ -316,16 +316,26 @@ class Topology:
 
     # -- init ---------------------------------------------------------------
 
-    def init(self, rng: jax.Array, dtype=None) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-        """Create (params, state) pytrees."""
+    def init(self, rng: jax.Array, dtype=None,
+             skip: Sequence[str] = ()) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Create (params, state) pytrees.
+
+        ``skip`` names parameters NOT to materialize (the pserver tier's
+        hook: a mesh-sharded table must never exist dense on one host —
+        the tier creates it shard-locally instead).  Key assignment stays
+        identical either way: every spec still consumes its split, so the
+        remaining params init to the same values with or without skips."""
         from paddle_tpu.ops.numerics import param_dtype
 
         dtype = dtype or param_dtype()
+        skipped = set(skip)
         params: Dict[str, Any] = {}
         state: Dict[str, Any] = {}
         specs = sorted(self.param_specs.values(), key=lambda s: s.name)
         keys = jax.random.split(rng, max(len(specs), 1))
         for key, spec in zip(keys, specs):
+            if spec.name in skipped:
+                continue
             arr = spec.initializer()(key, spec.shape, dtype)
             (state if spec.is_state else params)[spec.name] = arr
         return params, state
@@ -342,9 +352,16 @@ class Topology:
         rng: Optional[jax.Array] = None,
         outputs: Optional[Sequence[str]] = None,
         device_specs: Optional[Dict[str, Any]] = None,
+        param_overrides: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Dict[str, Act], Dict[str, Any]]:
         """Run the graph. ``feed`` maps data-layer name -> Act | array |
         (value, lengths). Returns ({layer_name: Act}, new_state).
+
+        ``param_overrides`` substitutes parameter VALUES by name for this
+        apply — the pserver tier's hook: a sharded-table parameter is
+        removed from ``params`` and handed in here as a ``TableProxy``
+        (paddle_tpu/pserver/tier.py), so layers consume it without the
+        table ever entering the differentiated pytree.
 
         ``device_specs`` is the model-parallel pinning plane — the analog of
         the reference's per-layer ``device`` attribute dispatched by
@@ -355,7 +372,7 @@ class Topology:
         matching mesh shards instead of spawning per-device threads."""
         ctx = ApplyContext(train, rng)
         env: Dict[str, Act] = {}
-        all_params = {**params, **state}
+        all_params = {**params, **state, **(param_overrides or {})}
         want = set(outputs) if outputs is not None else None
         needed = self.layers if want is None else self._needed_layers(want)
         for layer in needed:

@@ -221,13 +221,24 @@ def fc(input: Union[LayerOutput, Sequence[LayerOutput]], size: int, *,
 
 def embedding(input: LayerOutput, size: int, *, vocab_size: Optional[int] = None,
               name: Optional[str] = None, param_attr: AttrLike = None,
-              padding_idx: Optional[int] = None) -> LayerOutput:
+              padding_idx: Optional[int] = None,
+              sparse_grad: bool = False) -> LayerOutput:
     """Embedding lookup — analog of embedding_layer (layers.py:1025; table
     projection + hl_table_apply). ``input`` must be an integer data layer;
-    its ``size`` is the vocabulary size unless ``vocab_size`` is given."""
+    its ``size`` is the vocabulary size unless ``vocab_size`` is given.
+
+    ``sparse_grad=True`` (the ``ParamAttr(sparse_grad=True)`` sugar) marks
+    the table row-sparse: single-host trainers use the masked sparse-rows
+    optimizer path, and a trainer with a pserver mesh axis routes the table
+    through the sharded pserver tier (paddle_tpu/pserver) — mesh-sharded
+    storage, all-to-all lookup, row-sparse updates that never densify."""
     name = name or next_name("embedding")
     V = vocab_size or input.size
     pa = _pa(param_attr, f"_{name}.w0", initial_std=0.01, init="normal")
+    if sparse_grad and not pa.sparse_grad:
+        from dataclasses import replace as _dc_replace
+
+        pa = _dc_replace(pa, sparse_grad=True)
     spec = ParamSpec(name=pa.name, shape=(V, size), attr=pa)
 
     def forward(ctx, params, a: Act) -> Act:
@@ -237,7 +248,14 @@ def embedding(input: LayerOutput, size: int, *, vocab_size: Optional[int] = None
             # is the per-row vector [B,D], not a length-1 sequence — squeeze
             # here so every consumer (expand, concat, fc, ...) sees [B,D]
             ids = ids[:, 0]
-        out = O.embedding_lookup(params[spec.name], ids, pad_to_zero_id=padding_idx)
+        table = params[spec.name]
+        if hasattr(table, "pserver_lookup"):
+            # pserver-routed: the trainer handed in a TableProxy — sharded
+            # all-to-all lookup, gradients via the proxy rows (tier.py)
+            out = table.pserver_lookup(ids, layer=name,
+                                       pad_to_zero_id=padding_idx)
+        else:
+            out = O.embedding_lookup(table, ids, pad_to_zero_id=padding_idx)
         if a.is_seq:
             out = out * a.mask[..., None].astype(out.dtype)
             return _seq_like(a, out)

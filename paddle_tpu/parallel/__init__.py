@@ -24,6 +24,7 @@ from paddle_tpu.parallel.pipeline import (
 )
 from paddle_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
 from paddle_tpu.parallel.embedding import sharded_embedding_lookup, shard_table
+from paddle_tpu.parallel.compat import axis_size, shard_map
 from paddle_tpu.parallel.distributed import (
     initialize_distributed,
     shutdown_distributed,

@@ -1,59 +1,61 @@
-"""Sharded embedding tables — the pserver sparse-row analog.
+"""Sharded embedding tables — the pserver sparse-row analog (compat shim).
 
 Reference: huge embedding tables live row-sharded on pservers; trainers
 prefetch only the rows a batch touches and push sparse row gradients back
 (paddle/math/SparseRowMatrix.h, trainer/RemoteParameterUpdater.h:265
 SparseRemoteParameterUpdater, MultiGradientMachine.h:99-166).
 
-TPU-native: the table is sharded across the 'model' mesh axis along the
-*vocab* dimension.  Lookup runs under shard_map: each device gathers the ids
-that fall in its shard (others contribute zeros) and a ``psum`` combines —
-one collective instead of a parameter-server round trip.  The backward pass
-(scatter-add into the local shard) is derived by autodiff through the same
-program, so gradients stay sharded — the row-sparse push analog.
+This module is now a thin compatibility surface over the full pserver tier
+(``paddle_tpu/pserver``): ``sharded_embedding_lookup`` delegates to the
+all-to-all exchange (``pserver.lookup.all_to_all_lookup``) — ids bucketed
+by owning shard, fixed-capacity all-to-all, local gather, payloads
+returned to the requesting rows — which replaces the previous
+psum-of-zeros broadcast that did O(shards) redundant gather work and
+reduced a replicated [N, D] output.  The signature, autodiff contract
+(gradients are row-sparse scatter-adds into the sharded table), and the
+``shard_table`` placement helper are unchanged for existing callers.
+
+``shard_table`` additionally honors the documented precondition instead of
+failing inside ``device_put``: a vocab that does not divide the mesh axis
+is padded up to a shard multiple with masked (zero) tail rows — or, with
+``pad=False``, raises a typed ``ConfigError`` naming the table.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["sharded_embedding_lookup", "shard_table"]
 
 
-def shard_table(mesh: Mesh, table, axis: str = "model"):
-    """Place a [V, D] table row-sharded over ``axis`` (V must divide evenly)."""
+def shard_table(mesh: Mesh, table, axis: str = "model", *,
+                pad: bool = True, name: str = "table"):
+    """Place a [V, D] table row-sharded over ``axis``.
+
+    V not dividing the axis size is padded up to a shard multiple with
+    zero tail rows (they can never be looked up: ids are < V) — or raises
+    a typed ``ConfigError`` naming the table when ``pad=False``."""
+    from paddle_tpu.pserver.table import pad_vocab
+
+    table = jnp.asarray(table)
+    n = int(mesh.shape[axis])
+    v = table.shape[0]
+    v_pad = pad_vocab(v, n, pad=pad, name=name)
+    if v_pad != v:
+        table = jnp.concatenate(
+            [table, jnp.zeros((v_pad - v,) + table.shape[1:], table.dtype)])
     return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
 
 
-def _local_lookup(table_shard, ids, *, axis_name: str):
-    """shard_map body: gather local rows, zero others, psum across shards."""
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    vshard = table_shard.shape[0]
-    lo = idx * vshard
-    local = ids - lo
-    in_range = (local >= 0) & (local < vshard)
-    safe = jnp.clip(local, 0, vshard - 1)
-    rows = jnp.take(table_shard, safe, axis=0)
-    rows = rows * in_range[..., None].astype(rows.dtype)
-    return lax.psum(rows, axis_name)
-
-
 def sharded_embedding_lookup(mesh: Mesh, table, ids, *, axis: str = "model"):
-    """table: [V, D] sharded P(axis, None); ids: replicated int array.
-    Returns replicated [ids.shape..., D] embeddings."""
-    fn = functools.partial(_local_lookup, axis_name=axis)
-    mapped = jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(P(axis, None), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return mapped(table, ids)
+    """table: [V_pad, D] sharded P(axis, None); ids: replicated int array.
+    Returns [ids.shape..., D] embeddings via the balanced all-to-all
+    exchange (see paddle_tpu/pserver/lookup.py).  Differentiable: the
+    table cotangent is the row-sparse scatter-add, kept sharded."""
+    from paddle_tpu.pserver.lookup import all_to_all_lookup
+
+    return all_to_all_lookup(mesh, table, ids, axis=axis)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel import compat
 from paddle_tpu.param.optimizers import Optimizer
 
 __all__ = ["stack_stage_params", "shard_stage_params", "pipeline_apply",
@@ -73,7 +74,7 @@ def _gpipe_local(stage_fn, w_stacked_local, x_mb, *, axis: str):
     the same tree with [M, mb, ...] outputs, psum-replicated over the
     stage axis."""
     tmap = jax.tree_util.tree_map
-    S = lax.axis_size(axis)
+    S = compat.axis_size(axis)
     sid = lax.axis_index(axis)
     w_local = tmap(lambda a: a[0], w_stacked_local)
     M = jax.tree_util.tree_leaves(x_mb)[0].shape[0]
@@ -141,7 +142,7 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
     x_mb = tmap(lambda a: a.reshape(M, B // M, *a.shape[1:]), x)
     mb_spec = P(None, data_axis) if data_axis else P()
     fn = functools.partial(_gpipe_local, stage_fn, axis=stage_axis)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         fn, mesh=mesh,
         in_specs=(P(stage_axis), mb_spec),
         out_specs=mb_spec,

@@ -232,12 +232,15 @@ class PipelinedTopology(Topology):
 
     # -- params ---------------------------------------------------------
 
-    def init(self, rng, dtype=None):
+    def init(self, rng, dtype=None, skip=()):
+        # skip (pserver routing) is accepted for Topology-signature parity;
+        # stage-stacked params are never routed, so it only affects
+        # head/tail layers
         saved = self.param_specs
         self.param_specs = self._flat_param_specs
         try:
             args = (rng,) if dtype is None else (rng, dtype)
-            params, state = Topology.init(self, *args)
+            params, state = Topology.init(self, *args, skip=skip)
         finally:
             self.param_specs = saved
         for name0, names in self.stage_param_names.items():
@@ -267,13 +270,16 @@ class PipelinedTopology(Topology):
             env[layer.name] = layer.forward(ctx, local, *parent_acts)
 
     def apply(self, params, state, feed, *, train=False, rng=None,
-              outputs=None, device_specs=None):
+              outputs=None, device_specs=None, param_overrides=None):
+        # param_overrides (the pserver TableProxy hook) is accepted for
+        # trainer-signature parity; pipelined stage layers consume plain
+        # arrays, so overrides only reach head/tail layers
         ctx = ApplyContext(train, rng)
         env: Dict[str, Act] = {}
         stage0 = self.stage_layers[0]
         stacked = {n: params[n] for n in self.stage_param_names}
         flat_state = dict(state)
-        all_params = {**params, **flat_state}
+        all_params = {**params, **flat_state, **(param_overrides or {})}
 
         self._run_layers(self.head_layers, env, all_params, ctx, feed)
 

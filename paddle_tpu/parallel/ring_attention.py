@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel import compat
+
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
@@ -51,7 +53,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None):
     """Inside-shard_map ring attention. q/k/v local blocks [B,H,Tl,D];
     sequence is sharded over ``axis_name``. Returns local output block."""
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
     scale = scale if scale is not None else (1.0 / (D ** 0.5))
@@ -93,7 +95,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     spec = P(None, None, seq_axis, None)
 
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
     )
     return mapped(q, k, v)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from paddle_tpu.utils.registry import Registry
 
 __all__ = [
+    "dedup_rows",
     "Optimizer",
     "SGD",
     "Momentum",
@@ -100,11 +101,51 @@ def clip_by_value(grads, threshold: float):
     return jax.tree_util.tree_map(lambda g: jnp.clip(g, -threshold, threshold), grads)
 
 
-def clip_by_global_norm(grads, max_norm: float):
+def clip_by_global_norm(grads, max_norm: float, extra_sq=0.0):
+    """``extra_sq`` joins additional sum-of-squares mass into the norm
+    without scaling it here — the pserver trainer passes the deduped
+    row-gradient mass of its routed tables so the clip decision sees the
+    SAME global norm the single-host dense path would, then scales the
+    row grads by the same factor itself."""
     leaves = jax.tree_util.tree_leaves(grads)
-    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves) + extra_sq)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
     return jax.tree_util.tree_map(lambda g: g * scale, grads), gnorm
+
+
+def _regularize(p, g, decay, l1):
+    """decay/l1 applied to the gradient (Regularizer analog) — shared by
+    the dense, masked, row-fast, and pserver sparse paths so they cannot
+    drift."""
+    if decay:
+        g = g + decay * p
+    if l1:
+        g = g + l1 * jnp.sign(p)
+    return g
+
+
+def dedup_rows(ids, row_grads, *, sentinel):
+    """Stable-sorted segment-sum of duplicate row ids.
+
+    Returns ``(uids [N] int32, ug [N, ...])``: unique ids packed to the
+    front (``sentinel`` in unused slots) with their duplicate-summed
+    gradients in the matching slots (zeros elsewhere).  The accumulation
+    order is the stable id sort — the SAME order as the dense path's
+    sorted scatter-add — and every consumer (the sparse apply, the
+    clip-norm row mass) shares THIS implementation so their sums cannot
+    drift apart bit-wise."""
+    n = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    order = jnp.argsort(ids, stable=True)
+    sids = ids[order]
+    sg = row_grads[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sids[1:] != sids[:-1]])
+    seg = jnp.cumsum(first) - 1                     # segment id per position
+    uids = jnp.full((n,), sentinel, jnp.int32).at[seg].set(sids)
+    ug = jnp.zeros((n,) + row_grads.shape[1:], row_grads.dtype)
+    ug = ug.at[seg].add(sg)                         # sorted segment-sum
+    return uids, ug
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +195,7 @@ class Optimizer:
         decays: Optional[Dict[str, float]] = None,
         statics: Optional[Dict[str, bool]] = None,
         sparse_rows: Optional[Dict[str, Any]] = None,  # bool mask path or int K
+        clip: bool = True,  # False: caller already applied global-norm clip
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """``sparse_rows`` marks row-sparse parameters (embedding tables with
         ParamAttr(sparse_grad=True)): rows a batch never touched keep their
@@ -180,22 +222,16 @@ class Optimizer:
         """
         step = opt_state["step"] + 1
         lr = self.lr_at(step)
-        if self.gradient_clipping_threshold > 0:
+        if self.gradient_clipping_threshold > 0 and clip:
             grads, _ = clip_by_global_norm(grads, self.gradient_clipping_threshold)
-        def _regularized(p, g):
-            """decay/l1 applied to the gradient (closes over per-leaf decay)."""
-            if decay:
-                g = g + decay * p
-            if self.l1_rate:
-                g = g + self.l1_rate * jnp.sign(p)
-            return g
 
         def _masked_update(p, g, old_slots, touched, lr_eff):
             """Full-tensor update with untouched rows held — the ONE masked
             path shared by sparse_rows=True and the K fast path's overflow
             fallback (they must stay identical)."""
-            p2, s2 = self.update_leaf(p, _regularized(p, g), old_slots,
-                                      lr_eff, step)
+            p2, s2 = self.update_leaf(
+                p, _regularize(p, g, decay, self.l1_rate), old_slots,
+                lr_eff, step)
             row = touched.reshape((-1,) + (1,) * (p.ndim - 1))
 
             def sel(new, old):
@@ -228,29 +264,13 @@ class Optimizer:
                 touched = jnp.any(g != 0, axis=tuple(range(1, p.ndim)))
 
                 def _fast(_, p=p, g=g, touched=touched, K=K,
-                          old_slots=old_slots, scale=scale):
+                          old_slots=old_slots, scale=scale, decay=decay):
                     live_score, rows = jax.lax.top_k(
                         touched.astype(jnp.float32), K)
-                    live = (live_score > 0).reshape(
-                        (-1,) + (1,) * (p.ndim - 1))
-                    p_r = p[rows]
-                    g_r = _regularized(p_r, g[rows])
-                    s_r = jax.tree_util.tree_map(
-                        lambda s: s[rows]
-                        if getattr(s, "shape", None) == p.shape else s,
-                        old_slots)
-                    p2_r, s2_r = self.update_leaf(p_r, g_r, s_r, lr * scale,
-                                                  step)
-                    p2_r = jnp.where(live, p2_r, p_r)
                     # top_k indices are distinct -> unique scatter
-                    np_ = p.at[rows].set(p2_r.astype(p.dtype),
-                                         unique_indices=True)
-                    ns_ = jax.tree_util.tree_map(
-                        lambda o, n2: o.at[rows].set(
-                            jnp.where(live, n2, o[rows]), unique_indices=True)
-                        if getattr(o, "shape", None) == p.shape else n2,
-                        old_slots, s2_r)
-                    return np_, ns_
+                    return self.row_apply(
+                        p, rows, g[rows], old_slots, live_score > 0,
+                        lr * scale, step, decay=decay)
 
                 def _overflow(_, p=p, g=g, touched=touched,
                               old_slots=old_slots, scale=scale):
@@ -268,11 +288,83 @@ class Optimizer:
                 new_params[k], new_slots[k] = _masked_update(
                     p, g, old_slots, touched, lr * scale)
                 continue
-            p2, s2 = self.update_leaf(p, _regularized(p, g), old_slots,
-                                      lr * scale, step)
+            p2, s2 = self.update_leaf(
+                p, _regularize(p, g, decay, self.l1_rate), old_slots,
+                lr * scale, step)
             new_params[k] = p2.astype(p.dtype)
             new_slots[k] = s2
         return new_params, {"step": step, "slots": new_slots}
+
+    # ------------------------------------------------------------------
+    # row-sparse kernels (the pserver push path + the K fast path's core)
+    # ------------------------------------------------------------------
+
+    def row_apply(self, p, rows, g_rows, old_slots, live, lr_eff, step, *,
+                  decay: float = 0.0, oob_drop: bool = False):
+        """THE shared gather-update-scatter row kernel: update ``rows`` of
+        ``p`` and its row-shaped slots in place with already-gathered row
+        gradients ``g_rows``; entries with ``live=False`` keep their value
+        AND slots (lazy regularization — untouched rows never advance).
+
+        ``rows`` must be distinct among live entries (callers: ``top_k``
+        indices, or the deduped unique-id buffer of ``sparse_apply_rows``).
+        ``oob_drop=True`` additionally drops out-of-range rows (the sparse
+        apply parks dead entries past the end) and fill-gathers so no
+        clamped garbage feeds ``update_leaf``.  O(K·D) reads/writes — the
+        SparseRowCpuMatrix locality argument on HBM bandwidth.
+        """
+        kw = dict(unique_indices=True)
+        if oob_drop:
+            kw["mode"] = "drop"
+
+            def gather(a):
+                return a.at[rows].get(mode="fill", fill_value=0)
+        else:
+            def gather(a):
+                return a[rows]
+
+        live_col = live.reshape((-1,) + (1,) * (p.ndim - 1))
+        p_r = gather(p)
+        g_r = _regularize(p_r, g_rows, decay, self.l1_rate)
+        s_r = jax.tree_util.tree_map(
+            lambda s: gather(s)
+            if getattr(s, "shape", None) == p.shape else s,
+            old_slots)
+        p2_r, s2_r = self.update_leaf(p_r, g_r, s_r, lr_eff, step)
+        p2_r = jnp.where(live_col, p2_r, p_r)
+        np_ = p.at[rows].set(p2_r.astype(p.dtype), **kw)
+        ns_ = jax.tree_util.tree_map(
+            lambda o, n2: o.at[rows].set(
+                jnp.where(live_col, n2, gather(o)), **kw)
+            if getattr(o, "shape", None) == p.shape else n2,
+            old_slots, s2_r)
+        return np_, ns_
+
+    def sparse_apply_rows(self, p, ids, row_grads, old_slots, *, lr_eff,
+                          step, decay: float = 0.0):
+        """Row-sparse apply from (ids, row-grads) segments — the pserver
+        gradient push (SparseRemoteParameterUpdater analog), and the sparse
+        half of the contract ``lint --pserver`` gates: nothing here is
+        [V, ...]-shaped except ``p`` and its slots themselves.
+
+        Duplicates are segment-summed in stable id-sorted order — the SAME
+        accumulation order as the sorted scatter-add in ops/embedding's
+        backward — so the result is bit-identical to the dense masked path
+        (``sparse_rows=True``) on the equivalent dense gradient.  Sentinel
+        ids ``>= p.shape[0]`` (all-to-all padding) and zero-grad segments
+        (masked/pad positions) are dropped: those rows and their slots do
+        not advance.
+        """
+        v = p.shape[0]
+        n = ids.shape[0]
+        uids, ug = dedup_rows(ids, row_grads, sentinel=v)
+        live = (uids < v) & jnp.any(
+            ug != 0, axis=tuple(range(1, ug.ndim)))
+        # dead entries park at distinct out-of-range rows: the scatter
+        # drops them while the unique_indices claim stays honest
+        rows = jnp.where(live, uids, v + jnp.arange(n, dtype=jnp.int32))
+        return self.row_apply(p, rows, ug, old_slots, live, lr_eff, step,
+                              decay=decay, oob_drop=True)
 
 
 @OPTIMIZERS.register("sgd")

@@ -1,0 +1,51 @@
+"""paddle_tpu.pserver — the parameter-server tier redone as TPU-native SPMD.
+
+Reference lineage: ``paddle/pserver`` holds huge embedding tables row-sharded
+across nodes; trainers prefetch only the rows a batch touches and push sparse
+row gradients back over sockets (``SparseRowMatrix``,
+``SparseRemoteParameterUpdater``, ``MultiGradientMachine``).  Here the same
+contract rides the mesh instead of a TCP fabric:
+
+- tables live **row-sharded across a mesh axis** and never materialize on one
+  host (``table.ShardedTable``; vocab padded to a shard multiple with masked
+  tail rows, per-shard deterministic RNG init);
+- the prefetch is an **all-to-all lookup** under shard_map (``lookup``): ids
+  are bucketed by owning shard on-device, exchanged with a fixed-capacity
+  all-to-all, gathered locally, and returned to the requesting rows — one
+  balanced exchange instead of the psum-of-zeros broadcast that did
+  O(shards) redundant work;
+- the gradient push is a **row-sparse optimizer update that never
+  densifies** (``apply.sharded_row_update`` over
+  ``Optimizer.sparse_apply_rows``): backward keeps (ids, row-grads)
+  segments, each shard receives only the segments it owns and
+  scatter-updates only the touched rows and their optimizer slots;
+- the serving read path is **incremental per-shard snapshots**
+  (``snapshot``): only rows dirty since the last snapshot are written,
+  CRC-manifested like resilience checkpoints, and a ``TableReader``
+  hot-reloads deltas into a serving process without a full dump;
+- a lost shard is just a rank failure: tables checkpoint with the trainer
+  state, so the PR-4 gang supervisor restores them from the manifest and
+  training replays the dirty rows (tests/test_pserver_gang.py).
+
+Trainer entry point: ``nn.embedding(..., sparse_grad=True)`` routes through
+this tier automatically when the trainer has a mesh with the pserver axis
+(``--pserver_axis``, default 'model').  docs/pserver.md has the full map.
+"""
+
+from paddle_tpu.pserver.table import (TableSpec, ShardedTable, pad_vocab,
+                                      init_shard_rows)
+from paddle_tpu.pserver.lookup import all_to_all_lookup, TableProxy
+from paddle_tpu.pserver.apply import sharded_row_update
+from paddle_tpu.pserver.tier import PServerTier
+from paddle_tpu.pserver.snapshot import (SnapshotError, TableReader,
+                                         latest_snapshot, load_table_host,
+                                         save_table_snapshot,
+                                         validate_snapshot)
+from paddle_tpu.pserver.audit import audit_pserver
+
+__all__ = [
+    "TableSpec", "ShardedTable", "pad_vocab", "init_shard_rows",
+    "all_to_all_lookup", "TableProxy", "sharded_row_update", "PServerTier",
+    "SnapshotError", "TableReader", "latest_snapshot", "load_table_host",
+    "save_table_snapshot", "validate_snapshot", "audit_pserver",
+]

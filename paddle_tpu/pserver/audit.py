@@ -1,0 +1,135 @@
+"""``python -m paddle_tpu lint --pserver`` — the tier's CI gate.
+
+Traces the compiled all-to-all lookup and the sharded sparse-apply
+closures at a compact flagship-shaped config and audits them with the
+jaxpr auditor's serving check set (host transfers, constant bloat, Pallas
+tiles), PLUS the tier-specific "never densify" assertion
+(``analysis.audit_no_dense_rows``): no ``[V, D]``-shaped gradient or
+optimizer temp may appear in the sparse-apply jaxpr, and no broadcast may
+conjure a per-shard dense buffer.  The shapes are chosen so every legal
+buffer size (requests N, per-shard rows Vs, bucket capacity) differs from
+the vocab dims the gate scans for.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.analysis.findings import Finding
+
+__all__ = ["audit_pserver"]
+
+_DEFAULTS = (4096, 32, 256, 4)   # V, D, N, shards
+
+
+def _mesh(shards: int):
+    """A shards-wide 1D mesh on real devices when available, else an
+    abstract mesh (tracing needs axis sizes, not silicon)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) >= shards:
+        return Mesh(np.asarray(devs[:shards]).reshape(shards), ("model",))
+    from jax.sharding import AbstractMesh
+
+    try:
+        return AbstractMesh((("model", shards),))
+    except TypeError:  # newer signature: (shape_tuple, axis_names)
+        return AbstractMesh((shards,), ("model",))
+
+
+def audit_pserver(spec: str = "") -> List[Finding]:
+    """``spec``: 'V,D,N,S' comma ints (defaults 4096,32,256,4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, audit_jaxpr,
+                                                 audit_no_dense_rows)
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.pserver.apply import sharded_row_update
+    from paddle_tpu.pserver.lookup import all_to_all_lookup
+    from paddle_tpu.pserver.table import pad_vocab
+
+    try:
+        dims = [int(x) for x in spec.split(",")] if spec else []
+    except ValueError:
+        return [Finding(
+            check="pserver-build", severity="ERROR", file="--pserver",
+            message=f"malformed --pserver spec {spec!r}: expected up to "
+                    f"four comma-separated ints 'V,D,N,S'")]
+    v, d, n_req, shards = (dims + list(_DEFAULTS)[len(dims):])[:4]
+    v_pad = pad_vocab(v, shards)
+    vs = v_pad // shards
+    # every leading dim of a buffer the closures legitimately materialize:
+    # the full/padded id list, the [S, per(, D)] exchange buckets, and the
+    # pad-tail concat — none may collide with a vocab dim or the
+    # dense-temp scan is ambiguous (a clean build would be flagged)
+    npad = (-n_req) % shards
+    n_tot = n_req + npad
+    per = n_tot // shards
+    fixed_dims = {n_req, n_tot, shards, per} | ({npad} if npad else set())
+    if fixed_dims & {v, v_pad, vs}:
+        return [Finding(
+            check="pserver-build", severity="ERROR", file="--pserver",
+            message=f"--pserver spec N={n_req},S={shards} collides with a "
+                    f"vocab dim (V={v}, V_pad={v_pad}, Vs={vs}): buffer "
+                    f"dims {sorted(fixed_dims)} must avoid vocab dims — "
+                    f"the dense-temp scan would be ambiguous; pick a "
+                    f"different N or S")]
+    try:
+        mesh = _mesh(shards)
+    except Exception as e:
+        return [Finding(
+            check="pserver-build", severity="ERROR", file="--pserver",
+            message=f"cannot build a {shards}-shard mesh: "
+                    f"{type(e).__name__}: {e}")]
+
+    opt = Adam(learning_rate=1e-3)
+    table = jax.ShapeDtypeStruct((v_pad, d), jnp.float32)
+    slots = (jax.ShapeDtypeStruct((v_pad, d), jnp.float32),
+             jax.ShapeDtypeStruct((v_pad, d), jnp.float32))
+    dirty = jax.ShapeDtypeStruct((v_pad,), jnp.bool_)
+    ids = jax.ShapeDtypeStruct((n_req,), jnp.int32)
+    grads = jax.ShapeDtypeStruct((n_req, d), jnp.float32)
+    step = jax.ShapeDtypeStruct((), jnp.int32)
+
+    findings: List[Finding] = []
+
+    def lookup_fn(t, i):
+        return all_to_all_lookup(mesh, t, i, axis="model")
+
+    def apply_fn(t, s, dt, i, g, st):
+        return sharded_row_update(
+            mesh, opt, t, s, dt, i, g, axis="model",
+            lr_eff=opt.lr_at(st + 1), step=st + 1, decay=1e-4)
+
+    try:
+        closed = jax.make_jaxpr(lookup_fn)(table, ids)
+        findings.extend(audit_jaxpr(closed, label="pserver:lookup",
+                                    checks=DECODE_CHECKS))
+    except Exception as e:
+        findings.append(Finding(
+            check="pserver-build", severity="ERROR", file="pserver[lookup]",
+            message=f"lookup closure failed to trace: "
+                    f"{type(e).__name__}: {e}"))
+    try:
+        closed = jax.make_jaxpr(apply_fn)(table, slots, dirty, ids, grads,
+                                          step)
+        findings.extend(audit_jaxpr(closed, label="pserver:apply",
+                                    checks=DECODE_CHECKS))
+        # the "never densify" gate proper: Vs-leading temps may only be
+        # transforms of the donated table/slot buffers, and NOTHING may
+        # carry the global vocab dim
+        findings.extend(audit_no_dense_rows(
+            closed, full_rows=v_pad, shard_rows=vs, label="pserver:apply"))
+        if v != v_pad:
+            findings.extend(audit_no_dense_rows(
+                closed, full_rows=v, label="pserver:apply"))
+    except Exception as e:
+        findings.append(Finding(
+            check="pserver-build", severity="ERROR", file="pserver[apply]",
+            message=f"sparse-apply closure failed to trace: "
+                    f"{type(e).__name__}: {e}"))
+    return findings

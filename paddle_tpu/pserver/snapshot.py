@@ -1,0 +1,292 @@
+"""Incremental per-shard table snapshots — the serving read path.
+
+A 100M-row table must export WITHOUT a full dump.  Exploiting the
+deterministic per-shard init (table.init_shard_rows): the base of every
+snapshot chain is the re-derivable init, and each ``snap-%05d`` directory
+stores only the rows DIRTY since the previous snapshot, one npz per shard
+(``shard-%03d.npz``: global row ``ids`` + row ``values``).  A reader
+reconstructs any point of the chain as
+
+    re-init from the manifest's TableSpec  +  replay snaps 0..k in order
+
+touching only the dirty rows of each delta.  Durability matches the
+resilience checkpoints (same discipline as resilience/checkpoint_io): the
+write lands in a dot-prefixed temp dir, every file is fsynced, one atomic
+rename publishes, and the manifest records a CRC32 per stored array — a
+corrupted shard file raises the typed ``SnapshotError`` naming the failing
+member, and the chain loader falls back to the newest snapshot that still
+validates.
+
+``TableReader`` is the serving-side consumer: it holds the reconstructed
+host table and ``hot_reload()`` applies only snapshots newer than what it
+already has — the rows a serving replica rewrites per reload are exactly
+the rows training touched since, not V.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+import uuid
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.resilience.checkpoint_io import (_fsync_dir, _fsync_file,
+                                                 npz_safe)
+from paddle_tpu.resilience.errors import CheckpointError
+from paddle_tpu.pserver.table import TableSpec, init_shard_rows
+from paddle_tpu.utils import logger
+
+__all__ = ["SnapshotError", "save_table_snapshot", "validate_snapshot",
+           "latest_snapshot", "load_table_host", "TableReader",
+           "snap_dir"]
+
+SNAPSHOT_VERSION = 1
+
+_SNAP_RE = re.compile(r"snap-(\d{5,})")
+_TMP_PREFIX = ".tmp-"
+
+
+class SnapshotError(CheckpointError):
+    """A table snapshot failed validation (missing/corrupt member)."""
+
+
+def snap_dir(save_dir: str, snap_id: int) -> str:
+    return os.path.join(save_dir, f"snap-{snap_id:05d}")
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def save_table_snapshot(save_dir: str, spec: TableSpec, data, dirty,
+                        snap_id: int, *, shards: int) -> str:
+    """Write ``snap-%05d`` atomically: per shard, ONLY the rows whose dirty
+    bit is set.  ``data`` [V_pad, D] (sharded or host), ``dirty`` bool
+    [V_pad].  Returns the published directory."""
+    os.makedirs(save_dir, exist_ok=True)
+    v_pad = int(data.shape[0])
+    vs = v_pad // shards
+    dirty_host = np.asarray(dirty)
+    final = snap_dir(save_dir, snap_id)
+    tmp = os.path.join(
+        save_dir, f"{_TMP_PREFIX}snap-{snap_id:05d}-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    try:
+        files: Dict[str, Dict] = {}
+        total = 0
+        for s in range(shards):
+            ids_local = np.flatnonzero(dirty_host[s * vs:(s + 1) * vs])
+            ids_global = (ids_local + s * vs).astype(np.int64)
+            # device-side gather: only the [k, D] payload crosses the link
+            rows = npz_safe(jnp.take(data, jnp.asarray(ids_global), axis=0)
+                            if ids_global.size else
+                            np.zeros((0, int(data.shape[1]))))
+            rows = np.asarray(rows)
+            fname = f"shard-{s:03d}.npz"
+            fpath = os.path.join(tmp, fname)
+            np.savez_compressed(fpath, ids=ids_global, rows=rows)
+            _fsync_file(fpath)
+            files[fname] = {
+                "rows": int(ids_global.size),
+                "crc_ids": _crc(ids_global),
+                "crc_rows": _crc(rows),
+            }
+            total += int(ids_global.size)
+        manifest = {
+            "version": SNAPSHOT_VERSION,
+            "snap_id": snap_id,
+            "spec": spec.to_json(),
+            "shards": shards,
+            "vocab_padded": v_pad,
+            "dirty_rows": total,
+            "files": files,
+            "time": time.time(),
+        }
+        mpath = os.path.join(tmp, "manifest.json")
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        os.replace(tmp, final)
+        _fsync_dir(save_dir)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    logger.info("pserver snapshot %s: %d dirty row(s) over %d shard(s)",
+                final, total, shards)
+    return final
+
+
+def read_snapshot_manifest(d: str) -> Dict:
+    with open(os.path.join(d, "manifest.json")) as f:
+        return json.load(f)
+
+
+def validate_snapshot(d: str) -> Optional[str]:
+    """None when the snapshot is loadable, else the human-readable reason
+    (the string a raised SnapshotError carries)."""
+    if not os.path.isdir(d):
+        return "not a directory"
+    try:
+        manifest = read_snapshot_manifest(d)
+    except FileNotFoundError:
+        return "missing manifest.json"
+    except (json.JSONDecodeError, OSError) as e:
+        return f"unreadable manifest.json: {e}"
+    for fname, info in manifest.get("files", {}).items():
+        fpath = os.path.join(d, fname)
+        if not os.path.exists(fpath):
+            return f"missing {fname}"
+        try:
+            data = np.load(fpath, allow_pickle=False)
+            ids, rows = data["ids"], data["rows"]
+        except Exception as e:
+            return f"{fname} unreadable: {type(e).__name__}: {e}"
+        if _crc(ids) != info.get("crc_ids"):
+            return f"{fname}:ids CRC mismatch"
+        if _crc(rows) != info.get("crc_rows"):
+            return f"{fname}:rows CRC mismatch"
+    return None
+
+
+def valid_chain_tip(save_dir: str) -> int:
+    """Highest snap id reachable through an UNBROKEN valid chain from
+    snap 0 (reconstruction replays every delta in order, so a corrupt or
+    missing middle snapshot caps the usable tip at its predecessor), or
+    -1.  This is the fallback contract: one damaged snapshot costs you
+    the deltas from it onward, never the whole table."""
+    sid = -1
+    k = 0
+    while True:
+        d = snap_dir(save_dir, k)
+        if not os.path.isdir(d):
+            break
+        reason = validate_snapshot(d)
+        if reason is not None:
+            logger.warning("table snapshot chain stops at %s: %s", d, reason)
+            break
+        sid = k
+        k += 1
+    return sid
+
+
+def latest_snapshot(save_dir: str, *, validate: bool = True) -> int:
+    """Highest snap id under ``save_dir`` (validated unless told not to),
+    or -1.  Corrupt snapshots are logged and skipped — the fallback the
+    acceptance contract requires."""
+    if not os.path.isdir(save_dir):
+        return -1
+    ids = [int(m.group(1)) for m in
+           (_SNAP_RE.fullmatch(n) for n in os.listdir(save_dir)) if m]
+    for sid in sorted(ids, reverse=True):
+        if not validate:
+            return sid
+        reason = validate_snapshot(snap_dir(save_dir, sid))
+        if reason is None:
+            return sid
+        logger.warning("skipping corrupt table snapshot %s: %s",
+                       snap_dir(save_dir, sid), reason)
+    return -1
+
+
+def _apply_snap(table: np.ndarray, d: str) -> int:
+    """Replay one snapshot's dirty rows into ``table``; validates CRCs and
+    raises the typed error on damage.  Returns rows replayed."""
+    reason = validate_snapshot(d)
+    if reason is not None:
+        raise SnapshotError(f"table snapshot {d} failed validation: {reason}")
+    manifest = read_snapshot_manifest(d)
+    n = 0
+    for fname in sorted(manifest.get("files", {})):
+        data = np.load(os.path.join(d, fname), allow_pickle=False)
+        ids, rows = data["ids"], data["rows"]
+        if ids.size:
+            table[ids] = rows.astype(table.dtype)
+            n += int(ids.size)
+    return n
+
+
+def _reinit_host(spec: TableSpec, shards: int, v_pad: int) -> np.ndarray:
+    """Re-derive the initial table on the host, shard by shard — the same
+    bits the device-side per-shard init produced."""
+    vs = v_pad // shards
+    return np.concatenate(
+        [np.asarray(init_shard_rows(spec, s, vs)) for s in range(shards)],
+        axis=0)
+
+
+def load_table_host(save_dir: str, *, upto: Optional[int] = None
+                    ) -> Tuple[TableSpec, np.ndarray, int]:
+    """Reconstruct the host table: re-init from the manifest's spec, then
+    replay every snapshot in chain order.  Returns
+    ``(spec, table [V_pad, D], snap_id)``.
+
+    Without ``upto``, the tip is the end of the longest VALID chain
+    prefix (``valid_chain_tip``): a damaged snapshot — tip or middle —
+    falls back to its predecessor instead of making the table
+    unreconstructable.  With ``upto`` given explicitly, a corrupt member
+    anywhere in the requested chain raises the typed ``SnapshotError``."""
+    sid = valid_chain_tip(save_dir) if upto is None else int(upto)
+    if sid < 0:
+        raise SnapshotError(f"no valid table snapshot under {save_dir!r}")
+    newest = read_snapshot_manifest(snap_dir(save_dir, sid))
+    spec = TableSpec.from_json(newest["spec"])
+    v_pad = int(newest["vocab_padded"])
+    shards = int(newest["shards"])
+    table = _reinit_host(spec, shards, v_pad)
+    for k in range(sid + 1):
+        d = snap_dir(save_dir, k)
+        if not os.path.isdir(d):
+            raise SnapshotError(
+                f"table snapshot chain broken: missing {d} (needed to "
+                f"reconstruct snap {sid})")
+        _apply_snap(table, d)
+    return spec, table, sid
+
+
+class TableReader:
+    """Serving-side hot-reloadable view of one snapshotted table."""
+
+    def __init__(self, save_dir: str) -> None:
+        self.save_dir = save_dir
+        self.spec, self.table, self.version = load_table_host(save_dir)
+        self.rows_replayed = 0
+
+    def hot_reload(self) -> int:
+        """Apply snapshots newer than the loaded version; returns rows
+        replayed.  A corrupt NEW snapshot leaves the reader on its current
+        (previous-snapshot) view and logs the typed reason — serving keeps
+        answering from the last good table."""
+        newest = latest_snapshot(self.save_dir, validate=False)
+        replayed = 0
+        for k in range(self.version + 1, newest + 1):
+            try:
+                replayed += _apply_snap(self.table, snap_dir(self.save_dir, k))
+            except SnapshotError as e:
+                logger.warning("hot_reload stopped at snap %d: %s", k, e)
+                break
+            self.version = k
+        self.rows_replayed += replayed
+        return replayed
+
+    def lookup(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.spec.vocab):
+            raise SnapshotError(
+                f"table {self.spec.name!r}: lookup id out of range "
+                f"[0, {self.spec.vocab})")
+        return self.table[ids]
+
+    def healthz(self) -> dict:
+        return {"table": self.spec.name, "version": self.version,
+                "vocab": self.spec.vocab, "dim": self.spec.dim,
+                "rows_replayed": self.rows_replayed}

@@ -1,0 +1,186 @@
+"""Sharded embedding table store — the pserver's parameter memory.
+
+A table is a [V_pad, D] array row-sharded over one mesh axis
+(``P(axis, None)``): each of the n shard devices holds ``V_pad / n``
+contiguous rows and the full table never exists on one host.  Three
+invariants the rest of the tier builds on:
+
+- **vocab padding**: V is padded UP to a multiple of the shard count
+  (``pad_vocab``); tail rows are masked to zero at init and can never be
+  requested (ids are always < V), so they stay zero forever and cost only
+  the padding bytes.  Padding can be disabled, in which case a non-dividing
+  vocab raises a typed ``ConfigError`` naming the table instead of failing
+  later inside ``device_put`` with a shape error.
+- **per-shard deterministic init**: shard s draws its rows from
+  ``fold_in(PRNGKey(seed), s)`` — init happens shard-locally under
+  shard_map (no [V, D] materialization), yet any host can re-derive any
+  shard bit-exactly (threefry is backend-deterministic), which is what lets
+  incremental snapshots replay on top of a re-init instead of requiring a
+  full base dump (snapshot.py).
+- **f32 master / optional bf16 compute** (ROADMAP item 3 conventions): the
+  stored master table keeps ``dtype`` (f32 default); lookups may cast the
+  gathered rows to ``compute_dtype`` on the way out while gradients and
+  updates stay in master precision.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import asdict, dataclass, field, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel import compat
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = ["TableSpec", "ShardedTable", "pad_vocab", "init_shard_rows"]
+
+
+def pad_vocab(vocab: int, shards: int, *, pad: bool = True,
+              name: str = "table") -> int:
+    """Vocab rows padded up to a multiple of ``shards``; with ``pad=False``
+    a non-dividing vocab is a typed config error naming the table."""
+    if vocab <= 0:
+        raise ConfigError(f"table {name!r}: vocab must be positive, got {vocab}")
+    if shards <= 0:
+        raise ConfigError(f"table {name!r}: shard count must be positive, "
+                          f"got {shards}")
+    rem = vocab % shards
+    if rem == 0:
+        return vocab
+    if not pad:
+        raise ConfigError(
+            f"table {name!r}: vocab {vocab} does not divide evenly over "
+            f"{shards} shards and padding is disabled — enable padding "
+            f"(masked tail rows) or resize the vocabulary")
+    return vocab + (shards - rem)
+
+
+@dataclass(frozen=True)
+class TableSpec:
+    """Declarative spec of one sharded table — everything a host needs to
+    re-derive the initial shard contents (snapshot replay) and validate a
+    snapshot against the live config."""
+
+    name: str
+    vocab: int
+    dim: int
+    init: str = "normal"            # 'normal' | 'uniform' | 'zeros'
+    initial_std: float = 0.01
+    initial_mean: float = 0.0
+    seed: int = 0
+    dtype: str = "float32"          # master dtype (f32 keeps exact updates)
+    compute_dtype: Optional[str] = None   # lookup output cast (e.g. bfloat16)
+    #: per-DEVICE byte budget for this table's shard (0 = unchecked); the
+    #: "too large for one device" contract: the FULL table may exceed it as
+    #: long as every shard fits
+    device_budget_bytes: int = 0
+
+    def padded_vocab(self, shards: int, *, pad: bool = True) -> int:
+        return pad_vocab(self.vocab, shards, pad=pad, name=self.name)
+
+    def table_bytes(self) -> int:
+        return self.vocab * self.dim * jnp.dtype(self.dtype).itemsize
+
+    def shard_bytes(self, shards: int) -> int:
+        vs = self.padded_vocab(shards) // shards
+        return vs * self.dim * jnp.dtype(self.dtype).itemsize
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "TableSpec":
+        return cls(**d)
+
+
+def init_shard_rows(spec: TableSpec, shard_index, shard_rows: int):
+    """Rows ``[shard_index * shard_rows, ...)`` of the table, computed from
+    the per-shard folded key.  Traceable (``shard_index`` may be a tracer
+    inside shard_map) AND host-replayable with a concrete index — both
+    produce identical bits.  Tail rows past the true vocab are masked to
+    zero."""
+    key = jax.random.fold_in(jax.random.PRNGKey(spec.seed), shard_index)
+    shape = (shard_rows, spec.dim)
+    dtype = jnp.dtype(spec.dtype)
+    if spec.init == "zeros":
+        rows = jnp.zeros(shape, dtype)
+    elif spec.init == "uniform":
+        a = spec.initial_std
+        rows = jax.random.uniform(key, shape, dtype, -a, a)
+    else:  # normal — the reference's embedding default
+        rows = (spec.initial_mean
+                + spec.initial_std * jax.random.normal(key, shape, dtype))
+    row_id = shard_index * shard_rows + jnp.arange(shard_rows)
+    return rows * (row_id < spec.vocab)[:, None].astype(dtype)
+
+
+class ShardedTable:
+    """One live sharded table: the master array, its dirty-row mask, and the
+    placement metadata.  ``data``/``dirty`` are plain jax arrays (swapped
+    wholesale by the jitted step via the tier), everything else is static."""
+
+    def __init__(self, spec: TableSpec, mesh, *, axis: str = "model",
+                 pad: bool = True, data=None, dirty=None) -> None:
+        self.spec = spec
+        self.mesh = mesh
+        self.axis = axis
+        self.shards = int(mesh.shape[axis])
+        self.vocab_padded = spec.padded_vocab(self.shards, pad=pad)
+        self.shard_rows = self.vocab_padded // self.shards
+        if spec.device_budget_bytes:
+            per = self.shard_rows * spec.dim * jnp.dtype(spec.dtype).itemsize
+            if per > spec.device_budget_bytes:
+                raise ConfigError(
+                    f"table {spec.name!r}: one shard needs {per} bytes "
+                    f"({self.shard_rows} x {spec.dim} {spec.dtype}) but the "
+                    f"device budget is {spec.device_budget_bytes} — add "
+                    f"shards or shrink the table")
+        self.sharding = NamedSharding(mesh, P(axis, None))
+        self.mask_sharding = NamedSharding(mesh, P(axis))
+        self.data = self._init_sharded() if data is None else data
+        self.dirty = (jnp.zeros((self.vocab_padded,), jnp.bool_)
+                      if dirty is None else dirty)
+        if getattr(self.dirty, "sharding", None) != self.mask_sharding:
+            self.dirty = jax.device_put(self.dirty, self.mask_sharding)
+
+    # ------------------------------------------------------------------
+
+    def _init_sharded(self):
+        """Per-shard init under shard_map: shard s computes ONLY its rows
+        from the folded key — the [V_pad, D] array is born sharded."""
+        spec, vs = self.spec, self.shard_rows
+
+        def body(idx):
+            return init_shard_rows(spec, idx[0], vs)
+
+        mapped = compat.shard_map(
+            body, mesh=self.mesh, in_specs=(P(self.axis),),
+            out_specs=P(self.axis, None), check_vma=False)
+        idx = jax.device_put(jnp.arange(self.shards, dtype=jnp.int32),
+                             self.mask_sharding)
+        return mapped(idx)
+
+    # ------------------------------------------------------------------
+
+    def place(self) -> None:
+        """(Re-)pin data/dirty to their shardings — after a checkpoint load
+        hands back host arrays."""
+        self.data = jax.device_put(jnp.asarray(self.data), self.sharding)
+        self.dirty = jax.device_put(
+            jnp.asarray(self.dirty, jnp.bool_), self.mask_sharding)
+
+    def rows_host(self, ids) -> np.ndarray:
+        """Host pull of selected rows (debug/serving oracle) — gathers on
+        device, transfers only the [k, D] result."""
+        ids = jnp.asarray(ids, jnp.int32)
+        return np.asarray(jnp.take(self.data, ids, axis=0))
+
+    def __repr__(self) -> str:
+        return (f"<ShardedTable {self.spec.name} {self.spec.vocab}"
+                f"(+{self.vocab_padded - self.spec.vocab} pad)x{self.spec.dim} "
+                f"{self.shards} shards @{self.axis}>")

@@ -162,6 +162,7 @@ class InferenceServer:
             self.degrade_at = [max(1, (max_queue * (i + 1)) // (n + 1))
                                for i in range(n)]
         self._service_ema: Optional[float] = None  # seconds per batch
+        self._feeder = None   # attach_feeder(): healthz surfaces its drops
         self._state = self.RUNNING
         self._ready = False
         self._fail_reason: Optional[str] = None
@@ -813,6 +814,12 @@ class InferenceServer:
     # health surface
     # ------------------------------------------------------------------
 
+    def attach_feeder(self, feeder) -> None:
+        """Register the DataFeeder converting raw rows for this server so
+        ``healthz()`` surfaces its ``dropped_features`` counter — sparse-bag
+        truncation (max_len/max_nnz caps) is silent data loss otherwise."""
+        self._feeder = feeder
+
     def healthz(self) -> dict:
         snap = self.metrics.snapshot()
         # the supervisor owns the relaunch count (it alone knows whether a
@@ -832,6 +839,9 @@ class InferenceServer:
                                if self._service_ema is not None else None),
             **snap,
         }
+        if self._feeder is not None:
+            out["dropped_features"] = int(
+                getattr(self._feeder, "dropped_features", 0))
         if self._scheduler is not None:
             sched = self._scheduler
             occupied = sched.occupied()

@@ -97,9 +97,10 @@ class SGDTrainer:
         seed = FLAGS.seed if seed is None else seed
         self._rng = jax.random.PRNGKey(seed)
         self._rng, init_key = jax.random.split(self._rng)
-        self.params, self.state = self.topology.init(init_key)
 
-        # per-parameter attrs from specs (ParameterConfig analog)
+        # per-parameter attrs from specs (ParameterConfig analog) — read
+        # BEFORE init: pserver routing must be decided while no table has
+        # been materialized yet
         self.lr_scales = {}
         self.decays = {}
         self.statics = {}
@@ -119,6 +120,30 @@ class SGDTrainer:
             if spec.attr.pruning_ratio:
                 pruning_ratios[name] = spec.attr.pruning_ratio
         self.pruning_ratios = pruning_ratios
+
+        # pserver tier (paddle_tpu/pserver): with a mesh carrying the
+        # pserver axis, every sparse_grad table leaves the dense params
+        # pytree and lives mesh-sharded — created shard-locally and
+        # excluded from Topology.init, so a 100M-row table never exists
+        # dense on one host (docs/pserver.md)
+        self.pserver = None
+        routed = set()
+        if (mesh is not None and self.sparse_rows
+                and FLAGS.pserver_axis in mesh.axis_names):
+            from paddle_tpu.pserver import PServerTier
+
+            tier = PServerTier(mesh, self.topology, self.optimizer,
+                               lr_scales=self.lr_scales, decays=self.decays,
+                               seed=seed)
+            if tier.active:
+                self.pserver = tier
+                routed = tier.param_names()
+                for name in routed:
+                    self.sparse_rows.pop(name, None)
+                    self.lr_scales.pop(name, None)
+                    self.decays.pop(name, None)
+
+        self.params, self.state = self.topology.init(init_key, skip=routed)
 
         # StaticPruningHook analog: masks fixed from initial magnitudes,
         # re-applied after every update inside the jitted step
@@ -159,12 +184,24 @@ class SGDTrainer:
 
         device_specs = self.device_specs
         guard = self.guard_nonfinite
+        tier = self.pserver
 
-        def step(params, state, opt_state, rng, feed):
-            def loss_fn(p):
+        def step(params, state, opt_state, ps, rng, feed):
+            # ``ps`` is the pserver tier's pytree (tables/slots/dirty/step;
+            # {} without a tier).  Tables enter the step OUTSIDE the
+            # differentiated arguments; each routed lookup adds a zeros
+            # proxy, and grads w.r.t. the proxies ARE the (ids, row-grads)
+            # segments the sparse apply pushes — no [V, D] cotangent ever
+            # exists (pserver/tier.py, gated by `lint --pserver`).
+            proxies = tier.make_proxies(feed) if tier is not None else {}
+
+            def loss_fn(p, px):
+                overrides = (tier.make_overrides(ps["tables"], px)
+                             if tier is not None else None)
                 outs, new_state = topo.apply(
                     p, state, feed, train=True, rng=rng,
                     device_specs=device_specs,
+                    param_overrides=overrides,
                 )
                 extras = {k: outs[k].value for k in extra_names}
                 total = sum(
@@ -172,30 +209,53 @@ class SGDTrainer:
                 )
                 return total, (new_state, extras)
 
-            (loss, (new_state, extras)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            (loss, (new_state, extras)), (grads, px_grads) = (
+                jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
+                    params, proxies))
 
-            def do_update(p, g, o):
+            def do_update(pack, gpack, o):
+                p, ps_in = pack
+                g, pxg = gpack
+                clip = True
+                if tier is not None and opt.gradient_clipping_threshold > 0:
+                    # clipping parity with single-host training: the clip
+                    # norm must include the routed tables' (deduped) row
+                    # gradients, and the SAME scale must hit both trees
+                    from paddle_tpu.param.optimizers import \
+                        clip_by_global_norm
+
+                    thr = opt.gradient_clipping_threshold
+                    g, gnorm = clip_by_global_norm(
+                        g, thr, extra_sq=tier.grad_norm_sq(feed, pxg))
+                    scale = jnp.minimum(
+                        1.0, thr / jnp.maximum(gnorm, 1e-12))
+                    pxg = jax.tree_util.tree_map(lambda x: x * scale, pxg)
+                    clip = False
                 np_, no_ = opt.update(
                     p, g, o,
                     lr_scales=lr_scales, decays=decays, statics=statics,
-                    sparse_rows=sparse_rows,
+                    sparse_rows=sparse_rows, clip=clip,
                 )
-                return apply_masks(np_, masks), no_
+                ps_out = (tier.apply_grads(ps_in, feed, pxg)
+                          if tier is not None else ps_in)
+                return (apply_masks(np_, masks), ps_out), no_
 
             if guard:
-                # finite checks on loss + grad global-norm, update skipped
-                # via lax.cond — on-device, no host round-trip (gated by
-                # the audit in tests/test_resilience.py)
-                new_params, new_opt, new_state, gextras = guarded_update(
-                    do_update, loss=loss, grads=grads, params=params,
-                    opt_state=opt_state, new_state=new_state,
-                    old_state=state)
+                # finite checks on loss + grad global-norm (row grads
+                # included), update skipped via lax.cond — on-device, no
+                # host round-trip (gated by the audit in
+                # tests/test_resilience.py); a skip holds pserver tables,
+                # slots, and dirty masks too
+                (new_params, new_ps), new_opt, new_state, gextras = (
+                    guarded_update(
+                        do_update, loss=loss, grads=(grads, px_grads),
+                        params=(params, ps), opt_state=opt_state,
+                        new_state=new_state, old_state=state))
                 extras = {**extras, **gextras}
             else:
-                new_params, new_opt = do_update(params, grads, opt_state)
-            return loss, new_params, new_state, new_opt, extras
+                (new_params, new_ps), new_opt = do_update(
+                    (params, ps), (grads, px_grads), opt_state)
+            return loss, new_params, new_state, new_opt, new_ps, extras
 
         # kept un-jitted for the lint auditor (audit() re-traces it)
         self._step_fn = step
@@ -203,14 +263,14 @@ class SGDTrainer:
             # params/opt slots were placed ONCE at init (or after load) with
             # their rule-derived shardings; the jitted step consumes and
             # donates them in place — no per-batch host re-placement
-            jitted = jax.jit(step, donate_argnums=(0, 2))
+            jitted = jax.jit(step, donate_argnums=(0, 2, 3))
 
-            def run(params, state, opt_state, rng, feed):
+            def run(params, state, opt_state, ps, rng, feed):
                 feed = self._shard_feed(feed)
-                return jitted(params, state, opt_state, rng, feed)
+                return jitted(params, state, opt_state, ps, rng, feed)
 
             return run
-        return jax.jit(step, donate_argnums=(0, 2))
+        return jax.jit(step, donate_argnums=(0, 2, 3))
 
     def _param_shardings(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -259,12 +319,16 @@ class SGDTrainer:
             self.opt_state = {**rest, "slots": slots}
         else:
             self.opt_state = jax.device_put(self.opt_state, repl)
+        if getattr(self, "pserver", None) is not None:
+            self.pserver.place()
 
     def _shard_feed(self, feed):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self.mesh
-        axis = self.data_axis
+        # a mesh without the data axis (e.g. a pure pserver 'model' mesh)
+        # replicates the batch instead of erroring inside device_put
+        axis = self.data_axis if self.data_axis in mesh.axis_names else None
 
         def put(v):
             v = jnp.asarray(v)
@@ -333,8 +397,9 @@ class SGDTrainer:
         if self.mesh is not None:
             feed = self._shard_feed(feed)
         rng = jax.random.PRNGKey(0)
+        ps = self.pserver.state() if self.pserver is not None else {}
         return audit_fn(self._step_fn, self.params, self.state,
-                        self.opt_state, rng, feed,
+                        self.opt_state, ps, rng, feed,
                         label=label, mesh=self.mesh)
 
     def train_batch(self, feed: Dict[str, Any]) -> float:
@@ -349,9 +414,12 @@ class SGDTrainer:
         ``TooManyBadSteps`` — persistent non-finite training cannot
         recover by skipping."""
         self._rng, key = jax.random.split(self._rng)
-        loss, self.params, self.state, self.opt_state, extras = self._step(
-            self.params, self.state, self.opt_state, key, feed
-        )
+        ps = self.pserver.state() if self.pserver is not None else {}
+        loss, self.params, self.state, self.opt_state, new_ps, extras = (
+            self._step(self.params, self.state, self.opt_state, ps, key,
+                       feed))
+        if self.pserver is not None:
+            self.pserver.adopt(new_ps)
         if self.averager is not None:
             self.avg_params = self.averager.update(self.avg_params, self.params)
         self._last_extras = extras
@@ -497,6 +565,14 @@ class SGDTrainer:
                     except TooManyBadSteps:
                         handler(ev.EndPass(pass_id))
                         raise
+                    drops = getattr(feeder, "dropped_features", None)
+                    if drops is not None:
+                        # sparse-bag truncation is a data-loss event, not a
+                        # debug log line: surface the feeder's counter next
+                        # to the step extras (serving mirrors it in
+                        # healthz())
+                        self._last_extras = {**self._last_extras,
+                                             "dropped_features": int(drops)}
                     cost = float(loss)
                     costs.append(cost)
                     handler(ev.EndIteration(pass_id, batch_id, cost))
@@ -644,16 +720,22 @@ class SGDTrainer:
         fn = cache.get(want_outs)
         if fn is None:
             topo, names = self.topology, self.cost_names
+            tier = self.pserver
 
             @jax.jit
-            def fn(params, state, feed):
-                outs, _ = topo.apply(params, state, feed, train=False)
+            def fn(params, state, tables, feed):
+                overrides = (tier.make_overrides(tables, {})
+                             if tier is not None else None)
+                outs, _ = topo.apply(params, state, feed, train=False,
+                                     param_overrides=overrides)
                 costs = {k: outs[k].value for k in names}
                 if want_outs:
                     return costs, {k: a.value for k, a in outs.items()}
                 return costs, {}
 
             cache[want_outs] = fn
+        tables = ({k: t.data for k, t in self.pserver.tables.items()}
+                  if self.pserver is not None else {})
         params = self.avg_params if self.avg_params is not None else self.params
         accs = {ev: (DeviceAccumulator(ev) if ev.additive else None)
                 for ev in evaluators}
@@ -664,7 +746,7 @@ class SGDTrainer:
         nb = 0
         for data_batch in reader():
             feed = feeder(data_batch) if feeder else data_batch
-            costs, outs = fn(params, self.state, feed)
+            costs, outs = fn(params, self.state, tables, feed)
             if totals is None:
                 totals = costs
             else:
@@ -711,7 +793,12 @@ class SGDTrainer:
         names = [l.name for l in output_layers]
         topo = self.topology
 
-        outs, _ = topo.apply(self.params, self.state, feed, train=False, outputs=names)
+        overrides = None
+        if self.pserver is not None:
+            overrides = self.pserver.make_overrides(
+                {k: t.data for k, t in self.pserver.tables.items()}, {})
+        outs, _ = topo.apply(self.params, self.state, feed, train=False,
+                             outputs=names, param_overrides=overrides)
         return {k: np.asarray(outs[k].value) for k in names}
 
     # ------------------------------------------------------------------
@@ -739,6 +826,11 @@ class SGDTrainer:
         extra = {}
         if self.avg_params is not None:
             extra["avg_params"] = self.avg_params
+        if self.pserver is not None:
+            # sharded tables + their slots/dirty masks/step ride the same
+            # atomic CRC-manifested checkpoint: a lost shard rank restores
+            # its rows from the manifest through the gang supervisor
+            extra["pserver"] = self.pserver.state()
         return save_checkpoint(
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
@@ -752,19 +844,25 @@ class SGDTrainer:
         """Validate + restore a checkpoint; raises
         ``resilience.CheckpointError`` on corruption.  Restores the RNG
         key when the manifest carries one; returns the manifest."""
-        extra_like = ({"avg_params": self.avg_params}
-                      if self.avg_params is not None else None)
+        extra_like = {}
+        if self.avg_params is not None:
+            extra_like["avg_params"] = self.avg_params
+        if self.pserver is not None:
+            extra_like["pserver"] = self.pserver.state()
         out = load_checkpoint(
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
-            extra_like=extra_like, validate=validate,
+            extra_like=extra_like or None, validate=validate,
         )
-        if extra_like is None:
+        if not extra_like:
             self.params, self.state, self.opt_state = out
         else:
             self.params, self.state, self.opt_state, extras = out
             if "avg_params" in extras:
                 self.avg_params = extras["avg_params"]
+            if "pserver" in extras:
+                self.pserver.adopt(extras["pserver"])
+                self.pserver.place()
         try:
             manifest = read_manifest(pass_dir(save_dir, pass_id))
         except (FileNotFoundError, ValueError):

@@ -261,6 +261,15 @@ define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devic
 define_flag("mesh_axes", "data", "comma-separated mesh axis names, e.g. 'data,model'")
 define_flag("num_virtual_devices", 0, "force N virtual CPU devices (tests/dry-runs)")
 
+# Sharded-embedding parameter-server tier (paddle_tpu/pserver; docs/pserver.md)
+define_flag("pserver_axis", "model", "mesh axis embedding tables marked "
+            "sparse_grad shard their vocab over; a trainer mesh carrying "
+            "this axis routes them through the pserver tier (all-to-all "
+            "lookup + row-sparse updates that never densify)")
+define_flag("pserver_pad_vocab", True, "pad table vocabs up to a shard "
+            "multiple with masked tail rows; off = a non-dividing vocab "
+            "raises a typed ConfigError naming the table")
+
 # Sequence / generation (replaces beam_size, rnn_use_batch ...)
 define_flag("beam_size", 3, "default beam width for sequence generation")
 define_flag("max_gen_length", 100, "max generated sequence length")

@@ -44,6 +44,7 @@ _UNITS = [
     ("googlenet", "ms/batch"),
     ("pallas_", "ms (best variant)"),
     ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
+    ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
 ]
 
 

@@ -25,6 +25,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (-m 'not slow'); full-size acceptance "
+        "runs like the 100M-row pserver table")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)

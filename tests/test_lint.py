@@ -594,6 +594,24 @@ def test_cli_decode_audit_is_clean(capsys):
     assert rc == 0 and not errors, errors
 
 
+def test_cli_pserver_audit_is_clean(capsys):
+    """`python -m paddle_tpu lint --pserver` — the CI gate of the sharded
+    embedding tier: serving checks over the compiled lookup/apply closures
+    PLUS the never-densify assertion (no [V, D] grad or optimizer temp in
+    the sparse-apply jaxpr)."""
+    from paddle_tpu.__main__ import main
+
+    rc = main(["lint", "--pserver", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    errors = [f for f in out["findings"] if f["severity"] == "ERROR"]
+    assert rc == 0 and not errors, errors
+    # the spec knob works and a collision is rejected loudly, not skewed
+    rc = main(["lint", "--pserver", "2048,16,2048,4", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and any(f["check"] == "pserver-build"
+                           for f in out["findings"])
+
+
 # ---------------------------------------------------------------------------
 # deploy: _unrolled_scans lock (satellite config/deploy.py:283)
 # ---------------------------------------------------------------------------

@@ -202,6 +202,67 @@ def test_sparse_rows_overflow_falls_back_to_mask_path():
         assert moved[rows].all()
 
 
+def test_sparse_rows_overflow_bit_identical_to_dense_apply():
+    """The K fast path's overflow fallback ("shared by sparse_rows=True and
+    the K fast path's overflow" branch) pinned DIRECTLY against the dense
+    apply: forcing overflow (touched > K) must produce, bit for bit, the
+    dense update on touched rows — params AND every optimizer slot leaf —
+    while untouched rows hold params and slots exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.param.optimizers import Adam, AdaGrad, Momentum, SGD
+
+    rs = np.random.RandomState(11)
+    V, D, K, TOUCH = 30, 4, 3, 9               # TOUCH > K: overflow forced
+    # exact binary fractions + power-of-two hyperparameters: every product
+    # is exactly representable, so the cond-compiled fallback and the eager
+    # dense apply cannot diverge by FMA contraction — the comparison pins
+    # the BRANCH LOGIC at zero tolerance instead of XLA fusion noise
+    p0 = (rs.randint(-64, 64, (V, D)) / 8.0).astype(np.float32)
+    ge = np.zeros((V, D), np.float32)
+    rows = rs.choice(V, TOUCH, replace=False)
+    for r in rows:
+        ge[r] = rs.randint(-64, 64, D) / 8.0
+    touched = np.any(ge != 0, axis=1)
+    params = {"emb": jnp.asarray(p0)}
+    grads = {"emb": jnp.asarray(ge)}
+
+    exact_kw = {
+        SGD: {}, AdaGrad: {},
+        Momentum: {"momentum": 0.5},
+        Adam: {"beta1": 0.5, "beta2": 0.5},
+    }
+    for opt_cls in (SGD, Momentum, AdaGrad, Adam):
+        a = opt_cls(learning_rate=0.125, **exact_kw[opt_cls])
+        b = opt_cls(learning_rate=0.125, **exact_kw[opt_cls])
+        sa, sb = a.init_state(params), b.init_state(params)
+        # dense apply: every row advances
+        pd, sd = a.update(dict(params), grads, sa)
+        # overflow fallback: cond must take the masked branch
+        pk, sk = b.update(dict(params), grads, sb, sparse_rows={"emb": K})
+        # touched rows == the dense apply, bit for bit
+        np.testing.assert_array_equal(
+            np.asarray(pk["emb"])[touched], np.asarray(pd["emb"])[touched],
+            err_msg=f"{opt_cls.__name__} params/touched")
+        # untouched rows: params AND slots held exactly
+        np.testing.assert_array_equal(
+            np.asarray(pk["emb"])[~touched], p0[~touched],
+            err_msg=f"{opt_cls.__name__} params/untouched")
+        for dense_leaf, k_leaf, init_leaf in zip(
+                jax.tree_util.tree_leaves(sd["slots"]["emb"]),
+                jax.tree_util.tree_leaves(sk["slots"]["emb"]),
+                jax.tree_util.tree_leaves(sa["slots"]["emb"])):
+            np.testing.assert_array_equal(
+                np.asarray(k_leaf)[touched],
+                np.asarray(dense_leaf)[touched],
+                err_msg=f"{opt_cls.__name__} slots/touched")
+            np.testing.assert_array_equal(
+                np.asarray(k_leaf)[~touched],
+                np.asarray(init_leaf)[~touched],
+                err_msg=f"{opt_cls.__name__} slots/untouched")
+
+
 def test_adam_bf16_slot_dtype():
     """Mixed-precision Adam moment slots (slot_dtype='bfloat16'): slots
     store at half width, arithmetic runs in f32, and a toy quadratic still

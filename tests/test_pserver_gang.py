@@ -1,0 +1,237 @@
+"""Pserver chaos/gang coverage: a lost shard is just a rank failure.
+
+On a REAL 2-process CPU gang (the tests/test_gang.py harness — each rank
+an OS process running the full trainer, gang coordination over the
+supervisor's shared dir), with every rank hosting a 2-device pserver mesh:
+
+- each rank FIRST proves the tier's core contract in-process: the
+  all-to-all lookup and the sharded sparse apply are BIT-identical to the
+  single-host dense oracle (gather + masked ``sparse_rows=True`` update)
+  — the acceptance check running on real multi-process ranks, not just
+  the in-process virtual mesh;
+- SIGKILLing one shard-hosting rank mid-pass takes the gang down, the
+  supervisor relaunches it, ``--resume=auto`` restores the sharded tables
+  (manifest-validated checkpoint extras) and training replays the dirty
+  rows — post-resume losses match an uninterrupted run to 1e-6, the same
+  tolerance as tests/test_gang.py.
+
+Every multiprocess test runs under a hard ``signal.alarm``.
+"""
+
+import json
+import os
+import random
+import signal
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.resilience import GangSupervisor
+from paddle_tpu.trainer import SGDTrainer, events as ev
+from paddle_tpu.utils.flags import FLAGS
+from tests.conftest import on_accelerator
+
+pytestmark = pytest.mark.skipif(
+    on_accelerator(), reason="spawns CPU gangs; assumes virtual devices")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HARD_TIMEOUT_S = 240
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout():
+    def _abort(signum, frame):
+        raise RuntimeError(
+            f"pserver gang test exceeded {HARD_TIMEOUT_S}s hard timeout")
+
+    prev = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(HARD_TIMEOUT_S)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+# Each rank: 2 virtual CPU devices = a 2-shard pserver mesh; vocab 49 (odd,
+# exercising the padding path); the worker proves lookup/apply bit-identity
+# against the dense oracle BEFORE training, then runs the supervised loop.
+PSERVER_WORKER = textwrap.dedent("""\
+    import json, os, sys
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    import paddle_tpu.ops as O
+    import paddle_tpu.parallel as par
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.pserver import all_to_all_lookup, sharded_row_update
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.trainer import SGDTrainer, events as ev
+    from paddle_tpu.utils import FLAGS
+    from paddle_tpu.utils.devices import make_mesh
+
+    save_dir, out_dir, mode, chaos_rank = sys.argv[1:5]
+    rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    FLAGS.save_dir = save_dir
+    FLAGS.log_period = 0
+
+    mesh = make_mesh((2,), ("model",))
+
+    # ---- acceptance: lookup + sparse apply vs dense oracle, bit-exact,
+    # on THIS real gang rank's 2-device mesh ----
+    rs = np.random.RandomState(7)
+    V, D, N = 49, 8, 20
+    table = jnp.asarray(rs.randn(50, D).astype(np.float32))  # padded V
+    t_sh = jax.device_put(
+        table, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("model", None)))
+    ids = jnp.asarray(rs.randint(0, V, (N,)), jnp.int32)
+    g = jnp.asarray(rs.randn(N, D).astype(np.float32))
+    out = all_to_all_lookup(mesh, t_sh, ids)
+    assert np.array_equal(np.asarray(out),
+                          np.asarray(jnp.take(table, ids, axis=0)))
+    opt = Adam(learning_rate=0.05)
+    st = opt.init_state({"t": table})
+    order = jnp.argsort(ids, stable=True)
+    gd = jnp.zeros_like(table).at[ids[order]].add(g[order])
+    p_ref, s_ref = opt.update({"t": table}, {"t": gd}, st,
+                              sparse_rows={"t": True})
+    slots = jax.tree_util.tree_map(
+        lambda s: jax.device_put(s, t_sh.sharding), st["slots"]["t"])
+    new_t, new_s, _ = sharded_row_update(
+        mesh, opt, t_sh, slots, jnp.zeros((50,), jnp.bool_), ids, g,
+        lr_eff=opt.lr_at(st["step"] + 1), step=st["step"] + 1)
+    assert np.array_equal(np.asarray(new_t), np.asarray(p_ref["t"]))
+    for x, y in zip(jax.tree_util.tree_leaves(new_s),
+                    jax.tree_util.tree_leaves(s_ref["slots"]["t"])):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+    with open(os.path.join(out_dir, f"bitcheck-rank{rank}-ok"), "w") as f:
+        f.write("ok")
+
+    # ---- the supervised training run ----
+    uid = nn.data("uid", size=49, dtype="int32")
+    lab = nn.data("y", size=1)
+    emb = nn.embedding(uid, 8, name="u_emb", sparse_grad=True)
+    h = nn.fc(emb, 8, act="relu", name="h")
+    cost = nn.mse_cost(nn.fc(h, 1, act="linear", name="p"), lab,
+                       name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0, mesh=mesh)
+    assert tr.pserver is not None and "_u_emb.w0" not in tr.params
+
+    rs = np.random.RandomState(0)
+    feeds = [{"uid": rs.randint(0, 49, (8, 1)).astype(np.int32),
+              "y": rs.randn(8, 1).astype(np.float32)} for _ in range(6)]
+
+    losses = {}
+    def record(e):
+        if isinstance(e, ev.EndIteration):
+            losses[f"{e.pass_id}:{e.batch_id}"] = float(e.cost)
+
+    handler = record
+    marker = os.path.join(out_dir, "fault-fired")
+    if rank == int(chaos_rank) and mode == "kill":
+        handler = chaos.die_at(pass_id=1, batch=2, marker=marker,
+                               inner=record)
+
+    tr.train(lambda: iter(feeds), num_passes=3, event_handler=handler,
+             resume="auto")
+
+    with open(os.path.join(out_dir, f"losses-rank{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    if rank == 0:
+        np.savez(os.path.join(out_dir, "final-table-rank0.npz"),
+                 table=np.asarray(tr.pserver.tables["_u_emb.w0"].data))
+""")
+
+
+def _supervisor(n, script, args=(), **kw):
+    kw.setdefault("heartbeat_s", 0.2)
+    kw.setdefault("watchdog_s", 5.0)
+    kw.setdefault("startup_grace_s", 180.0)
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("env", {"PYTHONPATH": REPO_ROOT + os.pathsep
+                          + os.environ.get("PYTHONPATH", "")})
+    return GangSupervisor(["localhost"] * n, str(script), list(args), **kw)
+
+
+def _reference_run(monkeypatch):
+    """Uninterrupted oracle: same model/seed/feeds on the in-process
+    2-device mesh (first 2 of the 8 virtual devices — identical program)."""
+    from paddle_tpu.utils.devices import make_mesh
+
+    monkeypatch.setattr(FLAGS, "save_dir", "")
+    monkeypatch.setattr(FLAGS, "log_period", 0)
+    nn.reset_naming()
+    mesh = make_mesh((2,), ("model",))
+    uid = nn.data("uid", size=49, dtype="int32")
+    lab = nn.data("y", size=1)
+    emb = nn.embedding(uid, 8, name="u_emb", sparse_grad=True)
+    h = nn.fc(emb, 8, act="relu", name="h")
+    cost = nn.mse_cost(nn.fc(h, 1, act="linear", name="p"), lab,
+                       name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0, mesh=mesh)
+    rs = np.random.RandomState(0)
+    feeds = [{"uid": rs.randint(0, 49, (8, 1)).astype(np.int32),
+              "y": rs.randn(8, 1).astype(np.float32)} for _ in range(6)]
+    losses = {}
+
+    def record(e):
+        if isinstance(e, ev.EndIteration):
+            losses[f"{e.pass_id}:{e.batch_id}"] = float(e.cost)
+
+    tr.train(lambda: iter(feeds), num_passes=3, event_handler=record)
+    return losses, np.asarray(tr.pserver.tables["_u_emb.w0"].data)
+
+
+def test_kill_shard_rank_midpass_recovers_table_and_losses(
+        tmp_path, monkeypatch):
+    """THE pserver acceptance chaos proof: SIGKILL a random shard-hosting
+    rank mid-pass; the supervisor relaunches the gang, resume='auto'
+    restores the sharded tables from the checkpoint manifest, and the
+    completed run reproduces the uninterrupted losses AND final table."""
+    ref_losses, ref_table = _reference_run(monkeypatch)
+    victim = random.Random(0xBEEF).randrange(2)
+
+    script = tmp_path / "worker.py"
+    script.write_text(PSERVER_WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    sup = _supervisor(
+        2, script,
+        [str(tmp_path / "ckpts"), str(out_dir), "kill", str(victim)],
+        gang_dir=str(tmp_path / "gang"), max_restarts=2)
+    result = sup.run()
+
+    assert result.attempts == 2
+    assert (out_dir / "fault-fired").exists()
+    # the bit-identity acceptance ran on BOTH real ranks
+    assert (out_dir / "bitcheck-rank0-ok").exists()
+    assert (out_dir / "bitcheck-rank1-ok").exists()
+    victim_reports = [r for r in result.reports if r.rank == victim]
+    assert any(r.reason == "exit" and r.exit_code == -signal.SIGKILL
+               for r in victim_reports), result.reports
+
+    with open(out_dir / "losses-rank0.json") as f:
+        got = json.load(f)
+    assert "2:5" in got                        # ran to the end
+    for key, v in got.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    final = np.load(out_dir / "final-table-rank0.npz")["table"]
+    np.testing.assert_allclose(final, ref_table, rtol=1e-6, atol=1e-7)

@@ -6,8 +6,16 @@ model parallelism) with mesh shardings + XLA collectives, and adds the modern
 strategies the reference predates: tensor parallelism, sequence parallelism
 (ring attention), pipeline parallelism (GPipe over a 'stage' axis,
 ``pipeline.py``), sharded embeddings. See SURVEY.md §2 parallelism map & §5.8.
+
+The world shape lives in ONE object: :class:`MeshConfig` (``mesh.py``) —
+named axes + role bindings that every consumer here (and the pserver tier
+and the trainer) accepts wherever a ``jax.sharding.Mesh`` is expected.
+Elastic gang recovery (``resilience/cluster.py``) resizes the world by
+re-instantiating this one config (``cfg.fit_world(n)``); see
+docs/parallel.md.
 """
 
+from paddle_tpu.parallel.mesh import MeshConfig, as_mesh, mesh_axes
 from paddle_tpu.parallel.sharding import (
     ShardingRules,
     replicated,

@@ -16,14 +16,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel.mesh import as_mesh
 from paddle_tpu.parallel.sharding import ShardingRules, batch_sharding, replicated
 from paddle_tpu.param.optimizers import Optimizer
 
 __all__ = ["make_parallel_train_step", "shard_batch"]
 
 
-def shard_batch(mesh: Mesh, feed: Dict[str, Any], axis: str = "data") -> Dict[str, Any]:
-    """Place every array (or (value, lengths) tuple) batch-sharded on ``axis``."""
+def shard_batch(mesh, feed: Dict[str, Any], axis: str = "data") -> Dict[str, Any]:
+    """Place every array (or (value, lengths) tuple) batch-sharded on ``axis``.
+    ``mesh`` may be a ``Mesh`` or a ``parallel.MeshConfig``."""
+    mesh = as_mesh(mesh)
 
     def put(v):
         v = jnp.asarray(v)
@@ -38,13 +41,13 @@ def shard_batch(mesh: Mesh, feed: Dict[str, Any], axis: str = "data") -> Dict[st
 def make_parallel_train_step(
     loss_fn: Callable[[Dict[str, Any], Dict[str, Any]], jax.Array],
     optimizer: Optimizer,
-    mesh: Mesh,
+    mesh,
     *,
     rules: Optional[ShardingRules] = None,
     donate: bool = True,
 ) -> Callable:
     """Build ``step(params, opt_state, batch) -> (loss, params, opt_state)``
-    compiled SPMD over ``mesh``.
+    compiled SPMD over ``mesh`` (a ``Mesh`` or a ``parallel.MeshConfig``).
 
     ``loss_fn(params, batch) -> scalar`` must be pure. Params should be placed
     with ``shard_params(mesh, params, rules)`` and the batch with

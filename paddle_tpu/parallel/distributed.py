@@ -99,13 +99,26 @@ def is_multi_host() -> bool:
 
 
 def global_mesh(shape: Optional[Sequence[int]] = None,
-                axis_names: Optional[Sequence[str]] = None):
+                axis_names: Optional[Sequence[str]] = None,
+                config=None):
     """Mesh over ALL processes' devices. For pods, prefer putting the
     DCN-crossing axis ('data') first: intra-slice axes ride ICI, the
-    slice-crossing axis rides DCN (scaling-book recipe)."""
+    slice-crossing axis rides DCN (scaling-book recipe).
+
+    ``config`` (a :class:`paddle_tpu.parallel.MeshConfig`) is the
+    preferred spelling — one object describes the whole world and elastic
+    resize is ``config.fit_world(n).build()``; ``shape``/``axis_names``
+    remain as the legacy positional form (they build an ad-hoc config
+    from flags)."""
+    initialize_distributed()
+    if config is not None:
+        return config.build()
+    if shape is None and axis_names is None:
+        from paddle_tpu.parallel.mesh import MeshConfig
+
+        return MeshConfig.from_flags().build()
     from paddle_tpu.utils.devices import make_mesh
 
-    initialize_distributed()
     return make_mesh(shape, axis_names)
 
 

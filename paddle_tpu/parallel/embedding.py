@@ -32,15 +32,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["sharded_embedding_lookup", "shard_table"]
 
 
-def shard_table(mesh: Mesh, table, axis: str = "model", *,
+def shard_table(mesh, table, axis: str = "model", *,
                 pad: bool = True, name: str = "table"):
-    """Place a [V, D] table row-sharded over ``axis``.
+    """Place a [V, D] table row-sharded over ``axis``.  ``mesh`` may be a
+    ``Mesh`` or a ``parallel.MeshConfig``.
 
     V not dividing the axis size is padded up to a shard multiple with
     zero tail rows (they can never be looked up: ids are < V) — or raises
     a typed ``ConfigError`` naming the table when ``pad=False``."""
+    from paddle_tpu.parallel.mesh import as_mesh
     from paddle_tpu.pserver.table import pad_vocab
 
+    mesh = as_mesh(mesh)
     table = jnp.asarray(table)
     n = int(mesh.shape[axis])
     v = table.shape[0]
@@ -51,11 +54,12 @@ def shard_table(mesh: Mesh, table, axis: str = "model", *,
     return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
 
 
-def sharded_embedding_lookup(mesh: Mesh, table, ids, *, axis: str = "model"):
+def sharded_embedding_lookup(mesh, table, ids, *, axis: str = "model"):
     """table: [V_pad, D] sharded P(axis, None); ids: replicated int array.
     Returns [ids.shape..., D] embeddings via the balanced all-to-all
     exchange (see paddle_tpu/pserver/lookup.py).  Differentiable: the
     table cotangent is the row-sparse scatter-add, kept sharded."""
+    from paddle_tpu.parallel.mesh import as_mesh
     from paddle_tpu.pserver.lookup import all_to_all_lookup
 
-    return all_to_all_lookup(mesh, table, ids, axis=axis)
+    return all_to_all_lookup(as_mesh(mesh), table, ids, axis=axis)

@@ -94,6 +94,38 @@ class ClusterLauncher:
             host = f"[{host}]"
         return f"{host}:{self.coordinator_port}"
 
+    def _rank_spec(self, i: int, script: str, args: Sequence[str],
+                   env: Optional[Dict[str, str]], cwd: Optional[str],
+                   env_extra: Optional[Dict[str, str]] = None) -> Dict:
+        """Popen kwargs for one rank, with the distributed wiring (and any
+        ``env_extra`` overlay) injected — shared by ``launch`` and
+        ``relaunch_rank`` so a relaunched rank's ssh export string is
+        rebuilt, not replayed stale."""
+        wiring = {
+            "PADDLE_TPU_COORDINATOR": self._coordinator(),
+            "PADDLE_TPU_NUM_PROCESSES": str(len(self.hosts)),
+            "PADDLE_TPU_PROCESS_ID": str(i),
+        }
+        user, hname, port = _parse_host(self.hosts[i])
+        dest = f"{user}@{hname}" if user else hname
+        # an explicit :port on a local name means a forwarded sshd —
+        # honor it with ssh; only a bare local name forks directly
+        if hname in _LOCAL_HOSTS and port is None:
+            penv = {**os.environ, **(env or {}), **wiring,
+                    **(env_extra or {})}
+            return dict(args=[self.python, script, *args], env=penv,
+                        cwd=cwd)
+        q = shlex.quote
+        exports = " ".join(
+            f"{q(k)}={q(str(v))}"
+            for k, v in {**(env or {}), **wiring,
+                         **(env_extra or {})}.items())
+        remote = (f"cd {q(cwd or '.')} && env {exports} "
+                  f"{q(self.remote_python)} {q(script)} "
+                  + " ".join(q(str(a)) for a in args))
+        port_flag = ("-p", port) if port else ()
+        return dict(args=[*self.ssh_cmd, *port_flag, dest, remote])
+
     def launch(self, script: str, args: Sequence[str] = (),
                env: Optional[Dict[str, str]] = None,
                cwd: Optional[str] = None) -> List[subprocess.Popen]:
@@ -101,31 +133,10 @@ class ClusterLauncher:
         wiring injected; returns the Popen handles (remote ones wrap ssh)."""
         if self.procs:
             raise RuntimeError("launcher already started a job")
-        coord = self._coordinator()
+        self._job = (script, tuple(args), env, cwd)  # for relaunch_rank
         for i, host in enumerate(self.hosts):
-            wiring = {
-                "PADDLE_TPU_COORDINATOR": coord,
-                "PADDLE_TPU_NUM_PROCESSES": str(len(self.hosts)),
-                "PADDLE_TPU_PROCESS_ID": str(i),
-            }
-            user, hname, port = _parse_host(host)
-            dest = f"{user}@{hname}" if user else hname
-            # an explicit :port on a local name means a forwarded sshd —
-            # honor it with ssh; only a bare local name forks directly
-            if hname in _LOCAL_HOSTS and port is None:
-                penv = {**os.environ, **(env or {}), **wiring}
-                p = subprocess.Popen([self.python, script, *args],
-                                     env=penv, cwd=cwd)
-            else:
-                q = shlex.quote
-                exports = " ".join(
-                    f"{q(k)}={q(str(v))}"
-                    for k, v in {**(env or {}), **wiring}.items())
-                remote = (f"cd {q(cwd or '.')} && env {exports} "
-                          f"{q(self.remote_python)} {q(script)} "
-                          + " ".join(q(str(a)) for a in args))
-                port_flag = ("-p", port) if port else ()
-                p = subprocess.Popen([*self.ssh_cmd, *port_flag, dest, remote])
+            spec = self._rank_spec(i, script, args, env, cwd)
+            p = subprocess.Popen(**spec)
             logger.info("launched rank %d on %s (pid %d)", i, host or "local",
                         p.pid)
             self.procs.append(p)
@@ -134,6 +145,37 @@ class ClusterLauncher:
     def poll(self) -> List[Optional[int]]:
         """Non-blocking per-rank exit codes (None = still running)."""
         return [p.poll() for p in self.procs]
+
+    def kill_rank(self, rank: int, timeout: float = 10.0) -> Optional[int]:
+        """SIGKILL one rank and reap it (elastic shrink: the rest of the
+        gang stays up).  SIGKILL also takes down a SIGSTOPped rank, which
+        SIGTERM would not.  Returns its exit code."""
+        p = self.procs[rank]
+        if p.poll() is None:
+            p.kill()
+        try:
+            return p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return p.poll()
+
+    def relaunch_rank(self, rank: int,
+                      env_extra: Optional[Dict[str, str]] = None
+                      ) -> subprocess.Popen:
+        """Start a REPLACEMENT process for one (dead) rank with the same
+        command/wiring the original launch used — the elastic grow-back
+        primitive.  The old Popen at this index must already be reaped.
+        ``env_extra`` overlays the environment for BOTH local forks and
+        ssh ranks (the remote export string is rebuilt, not replayed) —
+        the supervisor uses it to hand a joiner its join epoch."""
+        if self.procs[rank].poll() is None:
+            raise RuntimeError(f"rank {rank} is still alive; kill it first")
+        script, args, env, cwd = self._job
+        spec = self._rank_spec(rank, script, args, env, cwd,
+                               env_extra=env_extra)
+        p = subprocess.Popen(**spec)
+        logger.info("relaunched rank %d (pid %d)", rank, p.pid)
+        self.procs[rank] = p
+        return p
 
     def kill_gang(self) -> List[Optional[int]]:
         """SIGKILL every rank and reap; returns the exit codes.  The gang

@@ -1,0 +1,245 @@
+"""MeshConfig — ONE declarative description of the device world.
+
+Before this module, every parallelism feature carried its own mesh/axis
+plumbing: ``distributed.global_mesh`` parsed flags, ``ShardingRules`` took
+a built ``Mesh``, the pipeline DSL took ``(mesh, stage_axis)``, ring
+attention took ``(mesh, seq_axis)``, and the pserver tier took
+``(mesh, axis)`` — five call sites that each privately knew part of the
+world shape (the same scatter the reference spread across
+``MultiGradientMachine`` and the trainer; PAPER.md layer map).
+
+``MeshConfig`` is the single place that knows the world shape: an ordered
+set of **named axes** with sizes, plus the role bindings (which axis is
+the data/batch axis, which carries tensor-parallel shards, which is the
+pipeline ``stage`` axis, which the pserver tables shard over).  Every
+consumer accepts a ``MeshConfig`` anywhere it previously took a ``Mesh``
+(``as_mesh`` materializes lazily), so changing the world is re-instanting
+ONE object — which is exactly what elastic gang recovery does on a host
+loss (``resilience/cluster.py``): ``cfg.fit_world(n)`` rescales the
+elastic (data) axis to the surviving device count and everything
+downstream (shardings, pipeline stages, pserver shard counts, checkpoint
+resharding) follows from the one new mesh.
+
+Checkpoints record ``cfg.to_json()`` in their manifest meta, so a restore
+onto a differently-sized world can see what shape the state was saved
+under — resharding then "falls out of the manifest": arrays are stored
+host-side and layout-free, and re-placement under the new config's
+shardings is the entire reshard (pserver tables additionally re-pad their
+vocab to the new shard multiple; ``pserver/tier.py``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = ["MeshConfig", "as_mesh", "mesh_axes"]
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Named-axis device mesh description + role bindings.
+
+    ``axes`` is an ordered ``((name, size), ...)`` tuple — order is the
+    device-assignment order of ``jax.sharding.Mesh`` (put the DCN-crossing
+    axis first on multi-slice pods, the scaling-book recipe).  Role fields
+    name which axis plays each part; a role whose axis is absent from
+    ``axes`` simply has size 1 (asking for it never errors — callers can
+    treat every config as carrying all four roles).
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+    data_axis: str = "data"        # batch sharding + gradient all-reduce
+    model_axis: str = "model"      # tensor-parallel weight shards
+    pipe_axis: str = "stage"       # GPipe pipeline stages
+    seq_axis: str = "seq"          # ring-attention sequence shards
+    pserver_axis: Optional[str] = None   # embedding-table shards
+                                         # (None = FLAGS.pserver_axis)
+    #: the axis elastic resize rescales (host loss shrinks the world along
+    #: this axis; grow-back restores it).  Defaults to ``data_axis``.
+    elastic_axis: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "axes",
+                           tuple((str(n), int(s)) for n, s in self.axes))
+        names = [n for n, _ in self.axes]
+        if len(set(names)) != len(names):
+            raise ConfigError(f"duplicate mesh axis names in {names}")
+        for n, s in self.axes:
+            if s < 1:
+                raise ConfigError(f"mesh axis {n!r} must have size >= 1, "
+                                  f"got {s}")
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def of(cls, **axis_sizes: int) -> "MeshConfig":
+        """``MeshConfig.of(data=4, model=2)`` — ordered as given."""
+        return cls(axes=tuple(axis_sizes.items()))
+
+    @classmethod
+    def named(cls, shape: Sequence[int],
+              axis_names: Optional[Sequence[str]] = None) -> "MeshConfig":
+        """Config from a shape plus optional names: names truncate to the
+        shape's rank, and a missing/mismatched list falls back to the
+        default ``data, model, seq, expert, stage`` prefix.  The ONE
+        naming rule — ``from_flags`` and ``utils.devices.make_mesh`` both
+        route through here."""
+        shape = tuple(int(s) for s in shape)
+        names = tuple(axis_names or ())[: len(shape)]
+        if len(names) != len(shape):
+            base = ("data", "model", "seq", "expert", "stage")
+            if len(shape) > len(base):
+                raise ConfigError(
+                    f"mesh shape {shape} has {len(shape)} dimensions but "
+                    f"only {len(base)} default axis names exist — pass "
+                    f"axis_names covering every dimension")
+            names = base[: len(shape)]
+        return cls(axes=tuple(zip(names, shape)))
+
+    @classmethod
+    def from_flags(cls, n_devices: Optional[int] = None) -> "MeshConfig":
+        """The flag plane (``--mesh_shape`` / ``--mesh_axes`` /
+        ``--pserver_axis``) as a config; empty ``--mesh_shape`` = one 1-D
+        data axis over all devices."""
+        from paddle_tpu.utils.flags import FLAGS
+
+        if n_devices is None:
+            import jax
+
+            n_devices = len(jax.devices())
+        from paddle_tpu.utils.devices import _parse_mesh_shape
+
+        cfg = cls.named(_parse_mesh_shape(FLAGS.mesh_shape, n_devices),
+                        FLAGS.mesh_axes.split(","))
+        return replace(cfg, pserver_axis=FLAGS.pserver_axis)
+
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshConfig":
+        """Describe an existing ``jax.sharding.Mesh``."""
+        return cls(axes=tuple((n, int(mesh.shape[n]))
+                              for n in mesh.axis_names))
+
+    # -- shape queries ---------------------------------------------------
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    @property
+    def size(self) -> int:
+        return math.prod(s for _, s in self.axes) if self.axes else 1
+
+    def axis_size(self, name: str) -> int:
+        """Size of axis ``name``; 1 when the axis is absent (a missing
+        axis IS a size-1 axis for every sharding purpose)."""
+        return self.shape.get(name, 1)
+
+    def role_axis(self, role: str) -> str:
+        """Axis name bound to ``role`` ('data'|'model'|'pipe'|'seq'|
+        'pserver')."""
+        if role == "pserver" and self.pserver_axis is None:
+            from paddle_tpu.utils.flags import FLAGS
+
+            return FLAGS.pserver_axis
+        name = getattr(self, f"{role}_axis")
+        if name is None:
+            raise ConfigError(f"unknown mesh role {role!r}")
+        return name
+
+    # -- resize (the elastic operation) ----------------------------------
+
+    def resize(self, **axis_sizes: int) -> "MeshConfig":
+        """New config with the named axes resized (axes not mentioned keep
+        their size; resizing an absent axis appends it)."""
+        known = dict(self.axes)
+        updated = tuple((n, axis_sizes.get(n, s)) for n, s in self.axes)
+        appended = tuple((n, s) for n, s in axis_sizes.items()
+                         if n not in known)
+        return replace(self, axes=updated + appended)
+
+    def fit_world(self, n_devices: int) -> "MeshConfig":
+        """Rescale the ELASTIC axis so the mesh fits ``n_devices``: the
+        other axes are fixed (model/pipe shards are topology, not
+        capacity), the elastic axis becomes ``n_devices // prod(others)``.
+        This is the one-call shrink/grow of elastic gang recovery."""
+        el = self.elastic_axis or self.data_axis
+        others = math.prod(s for n, s in self.axes if n != el)
+        new = n_devices // others
+        if new < 1:
+            raise ConfigError(
+                f"cannot fit mesh {dict(self.axes)} into {n_devices} "
+                f"device(s): non-elastic axes already need {others}")
+        return self.resize(**{el: new})
+
+    # -- materialization -------------------------------------------------
+
+    def build(self, devices: Optional[Sequence] = None):
+        """Instantiate the ``jax.sharding.Mesh`` over ``devices`` (default:
+        all).  The one place a config becomes hardware."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = list(devices) if devices is not None else jax.devices()
+        if self.size > len(devs):
+            raise ConfigError(
+                f"mesh {dict(self.axes)} needs {self.size} devices, "
+                f"have {len(devs)}")
+        shape = tuple(s for _, s in self.axes) or (1,)
+        names = self.axis_names or ("data",)
+        arr = np.asarray(devs[: math.prod(shape)]).reshape(shape)
+        return Mesh(arr, names)
+
+    # -- manifest plumbing -----------------------------------------------
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "axes": [[n, s] for n, s in self.axes],
+            "data_axis": self.data_axis,
+            "model_axis": self.model_axis,
+            "pipe_axis": self.pipe_axis,
+            "seq_axis": self.seq_axis,
+            "pserver_axis": self.pserver_axis,
+            "elastic_axis": self.elastic_axis,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "MeshConfig":
+        return cls(axes=tuple((n, int(s)) for n, s in d["axes"]),
+                   data_axis=d.get("data_axis", "data"),
+                   model_axis=d.get("model_axis", "model"),
+                   pipe_axis=d.get("pipe_axis", "stage"),
+                   seq_axis=d.get("seq_axis", "seq"),
+                   pserver_axis=d.get("pserver_axis"),
+                   elastic_axis=d.get("elastic_axis"))
+
+    def __repr__(self) -> str:
+        body = ",".join(f"{n}={s}" for n, s in self.axes)
+        return f"MeshConfig({body})"
+
+
+def as_mesh(mesh_or_config, devices: Optional[Sequence] = None):
+    """Materialize: a ``Mesh`` passes through, a ``MeshConfig`` builds,
+    ``None`` stays ``None``.  Every parallel consumer routes its ``mesh``
+    argument through here so call sites may hold the declarative config
+    instead of a bound device object."""
+    if mesh_or_config is None:
+        return None
+    if isinstance(mesh_or_config, MeshConfig):
+        return mesh_or_config.build(devices)
+    return mesh_or_config
+
+
+def mesh_axes(mesh_or_config) -> Tuple[str, ...]:
+    """Axis names of either form without materializing devices."""
+    if isinstance(mesh_or_config, MeshConfig):
+        return mesh_or_config.axis_names
+    return tuple(mesh_or_config.axis_names)

@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel import compat
+from paddle_tpu.parallel.mesh import as_mesh
 from paddle_tpu.param.optimizers import Optimizer
 
 __all__ = ["stack_stage_params", "shard_stage_params", "pipeline_apply",
@@ -57,10 +58,11 @@ def stack_stage_params(per_stage: Sequence[Any]):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
-def shard_stage_params(mesh: Mesh, stacked, *, stage_axis: str = "stage"):
+def shard_stage_params(mesh, stacked, *, stage_axis: str = "stage"):
     """Place a stage-stacked pytree with leading dim sharded over the stage
-    mesh axis (each device holds its own stage's weights)."""
-    sharding = NamedSharding(mesh, P(stage_axis))
+    mesh axis (each device holds its own stage's weights).  ``mesh`` may be
+    a ``Mesh`` or a ``parallel.MeshConfig``."""
+    sharding = NamedSharding(as_mesh(mesh), P(stage_axis))
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), stacked)
 
@@ -107,7 +109,7 @@ def _gpipe_local(stage_fn, w_stacked_local, x_mb, *, axis: str):
 
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
-                   stacked_params, x: Any, *, mesh: Mesh,
+                   stacked_params, x: Any, *, mesh,
                    n_microbatches: int, stage_axis: str = "stage",
                    data_axis: Optional[str] = None) -> Any:
     """Run ``x`` (array or pytree whose leaves all lead with [B, ...])
@@ -120,6 +122,7 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
     With ``data_axis`` the microbatch batch dim additionally shards over
     that mesh axis (dp x pp).  Fully differentiable — wrap in jax.grad for
     training."""
+    mesh = as_mesh(mesh)
     tmap = jax.tree_util.tree_map
     x_leaves = jax.tree_util.tree_leaves(x)
     B = x_leaves[0].shape[0]
@@ -156,7 +159,7 @@ def make_pipeline_train_step(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     loss_fn: Callable[[jax.Array, Any], jax.Array],
     optimizer: Optimizer,
-    mesh: Mesh,
+    mesh,
     *,
     n_microbatches: int,
     stage_axis: str = "stage",
@@ -168,6 +171,7 @@ def make_pipeline_train_step(
     update on the stage-sharded stacks.  ``loss_fn(y [B, ...], labels) ->
     scalar`` runs on the pipeline output (replicated over stage, sharded
     over data — GSPMD inserts the data-axis mean reduction)."""
+    mesh = as_mesh(mesh)
 
     def step(stacked_params, opt_state, x, labels):
         def objective(w):

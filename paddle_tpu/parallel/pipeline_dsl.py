@@ -67,8 +67,10 @@ class PipelinedTopology(Topology):
 
     def __init__(self, outputs, *, mesh, n_microbatches: int,
                  stage_axis: str = "stage", data_axis: Optional[str] = None):
+        from paddle_tpu.parallel.mesh import as_mesh
+
         super().__init__(outputs)
-        self.mesh = mesh
+        self.mesh = mesh = as_mesh(mesh)
         self.n_microbatches = n_microbatches
         self.stage_axis = stage_axis
         self.data_axis = data_axis

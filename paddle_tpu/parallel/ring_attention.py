@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel import compat
+from paddle_tpu.parallel.mesh import as_mesh
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
@@ -88,10 +89,12 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
+def ring_attention_sharded(q, k, v, mesh, *, seq_axis: str = "seq",
                            causal: bool = False):
     """User entry: q/k/v global [B,H,T,D]; runs ring attention with T sharded
-    over ``mesh`` axis ``seq_axis`` via shard_map."""
+    over ``mesh`` axis ``seq_axis`` via shard_map.  ``mesh`` may be a
+    ``Mesh`` or a ``parallel.MeshConfig``."""
+    mesh = as_mesh(mesh)
     spec = P(None, None, seq_axis, None)
 
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)

@@ -26,16 +26,19 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel.mesh import as_mesh
+
 __all__ = ["ShardingRules", "replicated", "batch_sharding", "shard_params", "P"]
 
 
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(as_mesh(mesh), P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
-    """Shard the leading (batch) dim over ``axis``; replicate the rest."""
-    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+def batch_sharding(mesh, ndim: int, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``; replicate the rest.
+    ``mesh`` may be a ``Mesh`` or a ``parallel.MeshConfig``."""
+    return NamedSharding(as_mesh(mesh), P(axis, *([None] * (ndim - 1))))
 
 
 class ShardingRules:
@@ -62,7 +65,8 @@ class ShardingRules:
                 return spec
         return P()
 
-    def shardings(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, NamedSharding]:
+    def shardings(self, mesh, params: Dict[str, Any]) -> Dict[str, NamedSharding]:
+        mesh = as_mesh(mesh)
         out = {}
         for name, p in params.items():
             ndim = getattr(p, "ndim", 0)
@@ -70,9 +74,11 @@ class ShardingRules:
         return out
 
 
-def shard_params(mesh: Mesh, params: Dict[str, Any],
+def shard_params(mesh, params: Dict[str, Any],
                  rules: Optional[ShardingRules] = None) -> Dict[str, Any]:
-    """device_put every param to its (rule-derived or replicated) sharding."""
+    """device_put every param to its (rule-derived or replicated) sharding.
+    ``mesh`` may be a ``Mesh`` or a ``parallel.MeshConfig``."""
+    mesh = as_mesh(mesh)
     if rules is None:
         repl = replicated(mesh)
         return {k: jax.device_put(v, repl) for k, v in params.items()}

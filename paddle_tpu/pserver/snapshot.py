@@ -210,6 +210,16 @@ def _apply_snap(table: np.ndarray, d: str) -> int:
         data = np.load(os.path.join(d, fname), allow_pickle=False)
         ids, rows = data["ids"], data["rows"]
         if ids.size:
+            # a chain may span WORLD SIZES (elastic resize changes the
+            # shard count, hence the padded vocab): true-row ids are
+            # world-independent, but an id past this table's rows means
+            # the chain and the spec genuinely disagree — typed error,
+            # never a silent wrap/scatter
+            if int(ids.max()) >= table.shape[0]:
+                raise SnapshotError(
+                    f"table snapshot {d}: {fname} carries row id "
+                    f"{int(ids.max())} beyond the table's {table.shape[0]} "
+                    "rows (spec/chain mismatch)")
             table[ids] = rows.astype(table.dtype)
             n += int(ids.size)
     return n

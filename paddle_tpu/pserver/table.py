@@ -126,8 +126,10 @@ class ShardedTable:
 
     def __init__(self, spec: TableSpec, mesh, *, axis: str = "model",
                  pad: bool = True, data=None, dirty=None) -> None:
+        from paddle_tpu.parallel.mesh import as_mesh
+
         self.spec = spec
-        self.mesh = mesh
+        self.mesh = mesh = as_mesh(mesh)
         self.axis = axis
         self.shards = int(mesh.shape[axis])
         self.vocab_padded = spec.padded_vocab(self.shards, pad=pad)

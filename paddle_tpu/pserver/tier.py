@@ -41,6 +41,19 @@ from paddle_tpu.utils import FLAGS, logger
 __all__ = ["PServerTier", "Route"]
 
 
+def _repad_rows(arr, vocab: int, v_pad_new: int):
+    """Carry a row-dimensioned array across a shard-count change: keep the
+    TRUE vocab rows, re-pad the tail with zeros to the new shard multiple.
+    Works for [V_pad, D] tables/slots and [V_pad] dirty masks alike; exact
+    because pad rows are zeros in every world (ids are always < vocab)."""
+    arr = arr[:vocab]
+    if v_pad_new > vocab:
+        pad = jnp.zeros((v_pad_new - vocab,) + tuple(arr.shape[1:]),
+                        arr.dtype)
+        arr = jnp.concatenate([arr, pad])
+    return arr
+
+
 class Route(NamedTuple):
     """One embedding layer routed through the tier."""
 
@@ -88,7 +101,11 @@ class PServerTier:
                  lr_scales: Optional[Dict[str, float]] = None,
                  decays: Optional[Dict[str, float]] = None,
                  seed: Optional[int] = None) -> None:
-        self.mesh = mesh
+        from paddle_tpu.parallel.mesh import MeshConfig, as_mesh
+
+        if axis is None and isinstance(mesh, MeshConfig):
+            axis = mesh.role_axis("pserver")
+        self.mesh = as_mesh(mesh)
         self.axis = axis or FLAGS.pserver_axis
         self.optimizer = optimizer
         self.lr_scales = dict(lr_scales or {})
@@ -149,12 +166,64 @@ class PServerTier:
 
     def adopt(self, state: Dict[str, Any]) -> None:
         """Take ownership of a step's output (or a loaded checkpoint's)
-        pserver pytree."""
+        pserver pytree.
+
+        Tolerates a WORLD-SIZE mismatch: a checkpoint taken under a
+        different shard count stores tables at a different padded vocab
+        ([V_pad_old, D]); the true rows carry over and the tail re-pads to
+        this mesh's shard multiple (pad rows are zeros in every world —
+        they can never be looked up or updated — so the reshard is
+        bit-exact; tests/test_elastic_reshard.py)."""
         self._step = state["step"]
+        new_slots: Dict[str, Any] = {}
         for k, t in self.tables.items():
-            t.data = state["tables"][k]
-            t.dirty = state["dirty"][k]
-        self._slots = dict(state["slots"])
+            data = jnp.asarray(state["tables"][k])
+            v_in = int(data.shape[0])
+            if v_in == t.vocab_padded:
+                t.data = data
+                t.dirty = state["dirty"][k]
+                new_slots[k] = state["slots"][k]
+                continue
+            logger.info(
+                "pserver: resharding table %r from padded vocab %d to %d "
+                "(%d shards)", k, v_in, t.vocab_padded, t.shards)
+            t.data = _repad_rows(data, t.spec.vocab, t.vocab_padded)
+            t.dirty = _repad_rows(
+                jnp.asarray(state["dirty"][k], jnp.bool_),
+                t.spec.vocab, t.vocab_padded)
+            new_slots[k] = jax.tree_util.tree_map(
+                lambda s: (_repad_rows(jnp.asarray(s), t.spec.vocab,
+                                       t.vocab_padded)
+                           if getattr(s, "shape", None) is not None
+                           and jnp.ndim(s) >= 1
+                           and int(jnp.shape(s)[0]) == v_in else s),
+                state["slots"][k])
+        self._slots = new_slots
+
+    def resize(self, mesh) -> None:
+        """Re-instantiate every table on a NEW mesh (the elastic resize:
+        the pserver-axis size — hence shard count and padded vocab — may
+        change).  Live rows, dirty bits, and optimizer slots carry over
+        via ``_repad_rows``; nothing is re-initialized."""
+        from paddle_tpu.parallel.mesh import as_mesh
+
+        mesh = as_mesh(mesh)
+        state = self.state()
+        self.mesh = mesh
+        for pname, old in list(self.tables.items()):
+            # adopt() below overwrites data/dirty/slots from ``state``
+            # (the same repad path a cross-world checkpoint load takes),
+            # so hand the constructor the old rows as-is — each table is
+            # copied ONCE, not twice, inside the latency-sensitive
+            # resize window
+            self.tables[pname] = ShardedTable(
+                old.spec, mesh, axis=self.axis, data=old.data,
+                dirty=None)
+        # adopt() re-pads the carried rows, dirty bits, and slots into the
+        # new shard multiple; place() re-pins everything to the new
+        # mesh's shardings
+        self.adopt(state)
+        self.place()
 
     def place(self) -> None:
         """Re-pin every leaf to its sharding (after checkpoint load)."""

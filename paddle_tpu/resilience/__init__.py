@@ -28,8 +28,8 @@ tier of the trainer survivable (docs/resilience.md):
 """
 
 from paddle_tpu.resilience.errors import (CheckpointError, GangError,
-                                          GangFailedError, ReaderError,
-                                          TooManyBadSteps)
+                                          GangFailedError, GangResized,
+                                          ReaderError, TooManyBadSteps)
 from paddle_tpu.resilience.cluster import (GangContext, GangResult,
                                            GangSupervisor, RankReport,
                                            current_gang)
@@ -55,6 +55,7 @@ __all__ = [
     "TooManyBadSteps",
     "GangError",
     "GangFailedError",
+    "GangResized",
     "GangContext",
     "GangResult",
     "GangSupervisor",

@@ -62,8 +62,10 @@ __all__ = [
     "preempt_at",
     "kill_rank",
     "hang_rank",
+    "slow_rank",
     "die_at",
     "stall_at",
+    "die_during_resize",
     "kill_worker",
     "latency_injection",
     "crash_calls",
@@ -245,6 +247,35 @@ def _procs_of(gang):
     return list(gang)
 
 
+def slow_rank(gang, rank: int, *, stop_s: float = 5.0) -> "object":
+    """The slow-host fault: SIGSTOP one rank NOW, SIGCONT it after
+    ``stop_s`` seconds (daemon timer).  While stopped the rank is alive
+    but heartbeat-silent — with an ELASTIC supervisor this must trigger a
+    shrink to the survivors once the watchdog fires and, because the
+    supervisor kills the wedged rank before publishing the smaller world,
+    a grow-back with a fresh replacement afterwards (shrink *then* grow —
+    never a whole-gang relaunch).  The delayed SIGCONT covers the
+    other half of the model: a rank that un-wedges AFTER being expelled
+    must find itself fenced out (killed), not half-participating.
+    Returns the timer (cancel() for deterministic teardown)."""
+    import threading
+
+    procs = _procs_of(gang)
+    pid = procs[rank].pid
+    os.kill(pid, _signal.SIGSTOP)
+
+    def resume():
+        try:
+            os.kill(pid, _signal.SIGCONT)
+        except (ProcessLookupError, OSError):
+            pass  # the supervisor already expelled (killed) it
+
+    t = threading.Timer(stop_s, resume)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def die_at(*, batch: int, pass_id: int = 0, marker: str,
            inner: Optional[Callable] = None,
            sig: int = _signal.SIGKILL) -> Callable:
@@ -292,6 +323,28 @@ def stall_at(*, batch: int, pass_id: int = 0, marker: str,
 # ---------------------------------------------------------------------------
 # serving faults (paddle_tpu/serving; docs/serving.md)
 # ---------------------------------------------------------------------------
+
+
+def die_during_resize(*, marker: str, inner: Optional[Callable] = None,
+                      sig: int = _signal.SIGKILL) -> Callable:
+    """Worker-side event handler: SIGKILL THIS rank the moment an elastic
+    resize begins on it (the ``ev.Resize`` event fires at the drain point,
+    BEFORE the checkpoint-commit/barrier) — the survivor-dies-mid-reshard
+    fault.  The supervisor sees a death while resize acks are pending and
+    MUST fall back to the whole-gang relaunch (``resize_fallbacks``),
+    bounded by the existing restart/backoff budget.  Marker-guarded like
+    ``die_at`` so the relaunched incarnation survives."""
+    from paddle_tpu.trainer import events as ev
+
+    def event_handler(e):
+        if isinstance(e, ev.Resize) and not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("died-during-resize\n")
+            os.kill(os.getpid(), sig)
+        if inner is not None:
+            inner(e)
+
+    return event_handler
 
 
 def kill_worker(server) -> None:

@@ -44,15 +44,18 @@ Supervisor state machine (docs/resilience.md "Multi-host recovery")::
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import random as _random
 import shutil
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
-from paddle_tpu.resilience.errors import GangError, GangFailedError
+from paddle_tpu.resilience.errors import (GangError, GangFailedError,
+                                          GangResized)
 from paddle_tpu.utils import FLAGS, logger
 
 __all__ = [
@@ -69,6 +72,9 @@ _ENV_DIR = "PADDLE_TPU_GANG_DIR"          # per-ATTEMPT shared directory
 _ENV_SIZE = "PADDLE_TPU_GANG_SIZE"
 _ENV_RANK = "PADDLE_TPU_GANG_RANK"        # falls back to _PROCESS_ID
 _ENV_HEARTBEAT = "PADDLE_TPU_GANG_HEARTBEAT_S"
+_ENV_EPOCH = "PADDLE_TPU_GANG_EPOCH"      # join epoch of an elastic joiner
+
+_WORLD_FILE = "world.json"                # supervisor-published membership
 
 _POLL_S = 0.02
 
@@ -91,10 +97,11 @@ class GangContext:
 
     def __init__(self, gang_dir: str, rank: int, size: int,
                  heartbeat_s: Optional[float] = None,
-                 barrier_timeout_s: float = 600.0) -> None:
+                 barrier_timeout_s: float = 600.0,
+                 epoch: int = 0) -> None:
         self.gang_dir = gang_dir
         self.rank = int(rank)
-        self.size = int(size)
+        self.size = int(size)          # CONFIGURED world size (full gang)
         self.heartbeat_s = (FLAGS.gang_heartbeat_s if heartbeat_s is None
                             else float(heartbeat_s))
         self.barrier_timeout_s = float(barrier_timeout_s)
@@ -102,10 +109,35 @@ class GangContext:
         self._hb_count = 0
         self._hb_last = 0.0
         self._preempt_flagged = False
+        # -- elastic world state (docs/resilience.md "Elastic gang") -----
+        # epoch 0 = the configured full world; the supervisor publishes
+        # world.json with a higher epoch on every shrink/grow.  A JOINER
+        # (launched after a resize) is handed its join epoch via env and
+        # adopts the published world at construction.
+        self.epoch = 0
+        self.ranks: List[int] = list(range(self.size))
+        self.coordinator = 0
+        self._resizing = False
+        if epoch > 0:
+            world = self._read_world()
+            if world is None or int(world.get("epoch", -1)) < epoch:
+                raise GangError(
+                    f"rank {self.rank}: launched as an epoch-{epoch} joiner "
+                    f"but {_WORLD_FILE} is missing or older")
+            self.adopt_world(world)
 
     @property
     def is_coordinator(self) -> bool:
-        return self.rank == 0
+        return self.rank == self.coordinator
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def degraded(self) -> bool:
+        """True while the live world is smaller than the configured one."""
+        return len(self.ranks) < self.size
 
     # -- heartbeat -------------------------------------------------------
 
@@ -126,31 +158,106 @@ class GangContext:
             return
         self._hb_last = now
 
+    # -- elastic world membership ---------------------------------------
+
+    def _read_world(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.gang_dir, _WORLD_FILE)) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            return None
+
+    def poll_world(self) -> Optional[Dict[str, Any]]:
+        """The supervisor's published world, iff its epoch is NEWER than
+        the one this rank lives in; None otherwise.  Called at every batch
+        boundary and from inside barrier waits.  Deliberately NOT
+        mtime-gated: two publishes can land within one filesystem
+        timestamp tick (shrink immediately followed by grow-back), and a
+        gated poll would miss the second forever — the file is ~200 bytes,
+        a read per boundary costs the same as the heartbeat touch."""
+        world = self._read_world()
+        if world is None or int(world.get("epoch", -1)) <= self.epoch:
+            return None
+        return world
+
+    def peek_world(self) -> Dict[str, Any]:
+        """Read-only view of the LATEST world: the published one when its
+        epoch is newer than what this rank has adopted, else the adopted
+        state.  Observability surfaces (serving ``healthz()``) report the
+        supervisor's truth through this even though they never run the
+        resize protocol themselves; never adopts, never acks."""
+        world = self._read_world()
+        if world is not None and int(world.get("epoch", -1)) > self.epoch:
+            ranks = sorted(int(r) for r in world["ranks"])
+            return {"epoch": int(world["epoch"]), "ranks": ranks,
+                    "coordinator": int(world.get("coordinator", ranks[0]))}
+        return {"epoch": self.epoch, "ranks": list(self.ranks),
+                "coordinator": self.coordinator}
+
+    def adopt_world(self, world: Dict[str, Any]) -> None:
+        """Enter the published epoch: new membership, new coordinator, and
+        a FRESH barrier sequence (barrier files are epoch-namespaced, so
+        rendezvous state can never leak across a resize)."""
+        self.epoch = int(world["epoch"])
+        self.ranks = sorted(int(r) for r in world["ranks"])
+        self.coordinator = int(world.get("coordinator", self.ranks[0]))
+        self._barrier_seq = 0
+        logger.info("rank %d: adopted gang epoch %d (ranks %s, "
+                    "coordinator %d)", self.rank, self.epoch, self.ranks,
+                    self.coordinator)
+
+    def ack_resize(self) -> None:
+        """Tell the supervisor this rank completed the resize protocol for
+        the current epoch (drained, committed, re-instantiated)."""
+        _atomic_write(os.path.join(
+            self.gang_dir,
+            f"resize-ack-e{self.epoch:03d}-rank{self.rank}"), "1")
+
+    @contextlib.contextmanager
+    def resizing(self):
+        """Suppress GangResized inside the resize protocol itself: the
+        grow path barriers under the OLD membership while the NEW world is
+        already published — that barrier must complete, not abort."""
+        self._resizing = True
+        try:
+            yield
+        finally:
+            self._resizing = False
+
     # -- barrier ---------------------------------------------------------
 
     def barrier(self, timeout_s: Optional[float] = None) -> None:
-        """Sequence-numbered all-ranks barrier.
+        """Sequence-numbered all-CURRENT-ranks barrier.
 
         Every rank executes the SAME sequence of barrier calls (the saves
         of a deterministic training loop), so a plain per-process counter
-        names each rendezvous.  Waiting ranks keep heartbeating — a slow
-        checkpoint write on rank 0 must not read as a hang."""
+        names each rendezvous; names carry the world epoch so a resized
+        gang can never be released by a previous incarnation's arrival
+        files.  Waiting ranks keep heartbeating — a slow checkpoint write
+        on rank 0 must not read as a hang — and keep watching the world:
+        a resize published while this rank waits (its partner just died)
+        raises :class:`GangResized` so the trainer can run the elastic
+        protocol instead of timing out."""
         n = self._barrier_seq
         self._barrier_seq += 1
-        me = os.path.join(self.gang_dir, f"barrier-{n:05d}-rank{self.rank}")
-        _atomic_write(me, "1")
+        stem = f"barrier-e{self.epoch:03d}-{n:05d}-rank"
+        _atomic_write(os.path.join(self.gang_dir, f"{stem}{self.rank}"), "1")
         deadline = time.monotonic() + (self.barrier_timeout_s
                                        if timeout_s is None else timeout_s)
-        want = [os.path.join(self.gang_dir, f"barrier-{n:05d}-rank{r}")
-                for r in range(self.size)]
+        want = [os.path.join(self.gang_dir, f"{stem}{r}")
+                for r in self.ranks]
         while True:
             if all(os.path.exists(p) for p in want):
                 return
+            if not self._resizing:
+                world = self.poll_world()
+                if world is not None:
+                    raise GangResized(world)
             if time.monotonic() > deadline:
                 raise GangError(
-                    f"rank {self.rank}: barrier {n} timed out after "
-                    f"{self.barrier_timeout_s:.0f}s — a peer likely died "
-                    "(the supervisor will relaunch the gang)")
+                    f"rank {self.rank}: barrier e{self.epoch}/{n} timed out "
+                    f"after {self.barrier_timeout_s:.0f}s — a peer likely "
+                    "died (the supervisor will relaunch the gang)")
             self.heartbeat()
             time.sleep(_POLL_S)
 
@@ -181,8 +288,12 @@ class GangContext:
         rank blocks (heartbeating) until it appears and returns it.  The
         resume-decision plane: ``latest_valid_pass`` resolves on the
         coordinator and the gang follows, never a locally-newer pass a
-        peer cannot see."""
-        path = os.path.join(self.gang_dir, f"pub-{name}.json")
+        peer cannot see.  Decisions are epoch-namespaced past epoch 0 —
+        an elastic joiner must receive the decision published FOR its join
+        epoch, never the original launch's."""
+        stem = (f"pub-{name}.json" if self.epoch == 0
+                else f"pub-{name}-e{self.epoch:03d}.json")
+        path = os.path.join(self.gang_dir, stem)
         if self.is_coordinator:
             _atomic_write(path, json.dumps(obj))
             return obj
@@ -214,10 +325,42 @@ class _JaxGang:
         self.rank = jax.process_index()
         self.size = jax.process_count()
         self._seq = 0
+        # elastic surface parity: live pods have no supervisor publishing
+        # world files — resizing a jax.distributed world requires a control
+        # plane re-init the platform owns, so the world here is static
+        self.epoch = 0
+        self.ranks = list(range(self.size))
+        self.coordinator = 0
 
     @property
     def is_coordinator(self) -> bool:
         return self.rank == 0
+
+    @property
+    def world_size(self) -> int:
+        return self.size
+
+    @property
+    def degraded(self) -> bool:
+        return False
+
+    def poll_world(self):
+        return None
+
+    def peek_world(self):
+        return {"epoch": self.epoch, "ranks": list(self.ranks),
+                "coordinator": self.coordinator}
+
+    def adopt_world(self, world) -> None:
+        raise GangError("a live jax.distributed pod cannot adopt a new "
+                        "world in place — the platform relaunches it")
+
+    def ack_resize(self) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def resizing(self):
+        yield
 
     def heartbeat(self, *, force: bool = False) -> None:
         pass
@@ -271,7 +414,8 @@ def current_gang():
         size = int(os.environ.get(_ENV_SIZE, "1"))
         hb = os.environ.get(_ENV_HEARTBEAT)
         return GangContext(gang_dir, rank, size,
-                           heartbeat_s=float(hb) if hb else None)
+                           heartbeat_s=float(hb) if hb else None,
+                           epoch=int(os.environ.get(_ENV_EPOCH, "0")))
     import sys
 
     jax = sys.modules.get("jax")
@@ -309,6 +453,13 @@ class GangResult:
 
     attempts: int
     reports: List[RankReport] = field(default_factory=list)
+    # elastic counters (docs/resilience.md "Elastic gang"): how many times
+    # the mesh shrank to survivors, grew back, and how often a failure
+    # DURING a resize forced the whole-gang relaunch fallback
+    shrinks: int = 0
+    grows: int = 0
+    resize_fallbacks: int = 0
+    last_resize_reason: Optional[str] = None
 
 
 class GangSupervisor:
@@ -343,11 +494,17 @@ class GangSupervisor:
         startup_grace_s: Optional[float] = None,
         backoff_s: float = 1.0,
         max_backoff_s: float = 30.0,
+        backoff_jitter: Optional[float] = None,
         poll_s: float = 0.05,
         coordinator_port: Optional[Callable[[], int]] = None,
         sleep: Callable[[float], None] = time.sleep,
         on_restart: Optional[Callable[["GangSupervisor", int], None]] = None,
         tick: Optional[Callable[["GangSupervisor", int, float], None]] = None,
+        elastic: Optional[bool] = None,
+        min_ranks: Optional[int] = None,
+        grow_back: Optional[bool] = None,
+        resize_timeout_s: Optional[float] = None,
+        rng: Optional[_random.Random] = None,
     ) -> None:
         self.hosts = list(hosts)
         self.script = script
@@ -369,15 +526,41 @@ class GangSupervisor:
                                 else float(startup_grace_s))
         self.backoff_s = float(backoff_s)
         self.max_backoff_s = float(max_backoff_s)
+        self.backoff_jitter = (FLAGS.gang_backoff_jitter
+                               if backoff_jitter is None
+                               else float(backoff_jitter))
         self.poll_s = float(poll_s)
         self._port = coordinator_port
         self._sleep = sleep
         self._on_restart = on_restart
         self._tick = tick
+        # elastic mode (docs/resilience.md "Elastic gang"): shrink the
+        # gang to the survivors on a rank failure instead of relaunching
+        # the world; grow back when a replacement registers
+        self.elastic = (FLAGS.gang_elastic if elastic is None
+                        else bool(elastic))
+        self.min_ranks = (FLAGS.gang_min_ranks if min_ranks is None
+                          else int(min_ranks))
+        self.grow_back = (FLAGS.gang_grow_back if grow_back is None
+                          else bool(grow_back))
+        self.resize_timeout_s = (FLAGS.gang_resize_timeout_s
+                                 if resize_timeout_s is None
+                                 else float(resize_timeout_s))
+        self._rng = rng or _random.Random()
+        self.shrinks = 0
+        self.grows = 0
+        self.resize_fallbacks = 0
+        self.last_resize_reason: Optional[str] = None
         self.reports: List[RankReport] = []
         self.launcher = None           # live ClusterLauncher, for chaos hooks
         self.attempt_dir: Optional[str] = None
         self._created_dirs: List[str] = []
+        # per-attempt elastic world state (reset by _launch)
+        self.world_epoch = 0
+        self.active: Set[int] = set(range(len(self.hosts)))
+        self.coordinator = 0
+        self._pending: Optional[Dict[str, Any]] = None
+        self._rank_start: Dict[int, float] = {}
 
     # -- one attempt -----------------------------------------------------
 
@@ -399,6 +582,13 @@ class GangSupervisor:
         }
         launcher.launch(self.script, self.args, env=env, cwd=self.cwd)
         self.launcher = launcher
+        # fresh attempt = fresh full world at epoch 0
+        now = time.monotonic()
+        self.world_epoch = 0
+        self.active = set(range(len(self.hosts)))
+        self.coordinator = 0
+        self._pending = None
+        self._rank_start = {r: now for r in range(len(self.hosts))}
         return launcher
 
     def _hb_age(self, rank: int, now: float) -> Optional[float]:
@@ -412,28 +602,74 @@ class GangSupervisor:
 
     def _monitor(self, launcher, attempt: int,
                  deadline: Optional[float]) -> Optional[List[RankReport]]:
-        """Poll until success (returns None) or failure (rank reports)."""
+        """Poll until success (returns ``[]``) or failure (rank reports).
+
+        Elastic mode intercepts the failure path: instead of returning the
+        culprits (which makes ``run()`` kill and relaunch the world), the
+        gang SHRINKS to the survivors — the culprits are killed, a new
+        world is published, and the monitor waits for every survivor's
+        resize ack; once acked, lost ranks are relaunched and the world
+        GROWS back.  Any failure while a resize is pending — a survivor
+        dying mid-reshard, acks not arriving inside the resize budget —
+        falls back to returning reports, i.e. the classic whole-gang
+        relaunch bounded by the existing restart/backoff budget."""
         t0 = time.monotonic()
         drain_since = None   # first time we saw a partial zero-exit gang
         while True:
             codes = launcher.poll()
-            if all(c == 0 for c in codes):
+            active = sorted(self.active)
+            if all(codes[r] == 0 for r in active):
                 return []
-            dead = [(r, c) for r, c in enumerate(codes)
-                    if c is not None and c != 0]
-            if dead:
-                return [
-                    RankReport(attempt, r, launcher.procs[r].pid, c, "exit")
-                    for r, c in dead
-                ]
+            dead = [(r, codes[r]) for r in active
+                    if codes[r] is not None and codes[r] != 0]
             now = time.monotonic()
             elapsed = now - t0
+            wall = time.time()
+            failed = [
+                RankReport(attempt, r, launcher.procs[r].pid, c, "exit")
+                for r, c in dead
+            ]
+            for r in active:
+                if codes[r] is not None:   # exited 0, waiting on peers
+                    continue
+                age = self._hb_age(r, wall)
+                started = now - self._rank_start.get(r, t0)
+                if age is None:
+                    if started > self.startup_grace_s:
+                        failed.append(RankReport(
+                            attempt, r, launcher.procs[r].pid, None,
+                            "hung (no heartbeat after startup grace)",
+                            stale_s=started))
+                elif age > self.watchdog_s:
+                    failed.append(RankReport(
+                        attempt, r, launcher.procs[r].pid, None, "hung",
+                        stale_s=age))
+            if failed:
+                if self._pending is not None:
+                    # mid-resize failure: the new path must never be less
+                    # safe than the old one — whole-gang relaunch fallback
+                    self.resize_fallbacks += 1
+                    kind = self._pending["kind"]
+                    for f in failed:
+                        f.reason += f" (during {kind} resize: fallback)"
+                    logger.warning("gang %s resize failed (%s): falling "
+                                   "back to whole-gang relaunch", kind,
+                                   "; ".join(f.describe() for f in failed))
+                    return failed
+                survivors = self.active - {f.rank for f in failed}
+                if self.elastic and len(survivors) >= self.min_ranks:
+                    self._begin_shrink(launcher, attempt, failed)
+                    drain_since = None
+                    continue
+                return failed
             # straggler drain: some ranks exited 0 while peers run on.  A
             # peer blocked in a barrier whose partner is gone heartbeats
             # while it waits (slow saves must not read as hangs), so
             # neither the death poll nor the staleness watchdog would ever
-            # fire — bound the inconsistency with the same watchdog budget
-            if any(c == 0 for c in codes):
+            # fire — bound the inconsistency with the same watchdog budget.
+            # Suspended while a resize is pending: a grow's join barrier
+            # legitimately holds survivors while the joiner warms up.
+            if self._pending is None and any(codes[r] == 0 for r in active):
                 if drain_since is None:
                     drain_since = now
                 elif now - drain_since > self.watchdog_s:
@@ -441,27 +677,62 @@ class GangSupervisor:
                         attempt, r, launcher.procs[r].pid, None,
                         "straggler (peers already exited)",
                         stale_s=now - drain_since)
-                        for r, c in enumerate(codes) if c is None]
+                        for r in active if codes[r] is None]
             else:
                 drain_since = None
-            wall = time.time()
-            hung = []
-            for r, c in enumerate(codes):
-                if c is not None:      # exited 0, waiting on peers
-                    continue
-                age = self._hb_age(r, wall)
-                if age is None:
-                    if elapsed > self.startup_grace_s:
-                        hung.append(RankReport(
-                            attempt, r, launcher.procs[r].pid, None,
-                            "hung (no heartbeat after startup grace)",
-                            stale_s=elapsed))
-                elif age > self.watchdog_s:
-                    hung.append(RankReport(
-                        attempt, r, launcher.procs[r].pid, None, "hung",
-                        stale_s=age))
-            if hung:
-                return hung
+            if self._pending is not None:
+                if self._acks_done(self._pending):
+                    kind = self._pending["kind"]
+                    self._pending = None
+                    if kind == "shrink":
+                        self.shrinks += 1
+                        logger.info("gang shrink complete (epoch %d, %d "
+                                    "rank(s))", self.world_epoch,
+                                    len(self.active))
+                        if self.grow_back and (
+                                self.active != set(range(len(self.hosts)))):
+                            self._begin_grow(launcher, attempt)
+                    else:
+                        self.grows += 1
+                        logger.info("gang grow-back complete (epoch %d, %d "
+                                    "rank(s))", self.world_epoch,
+                                    len(self.active))
+                elif (self._pending["kind"] == "grow"
+                      and self._pending["survivors"]
+                      and all(codes[r] == 0
+                              for r in self._pending["survivors"])
+                      and not any(self._acked(self._pending["epoch"], r)
+                                  for r in self._pending["survivors"])):
+                    # every survivor finished training and exited before a
+                    # batch-boundary poll could see the grow publish: no
+                    # coordinator is left to publish the join-epoch resume
+                    # decision, so the joiners can never complete — but
+                    # training itself IS done.  Retire the joiners and let
+                    # the attempt succeed instead of burning the resize
+                    # budget and relaunching a finished job.
+                    joiners = sorted(self._pending["joiners"])
+                    for r in joiners:
+                        self.reports.append(RankReport(
+                            attempt, r, launcher.procs[r].pid,
+                            launcher.kill_rank(r),
+                            "joiner retired (peers finished before the "
+                            "grow)"))
+                        self.active.discard(r)
+                    logger.info("gang grow-back abandoned (epoch %d): "
+                                "peers finished; joiner(s) %s retired",
+                                self._pending["epoch"], joiners)
+                    self._pending = None
+                elif now > self._pending["deadline"]:
+                    self.resize_fallbacks += 1
+                    kind = self._pending["kind"]
+                    missing = [r for r in self._pending["acks"]
+                               if not self._acked(self._pending["epoch"], r)]
+                    return [RankReport(
+                        attempt, r, launcher.procs[r].pid, codes[r],
+                        f"{kind} resize timed out (no ack): fallback",
+                        stale_s=now - (self._pending["deadline"]
+                                       - self._pending["budget"]))
+                        for r in missing]
             if deadline is not None and now > deadline:
                 raise GangFailedError(
                     f"gang did not complete within the deadline "
@@ -470,6 +741,82 @@ class GangSupervisor:
             if self._tick is not None:
                 self._tick(self, attempt, elapsed)
             self._sleep(self.poll_s)
+
+    # -- elastic resize (supervisor half) --------------------------------
+
+    def _publish_world(self, reason: str) -> None:
+        """Advance the epoch and atomically publish the new membership;
+        survivors adopt it at their next batch boundary (or from inside a
+        blocked barrier via GangResized)."""
+        self.world_epoch += 1
+        if self.coordinator not in self.active:
+            self.coordinator = min(self.active)
+        world = {"epoch": self.world_epoch,
+                 "ranks": sorted(self.active),
+                 "coordinator": self.coordinator,
+                 "size": len(self.hosts),
+                 "reason": reason}
+        _atomic_write(os.path.join(self.attempt_dir, _WORLD_FILE),
+                      json.dumps(world))
+        self.last_resize_reason = reason
+
+    def _begin_shrink(self, launcher, attempt: int,
+                      failed: List[RankReport]) -> None:
+        """Remove the culprits from the world: make sure they are REALLY
+        dead (SIGKILL reaps a SIGSTOPped/wedged rank too — a half-alive
+        host must never write into the new epoch), publish the shrunken
+        membership, and expect a resize ack from every survivor."""
+        culprits = sorted({f.rank for f in failed})
+        for r in culprits:
+            launcher.kill_rank(r)
+            self.active.discard(r)
+        for f in failed:
+            f.reason += " (elastic shrink)"
+        self.reports.extend(failed)
+        reason = "shrink: " + "; ".join(f.describe() for f in failed)
+        self._publish_world(reason)
+        budget = self.resize_timeout_s or max(2 * self.watchdog_s, 30.0)
+        self._pending = {"kind": "shrink", "epoch": self.world_epoch,
+                         "acks": set(self.active), "budget": budget,
+                         "deadline": time.monotonic() + budget}
+        logger.warning("gang elastic shrink to %d rank(s) (epoch %d): %s",
+                       len(self.active), self.world_epoch, reason)
+
+    def _begin_grow(self, launcher, attempt: int) -> None:
+        """Relaunch a replacement for every lost rank and publish the full
+        world; survivors commit a checkpoint at their next batch boundary
+        and the joiners restore it via the epoch's resume decision.  Acks
+        from the WHOLE world (survivors + joiners) complete the grow."""
+        missing = sorted(set(range(len(self.hosts))) - self.active)
+        self.active |= set(missing)
+        self._publish_world(f"grow-back: ranks {missing} rejoin")
+        now = time.monotonic()
+        for r in missing:
+            try:   # a stale heartbeat from the dead incarnation must not
+                   # make the joiner look hung before its first touch
+                os.remove(os.path.join(self.attempt_dir, f"hb-rank{r}"))
+            except OSError:
+                pass
+            launcher.relaunch_rank(
+                r, env_extra={_ENV_EPOCH: str(self.world_epoch)})
+            self._rank_start[r] = now
+        budget = (self.resize_timeout_s
+                  or self.startup_grace_s + 2 * self.watchdog_s)
+        self._pending = {"kind": "grow", "epoch": self.world_epoch,
+                         "acks": set(self.active), "budget": budget,
+                         "deadline": now + budget,
+                         "joiners": set(missing),
+                         "survivors": set(self.active) - set(missing)}
+        logger.info("gang grow-back launched (epoch %d): ranks %s "
+                    "rejoining", self.world_epoch, missing)
+
+    def _acked(self, epoch: int, rank: int) -> bool:
+        return os.path.exists(os.path.join(
+            self.attempt_dir, f"resize-ack-e{epoch:03d}-rank{rank}"))
+
+    def _acks_done(self, pending: Dict[str, Any]) -> bool:
+        return all(self._acked(pending["epoch"], r)
+                   for r in pending["acks"])
 
     # -- the restart loop ------------------------------------------------
 
@@ -487,18 +834,26 @@ class GangSupervisor:
                 launcher.kill_gang()
                 raise
             if not failed:
-                launcher.wait(timeout=60)
-                logger.info("gang attempt %d: all %d ranks exited 0",
-                            attempt, len(self.hosts))
+                for r in sorted(self.active):
+                    launcher.procs[r].wait(timeout=60)
+                logger.info("gang attempt %d: all %d active ranks exited 0",
+                            attempt, len(self.active))
                 self._scrub_attempt_dirs()
-                return GangResult(attempts=attempt + 1, reports=self.reports)
+                return GangResult(attempts=attempt + 1, reports=self.reports,
+                                  shrinks=self.shrinks, grows=self.grows,
+                                  resize_fallbacks=self.resize_fallbacks,
+                                  last_resize_reason=self.last_resize_reason)
             # attribute the peers that the gang kill takes down with it
+            # (only ACTIVE peers — ranks already shrunk away carry their
+            # own elastic-shrink report)
             culprits = {f.rank for f in failed}
             self.reports.extend(failed)
-            for r, c in enumerate(launcher.poll()):
+            codes = launcher.poll()
+            for r in sorted(self.active):
                 if r not in culprits:
                     self.reports.append(RankReport(
-                        attempt, r, launcher.procs[r].pid, c, "gang-killed"))
+                        attempt, r, launcher.procs[r].pid, codes[r],
+                        "gang-killed"))
             logger.warning("gang attempt %d failed: %s", attempt,
                            "; ".join(f.describe() for f in failed))
             launcher.kill_gang()
@@ -511,6 +866,11 @@ class GangSupervisor:
             if self._on_restart is not None:
                 self._on_restart(self, attempt)
             delay = min(self.backoff_s * (2.0 ** attempt), self.max_backoff_s)
+            # jitter: many gangs sharing one scheduler (or one storage
+            # tier) must not relaunch in lockstep after a correlated
+            # failure — draw uniformly from [(1-jitter)*delay, delay]
+            if self.backoff_jitter:
+                delay *= 1.0 - self.backoff_jitter * self._rng.random()
             logger.info("gang restart %d/%d in %.1fs", attempt + 1,
                         self.max_restarts, delay)
             self._sleep(delay)

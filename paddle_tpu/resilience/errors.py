@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 __all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps",
-           "GangError", "GangFailedError"]
+           "GangError", "GangFailedError", "GangResized"]
 
 
 class CheckpointError(RuntimeError):
@@ -40,6 +40,22 @@ class GangError(RuntimeError):
     or coordinator-broadcast timed out (a peer likely died mid-protocol).
     The supervisor treats the resulting nonzero exit like any rank death
     and relaunches the gang."""
+
+
+class GangResized(Exception):
+    """Control-flow signal, not a failure: the supervisor published a new
+    world while this rank was blocked in a gang barrier (typically the
+    save barrier — waiting on a peer that just died).  Carries the new
+    ``world`` dict; the trainer catches it at its save sites and runs the
+    elastic resize protocol instead of waiting out the barrier timeout.
+    A rank that does not catch it exits nonzero and the supervisor falls
+    back to the whole-gang relaunch — never less safe than the old path.
+    """
+
+    def __init__(self, world: dict) -> None:
+        super().__init__(f"gang resized to epoch {world.get('epoch')}: "
+                         f"ranks {world.get('ranks')}")
+        self.world = dict(world)
 
 
 class GangFailedError(RuntimeError):

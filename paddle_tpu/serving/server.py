@@ -55,6 +55,8 @@ from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
                                        WorkerCrashed)
 from paddle_tpu.serving.metrics import ServerMetrics
 from paddle_tpu.serving.worker import WorkerSupervisor
+from paddle_tpu.resilience.cluster import current_gang as _current_gang
+from paddle_tpu.resilience.errors import GangError
 from paddle_tpu.utils.log import logger
 
 __all__ = ["InferenceServer"]
@@ -163,6 +165,7 @@ class InferenceServer:
                                for i in range(n)]
         self._service_ema: Optional[float] = None  # seconds per batch
         self._feeder = None   # attach_feeder(): healthz surfaces its drops
+        self._gang = None     # healthz(): resolved once, lazily
         self._state = self.RUNNING
         self._ready = False
         self._fail_reason: Optional[str] = None
@@ -842,6 +845,32 @@ class InferenceServer:
         if self._feeder is not None:
             out["dropped_features"] = int(
                 getattr(self._feeder, "dropped_features", 0))
+        if self._gang is None:
+            # resolved ONCE and cached: for an elastic-joiner replica
+            # (epoch env > 0) GangContext.__init__ re-validates world.json
+            # and raises when the attempt dir was swept — the health probe
+            # must report that, never throw it.  A failed resolve retries
+            # on the next call (the file may be momentarily unreadable).
+            try:
+                self._gang = _current_gang()
+            except GangError as e:
+                out["gang"] = {"error": f"{type(e).__name__}: {e}"}
+        gang = self._gang
+        if gang is not None:
+            # a supervised serving replica surfaces its gang's elastic
+            # state: how big the live world is, whether it is running
+            # degraded, and which epoch it lives in.  peek_world() folds
+            # in a published-but-not-adopted shrink/grow — a replica
+            # never runs the resize protocol itself, but its healthz
+            # must not report the construction-time world forever.
+            view = gang.peek_world()
+            out["gang"] = {
+                "world_size": len(view["ranks"]),
+                "configured_size": gang.size,
+                "degraded": len(view["ranks"]) < gang.size,
+                "epoch": view["epoch"],
+                "coordinator": view["coordinator"],
+            }
         if self._scheduler is not None:
             sched = self._scheduler
             occupied = sched.occupied()

@@ -10,7 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration", "TestResult"]
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult", "Resize"]
 
 
 @dataclass
@@ -43,3 +44,18 @@ class TestResult:
     pass_id: int
     cost: float
     evaluator: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Resize:
+    """Elastic gang resize in progress at a batch boundary: the rank is
+    about to drain, commit, and re-enter the published world (docs/
+    resilience.md "Elastic gang").  Fired BEFORE the commit so handlers
+    (and the chaos harness's ``die_during_resize``) observe the protocol
+    window; ``grew`` distinguishes grow-back from shrink."""
+
+    pass_id: int
+    batch_id: int
+    epoch: int
+    world_size: int
+    grew: bool

@@ -26,8 +26,9 @@ import numpy as np
 
 from paddle_tpu.nn.graph import LayerOutput, Topology
 from paddle_tpu.param.optimizers import Optimizer, ParameterAverager, SGD
-from paddle_tpu.resilience import (PreemptionHandler, ReaderError,
-                                   TooManyBadSteps, guarded_update)
+from paddle_tpu.resilience import (GangResized, PreemptionHandler,
+                                   ReaderError, TooManyBadSteps,
+                                   guarded_update)
 from paddle_tpu.resilience.checkpoint_io import (latest_pass, load_checkpoint,
                                                  read_manifest, pass_dir,
                                                  save_checkpoint)
@@ -62,6 +63,17 @@ class SGDTrainer:
         # several costs train jointly (MultiNetwork analog,
         # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
         # (weighted) sum, parameters shared by name across sub-networks
+        from paddle_tpu.parallel.mesh import MeshConfig, as_mesh
+
+        # ONE world description: a parallel.MeshConfig is accepted wherever
+        # a built Mesh is; keeping the config around is what makes elastic
+        # resize possible (re-instantiate the config at the new world size
+        # and re-place — _mesh_resize)
+        self.mesh_config = mesh if isinstance(mesh, MeshConfig) else None
+        mesh = as_mesh(mesh)
+        if self.mesh_config is not None and data_axis == "data":
+            data_axis = self.mesh_config.data_axis
+
         costs = [cost] if isinstance(cost, LayerOutput) else list(cost)
         self.cost_names = [c.name for c in costs]
         self.cost_weights = list(cost_weights) if cost_weights else [1.0] * len(costs)
@@ -128,11 +140,14 @@ class SGDTrainer:
         # dense on one host (docs/pserver.md)
         self.pserver = None
         routed = set()
+        ps_axis = (self.mesh_config.role_axis("pserver")
+                   if self.mesh_config is not None else FLAGS.pserver_axis)
         if (mesh is not None and self.sparse_rows
-                and FLAGS.pserver_axis in mesh.axis_names):
+                and ps_axis in mesh.axis_names):
             from paddle_tpu.pserver import PServerTier
 
             tier = PServerTier(mesh, self.topology, self.optimizer,
+                               axis=ps_axis,
                                lr_scales=self.lr_scales, decays=self.decays,
                                seed=seed)
             if tier.active:
@@ -166,6 +181,10 @@ class SGDTrainer:
         self._bad_streak = 0
         # gang context (resilience/cluster.py) — bound per train() call
         self._gang = None
+        # elastic-resize observability (mirrored into _last_extras and,
+        # for supervised serving replicas, healthz())
+        self._resize_count = 0
+        self._last_resize_reason: Optional[str] = None
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -423,6 +442,16 @@ class SGDTrainer:
         if self.averager is not None:
             self.avg_params = self.averager.update(self.avg_params, self.params)
         self._last_extras = extras
+        if self._gang is not None:
+            # elastic observability: the live world, whether it is running
+            # degraded (fewer ranks than configured), and the resize story
+            self._last_extras = {
+                **self._last_extras,
+                "world_size": self._gang.world_size,
+                "degraded": self._gang.degraded,
+                "resize_count": self._resize_count,
+                "last_resize_reason": self._last_resize_reason,
+            }
         if self.guard_nonfinite and "bad_step" in extras:
             if bool(jax.device_get(extras["bad_step"])):
                 self.bad_steps_total += 1
@@ -493,10 +522,16 @@ class SGDTrainer:
         gang = self._gang = current_gang()
         resume = resume or FLAGS.resume or None
         start_pass, start_batch = FLAGS.start_pass, 0
-        if resume == "auto":
-            start_pass, start_batch = self._auto_resume()
-        elif resume is not None:
+        if resume is not None and resume != "auto":
             raise ValueError(f"resume must be None or 'auto', got {resume!r}")
+        if gang is not None and gang.size > 1 and gang.epoch > 0:
+            # elastic JOINER: rendezvous with the survivors regardless of
+            # resume mode or save_dir — the grow must complete (and the
+            # survivors' join barrier release) even when there is nothing
+            # durable to restore
+            start_pass, start_batch = self._gang_join(gang)
+        elif resume == "auto":
+            start_pass, start_batch = self._auto_resume()
         if (preemption is None and FLAGS.save_dir
                 and FLAGS.checkpoint_on_preemption):
             preemption = PreemptionHandler()
@@ -540,8 +575,21 @@ class SGDTrainer:
                         # stuck in a collective stops heartbeating here
                         # and the supervisor's watchdog gang-restarts it
                         gang.heartbeat()
+                        # elastic resize (docs/resilience.md): a published
+                        # world change is adopted HERE, at the batch
+                        # boundary — the natural drain point.  While the
+                        # reader is still fast-forwarding (skip > 0) the
+                        # params already include every batch up to
+                        # batch_id + skip — recording the skip cursor
+                        # instead would make a restore re-apply batches
+                        # the state has already seen
+                        world = gang.poll_world()
+                        if world is not None:
+                            self._gang_resize(gang, world, pass_id,
+                                              batch_id + skip, handler)
                     if preemption is not None and preemption.poll():
-                        self._preempt_exit(pass_id, batch_id, preemption)
+                        self._preempt_exit(pass_id, batch_id + skip,
+                                           preemption, handler)
                         return
                     with timer("DataWaitTimer"):
                         try:
@@ -607,7 +655,36 @@ class SGDTrainer:
                     (pass_id + 1) % FLAGS.saving_period == 0
                 ):
                     with timer("SaveCheckpoint"):
-                        self.save(FLAGS.save_dir, pass_id)
+                        try:
+                            self.save(FLAGS.save_dir, pass_id)
+                        except GangResized as e:
+                            # a peer died while this rank waited in the
+                            # save barrier; the resize commit below IS the
+                            # end-of-pass checkpoint
+                            self._gang_resize(gang, e.world, pass_id,
+                                              None, handler)
+            if gang is not None and num_passes > start_pass:
+                # one last look before returning — and, while the gang is
+                # running DEGRADED, a bounded linger.  The supervisor
+                # publishes the grow-back within its poll cadence of the
+                # last survivor's shrink ack; a survivor that exits inside
+                # that window strands the joiner with no coordinator to
+                # publish its join-epoch resume decision (the supervisor
+                # would have to retire it).  Lingering a few seconds makes
+                # the grow deterministic; a supervisor with grow_back off
+                # just costs each survivor one bounded wait at the very
+                # end of training.
+                linger_until = time.monotonic() + 5.0
+                while True:
+                    world = gang.poll_world()
+                    if world is not None:
+                        self._gang_resize(gang, world, num_passes - 1,
+                                          None, handler)
+                        linger_until = time.monotonic() + 5.0
+                    if not gang.degraded or time.monotonic() > linger_until:
+                        break
+                    gang.heartbeat()
+                    time.sleep(0.05)
         finally:
             if profiling:
                 jax.profiler.stop_trace()
@@ -615,14 +692,23 @@ class SGDTrainer:
                 preemption.uninstall()
 
     def _preempt_exit(self, pass_id: int, batch_id: int,
-                      preemption: PreemptionHandler) -> None:
+                      preemption: PreemptionHandler,
+                      handler: Optional[Callable] = None) -> None:
         """Preemption landed: persist an atomically-written mid-pass
         checkpoint (manifest records ``next_batch`` so ``resume="auto"``
         re-enters this pass at this exact batch) and return cleanly."""
         self.preempted = True
         if FLAGS.save_dir:
-            d = self.save(FLAGS.save_dir, pass_id,
-                          meta={"preempted": True, "next_batch": batch_id})
+            try:
+                d = self.save(FLAGS.save_dir, pass_id,
+                              meta={"preempted": True, "next_batch": batch_id})
+            except GangResized as e:
+                # the gang resized under the preemption save; the resize
+                # commit records the SAME resume point, so it doubles as
+                # the preemption checkpoint
+                self._gang_resize(self._gang, e.world, pass_id, batch_id,
+                                  handler)
+                d = pass_dir(FLAGS.save_dir, pass_id)
             logger.warning(
                 "preemption: checkpoint saved to %s (pass %d, next batch "
                 "%d); exiting", d, pass_id, batch_id)
@@ -630,6 +716,102 @@ class SGDTrainer:
             logger.warning(
                 "preemption requested but --save_dir is unset: exiting "
                 "WITHOUT a checkpoint")
+
+    # -- elastic gang resize (worker half; docs/resilience.md) -----------
+
+    def _gang_resize(self, gang, world: Dict[str, Any], pass_id: int,
+                     next_batch: Optional[int],
+                     handler: Optional[Callable] = None) -> None:
+        """Carry this rank through one published world change, at a batch
+        boundary (the drain point): barriered checkpoint-commit →
+        re-instantiate the (one) mesh → resume.
+
+        ``next_batch`` is the resume position inside ``pass_id`` (None =
+        the pass just completed).  Shrink or grow, the new membership is
+        adopted FIRST and the commit barriers under the NEW epoch (seq 0
+        of its fresh barrier sequence): on a shrink that is the
+        survivors; on a grow the joiner pairs the same barrier from
+        ``_gang_join``, then the coordinator publishes the epoch's
+        resume decision for it.  Adopt-first means a resize never
+        consumes old-epoch barriers — a peer that was still blocked in a
+        normal save barrier when the world changed aborts it via
+        ``GangResized`` and re-enters here, landing on the SAME new-epoch
+        commit barrier instead of desynchronizing the sequence.  Any
+        failure in here surfaces as a nonzero exit and the supervisor
+        falls back to the whole-gang relaunch."""
+        new_ranks = sorted(int(r) for r in world["ranks"])
+        grew = bool(set(new_ranks) - set(gang.ranks))
+        epoch = int(world["epoch"])
+        if handler is not None:
+            handler(ev.Resize(pass_id,
+                              -1 if next_batch is None else next_batch,
+                              epoch, len(new_ranks), grew))
+        meta: Dict[str, Any] = {"resize_epoch": epoch,
+                                "resize_reason": world.get("reason", "")}
+        if next_batch is None:
+            start = (pass_id + 1, 0)
+        else:
+            meta.update(preempted=True, next_batch=next_batch)
+            start = (pass_id, next_batch)
+        with gang.resizing():
+            gang.adopt_world(world)
+            self._resize_commit(gang, pass_id, meta)
+            if grew and gang.is_coordinator:
+                gang.broadcast_json(
+                    {"pass": pass_id if FLAGS.save_dir else -1,
+                     "start_pass": start[0], "start_batch": start[1]},
+                    name="resume")
+            gang.ack_resize()
+        self._mesh_resize()
+        self._resize_count += 1
+        self._last_resize_reason = world.get("reason")
+        logger.warning(
+            "elastic resize: %s to %d rank(s) (epoch %d) at pass %d%s — %s",
+            "grew" if grew else "shrank", len(new_ranks), epoch, pass_id,
+            "" if next_batch is None else f" batch {next_batch}",
+            world.get("reason", ""))
+
+    def _resize_commit(self, gang, pass_id: int, meta: Dict[str, Any]):
+        """The drain's durable point: a normal (barriered, rank-0-publish)
+        checkpoint — the state a joiner restores and a mid-resize failure
+        falls back to.  Without a save_dir there is nothing durable to
+        commit; the gang still rendezvouses so the resize stays barriered."""
+        if FLAGS.save_dir:
+            return self.save(FLAGS.save_dir, pass_id, meta=meta)
+        gang.barrier()
+        return None
+
+    def _mesh_resize(self) -> None:
+        """Re-instantiate the ONE MeshConfig for the current device world
+        and re-place all state under the new shardings.
+
+        On a supervised CPU gang every rank owns a single-process device
+        world (this backend has no cross-process collectives), so the
+        local mesh shape is unchanged and this is a no-op — resizing is
+        purely membership.  On a live multi-host mesh the relaunched
+        control plane exposes fewer (or restored) devices and the same
+        call path rebuilds the mesh + re-places params/opt-state/pserver
+        tables; checkpoint resharding needs no extra code because arrays
+        are stored host-side and layout-free (the manifest records the
+        mesh config for attribution — see tests/test_elastic_reshard.py)."""
+        if self.mesh_config is None or self.mesh is None:
+            return
+        import jax as _jax
+
+        cfg = self.mesh_config.fit_world(len(_jax.devices()))
+        if cfg.shape == {n: int(self.mesh.shape[n])
+                         for n in self.mesh.axis_names}:
+            return
+        # the config IS the world shape: keep it current so every
+        # post-resize checkpoint manifest records the shape the state was
+        # actually saved under, not the launch-time one
+        self.mesh_config = cfg
+        self.mesh = cfg.build()
+        if self.pserver is not None:
+            self.pserver.resize(self.mesh)
+        self._place_sharded()
+        self._step = self._build_step()
+        logger.info("mesh re-instantiated: %r", cfg)
 
     def _auto_resume(self) -> tuple:
         """Locate the newest valid checkpoint under FLAGS.save_dir and
@@ -667,9 +849,43 @@ class SGDTrainer:
         logger.info("resume=auto: resuming after completed pass %d", p)
         return p + 1, 0
 
+    def _gang_join(self, gang) -> tuple:
+        """Elastic JOINER's half of the grow (docs/resilience.md): pair
+        the survivors' resize-commit barrier (their FIRST barrier of this
+        epoch — the adopt-first protocol in ``_gang_resize`` runs the
+        commit under the NEW membership, joiner included), then follow
+        the decision the coordinator publishes AFTER that commit
+        (``broadcast_json`` epoch-namespaces the file), restore the
+        committed checkpoint when there is one (pass -1 = no save_dir:
+        nothing durable, membership only), and ack the grow — from that
+        point this rank is an ordinary gang member.
+
+        The barrier MUST come before the decision read: the decision is
+        published only once the commit barrier releases, and that barrier
+        waits for this rank — reading first would deadlock every grow
+        into the whole-gang-relaunch fallback.
+
+        Runs from ``train()`` for EVERY epoch>0 launch, independent of
+        resume mode and save_dir — the survivors block in the commit
+        barrier, so a joiner that skipped the rendezvous would time every
+        grow out into the whole-gang-relaunch fallback."""
+        gang.barrier()
+        decision = gang.broadcast_json(None, name="resume")
+        p = int(decision["pass"])
+        if p >= 0:
+            # the coordinator validated its OWN view of the resize commit,
+            # not this rank's — CRC-verify on load
+            self.load(FLAGS.save_dir, p, validate=True)
+        gang.ack_resize()
+        self._resize_count += 1
+        self._last_resize_reason = "joined"
+        return int(decision["start_pass"]), int(decision["start_batch"])
+
     def _gang_auto_resume(self, gang, save_dir: str) -> tuple:
         """Coordinator resolves ``latest_valid_pass`` and broadcasts the
-        decision; every rank restores that exact pass."""
+        decision; every rank restores that exact pass.  (An elastic
+        joiner never reaches this — ``train()`` routes epoch>0 launches
+        through ``_gang_join`` first.)"""
         if gang.is_coordinator:
             p = latest_pass(save_dir)
             if p < 0:
@@ -823,6 +1039,14 @@ class SGDTrainer:
             return pass_dir(save_dir, pass_id)
         meta = dict(meta or {})
         meta.setdefault("rng_key", self._rng_to_list(self._rng))
+        if self.mesh_config is not None:
+            # record the world shape the state was saved under, so a
+            # restore onto a different world can attribute the reshard
+            # (the reshard itself needs no translation: arrays are stored
+            # host-side and layout-free)
+            meta.setdefault("mesh", self.mesh_config.to_json())
+        if gang is not None:
+            meta.setdefault("world_size", gang.world_size)
         extra = {}
         if self.avg_params is not None:
             extra["avg_params"] = self.avg_params

@@ -151,24 +151,17 @@ def make_mesh(
     trainers-by-pservers network topology (pserver/): on TPU the set of chips is
     one SPMD mesh and collectives ride ICI.
     """
-    import numpy as np
     import jax
-    from jax.sharding import Mesh
 
+    # one implementation of flag parsing, name defaulting, and device
+    # reshaping: the declarative config plane (parallel/mesh.py) — this
+    # stays the legacy Mesh-returning entry point over it
+    from paddle_tpu.parallel.mesh import MeshConfig
     from paddle_tpu.utils.flags import FLAGS
 
     devs = jax.devices()
     if shape is None:
         shape = _parse_mesh_shape(FLAGS.mesh_shape, len(devs))
     if axis_names is None:
-        axis_names = tuple(FLAGS.mesh_axes.split(","))[: len(shape)]
-    shape = tuple(shape)
-    if len(axis_names) != len(shape):
-        # default names data, model, seq, ... truncated/extended to rank
-        base = ("data", "model", "seq", "expert", "stage")
-        axis_names = base[: len(shape)]
-    n = int(np.prod(shape))
-    if n > len(devs):
-        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devs)}")
-    arr = np.asarray(devs[:n]).reshape(shape)
-    return Mesh(arr, axis_names)
+        axis_names = FLAGS.mesh_axes.split(",")
+    return MeshConfig.named(shape, axis_names).build(devs)

@@ -212,6 +212,29 @@ define_flag("gang_watchdog_s", 60.0, "gang supervisor: a rank whose "
             "heartbeat is older than N seconds is declared hung and the "
             "gang is restarted (JAX collectives deadlock, not error, when "
             "a peer dies)")
+define_flag("gang_elastic", False, "elastic gang recovery: a dead or hung "
+            "rank SHRINKS the surviving gang's device mesh (drain -> "
+            "checkpoint-commit -> re-instantiate MeshConfig -> resume "
+            "mid-pass) instead of relaunching the whole gang; the world "
+            "GROWS back the same way when a replacement registers.  A "
+            "failure during the resize itself falls back to the classic "
+            "whole-gang relaunch within --gang_max_restarts")
+define_flag("gang_min_ranks", 1, "elastic gang: never shrink below N "
+            "surviving ranks — fewer survivors fall back to the "
+            "whole-gang relaunch",
+            validator=lambda v: v >= 1)
+define_flag("gang_grow_back", True, "elastic gang: after a shrink "
+            "completes, relaunch a replacement for each lost rank and "
+            "grow the mesh back at the survivors' next batch boundary")
+define_flag("gang_resize_timeout_s", 0.0, "elastic gang: budget for the "
+            "survivors' shrink/grow protocol (drain + checkpoint-commit + "
+            "barriers) before the supervisor falls back to the whole-gang "
+            "relaunch; 0 = derived from the watchdog/startup budgets")
+define_flag("gang_backoff_jitter", 0.5, "gang supervisor: restart backoff "
+            "is drawn uniformly from [(1-jitter)*delay, delay] so many "
+            "gangs sharing a scheduler never relaunch in lockstep "
+            "(thundering herd); 0 = deterministic backoff",
+            validator=lambda v: 0.0 <= v <= 1.0)
 
 # Serving runtime (paddle_tpu/serving; docs/serving.md) — the
 # `python -m paddle_tpu serve` surface

@@ -1,0 +1,194 @@
+"""Checkpoint resharding across an elastic world-size change.
+
+The elastic gang (docs/resilience.md) shrinks or grows the device world by
+re-instantiating ONE ``MeshConfig``; the checkpoint needs no translation
+step because arrays are stored host-side and layout-free — "resharding
+falls out of the manifest".  These tests pin that contract end to end on
+the 8-virtual-device CPU mesh:
+
+- save under world=4, restore under world=2 AND world=8: dense params and
+  optimizer state restore bit-identical, and the pserver tables (data,
+  optimizer slots, dirty bits) are BIT-identical to a fresh same-size
+  shard of the full saved state — true vocab rows carried over, tail
+  re-padded with zeros to the new shard multiple;
+- the manifest meta records the MeshConfig the state was saved under
+  (attribution for the reshard);
+- training resumed at the new world size matches a same-checkpoint resume
+  at the original world;
+- a corrupt checkpoint member surfaces as the typed ``CheckpointError``
+  naming the failing member, not as a garbled restore.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.parallel import MeshConfig
+from paddle_tpu.resilience import CheckpointError
+from paddle_tpu.resilience.checkpoint_io import pass_dir, read_manifest
+from paddle_tpu.trainer import SGDTrainer
+from tests.conftest import on_accelerator
+
+pytestmark = pytest.mark.skipif(
+    on_accelerator(), reason="assumes the 8-virtual-device CPU mesh")
+
+# 50 rows never divides evenly across every world: padded vocab is 50 at
+# 1–2 shards, 52 at 4, 56 at 8 — every resize below actually re-pads.
+VOCAB, DIM = 50, 16
+TABLE = "_u_emb.w0"
+
+
+# ---------------------------------------------------------------------------
+# MeshConfig: the resize/fit_world algebra (no devices needed)
+# ---------------------------------------------------------------------------
+
+
+def test_meshconfig_resize_and_fit_world():
+    cfg = MeshConfig.of(data=4, model=2)
+    assert cfg.size == 8 and cfg.axis_names == ("data", "model")
+    # fit_world rescales ONLY the elastic (data) axis; order is preserved
+    assert cfg.fit_world(4).shape == {"data": 2, "model": 2}
+    assert cfg.fit_world(16).axes == (("data", 8), ("model", 2))
+    # resize keeps unmentioned axes
+    assert cfg.resize(model=4).shape == {"data": 4, "model": 4}
+    # a missing axis is a size-1 axis for every query
+    assert cfg.axis_size("stage") == 1
+    from paddle_tpu.utils.error import ConfigError
+    with pytest.raises(ConfigError, match="cannot fit"):
+        cfg.fit_world(1)                   # model=2 is topology, not capacity
+
+
+def test_meshconfig_resize_absent_axis_appends():
+    """Regression: resizing (or fit_world-ing) an axis that is absent from
+    ``axes`` must APPEND it, not crash with 'duplicate mesh axis names'."""
+    cfg = MeshConfig.of(model=2)
+    grown = cfg.resize(data=4)
+    assert grown.axes == (("model", 2), ("data", 4))
+    # fit_world on a config without its elastic axis takes the same path
+    assert MeshConfig.of(model=2).fit_world(4).shape == {"model": 2,
+                                                         "data": 2}
+
+
+def test_meshconfig_json_roundtrip():
+    cfg = MeshConfig.of(data=2, model=4).resize(model=2)
+    back = MeshConfig.from_json(cfg.to_json())
+    assert back == cfg and back.axes == (("data", 2), ("model", 2))
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+def _net():
+    uid = nn.data("uid", size=VOCAB, dtype="int32")
+    lab = nn.data("y", size=1)
+    emb = nn.embedding(uid, DIM, name="u_emb", sparse_grad=True)
+    h = nn.fc(emb, 8, act="relu", name="h")
+    return nn.mse_cost(nn.fc(h, 1, act="linear", name="p"), lab,
+                       name="cost")
+
+
+def _feeds(rng, n=3, b=16):
+    return [{"uid": rng.randint(0, VOCAB, (b, 1)).astype(np.int32),
+             "y": rng.randn(b, 1).astype(np.float32)} for _ in range(n)]
+
+
+def _trainer(world: int, seed: int) -> SGDTrainer:
+    """A trainer whose whole world is the pserver axis: ``world`` is the
+    table shard count, so resizing it changes the padded vocab."""
+    nn.reset_naming()
+    cfg = MeshConfig.of(model=world)
+    return SGDTrainer(_net(), Adam(learning_rate=0.05), seed=seed,
+                      mesh=cfg)
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_resharded(new_leaf: np.ndarray, old_leaf: np.ndarray,
+                      v_pad_new: int, name: str):
+    """``new_leaf`` must be the fresh same-size shard of ``old_leaf``:
+    identical true-vocab rows, zero tail padding, new padded length."""
+    assert new_leaf.shape[0] == v_pad_new, name
+    np.testing.assert_array_equal(new_leaf[:VOCAB], old_leaf[:VOCAB],
+                                  err_msg=name)
+    np.testing.assert_array_equal(
+        new_leaf[VOCAB:], np.zeros_like(new_leaf[VOCAB:]), err_msg=name)
+
+
+@pytest.mark.parametrize("world_new", [2, 8])
+def test_save_world4_restore_other_world_bit_exact(rng, tmp_path,
+                                                   world_new):
+    t4 = _trainer(4, seed=5)
+    for f in _feeds(rng):
+        t4.train_batch(f)
+    t4.save(str(tmp_path), 0)
+
+    # the manifest records the world shape the state was saved under
+    meta = read_manifest(pass_dir(str(tmp_path), 0))["meta"]
+    assert meta["mesh"]["axes"] == [["model", 4]]
+
+    t = _trainer(world_new, seed=99)        # different seed: nothing carries
+    assert t.pserver.tables[TABLE].vocab_padded != \
+        t4.pserver.tables[TABLE].vocab_padded
+    t.load(str(tmp_path), 0)
+
+    # dense params + optimizer state are layout-free: bit-identical
+    for k, a in t4.params.items():
+        np.testing.assert_array_equal(np.asarray(t.params[k]),
+                                      np.asarray(a), err_msg=k)
+    for x, y in zip(_leaves(t.opt_state), _leaves(t4.opt_state)):
+        np.testing.assert_array_equal(x, y)
+
+    # pserver table, slots, and dirty bits: fresh same-size re-shard
+    v_pad = t.pserver.tables[TABLE].vocab_padded
+    _assert_resharded(np.asarray(t.pserver.tables[TABLE].data),
+                      np.asarray(t4.pserver.tables[TABLE].data),
+                      v_pad, "table")
+    old_slots, new_slots = (_leaves(x.pserver._slots[TABLE])
+                            for x in (t4, t))
+    assert len(old_slots) == len(new_slots)
+    for i, (old, new) in enumerate(zip(old_slots, new_slots)):
+        if old.ndim >= 1 and old.shape[0] == \
+                t4.pserver.tables[TABLE].vocab_padded:
+            _assert_resharded(new, old, v_pad, f"slot[{i}]")
+        else:                                # scalar slot (e.g. step count)
+            np.testing.assert_array_equal(new, old, err_msg=f"slot[{i}]")
+    _assert_resharded(np.asarray(t.pserver.tables[TABLE].dirty),
+                      np.asarray(t4.pserver.tables[TABLE].dirty),
+                      v_pad, "dirty")
+    assert np.asarray(t.pserver.tables[TABLE].dirty).any()  # real carry
+
+    # resumed training at the new world tracks a same-checkpoint resume
+    # at the ORIGINAL world (collective reduction order may differ)
+    t4b = _trainer(4, seed=98)
+    t4b.load(str(tmp_path), 0)
+    nxt = _feeds(rng, n=2)
+    for f in nxt:
+        np.testing.assert_allclose(float(t.train_batch(f)),
+                                   float(t4b.train_batch(f)), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(t.pserver.tables[TABLE].data)[:VOCAB],
+        np.asarray(t4b.pserver.tables[TABLE].data)[:VOCAB],
+        rtol=1e-6, atol=1e-7)
+
+
+def test_corrupt_member_is_typed_error_naming_the_member(rng, tmp_path):
+    t4 = _trainer(4, seed=7)
+    t4.train_batch(_feeds(rng, n=1)[0])
+    t4.save(str(tmp_path), 0)
+    member = os.path.join(pass_dir(str(tmp_path), 0), "pserver.npz")
+    blob = bytearray(open(member, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(member, "wb") as f:
+        f.write(bytes(blob))
+    t2 = _trainer(2, seed=8)
+    with pytest.raises(CheckpointError, match="pserver.npz"):
+        t2.load(str(tmp_path), 0)

@@ -35,7 +35,8 @@ import pytest
 import paddle_tpu.nn as nn
 from paddle_tpu.param.optimizers import Adam
 from paddle_tpu.resilience import (GangContext, GangError, GangFailedError,
-                                   GangSupervisor, PreemptionHandler, chaos)
+                                   GangResized, GangSupervisor,
+                                   PreemptionHandler, chaos)
 from paddle_tpu.trainer import SGDTrainer, events as ev
 from paddle_tpu.utils.flags import FLAGS
 
@@ -143,6 +144,94 @@ def test_heartbeat_writes_and_throttles(tmp_path):
     assert hb.read_text() == "1"
     g.heartbeat(force=True)
     assert hb.read_text() == "2"
+
+
+# ---------------------------------------------------------------------------
+# elastic world protocol (docs/resilience.md "Elastic gang")
+# ---------------------------------------------------------------------------
+
+
+def _publish_world(d, epoch, ranks, coordinator=None, reason="test"):
+    with open(os.path.join(str(d), "world.json"), "w") as f:
+        json.dump({"epoch": epoch, "ranks": ranks,
+                   "coordinator": coordinator if coordinator is not None
+                   else min(ranks), "size": 2, "reason": reason}, f)
+
+
+def test_world_poll_adopt_and_ack(tmp_path):
+    g = _ctx(tmp_path, 0, 2)
+    assert g.poll_world() is None and not g.degraded and g.world_size == 2
+    _publish_world(tmp_path, 1, [0], reason="rank 1 died")
+    w = g.poll_world()
+    assert w is not None and w["epoch"] == 1
+    g.adopt_world(w)
+    assert g.epoch == 1 and g.world_size == 1 and g.degraded
+    assert g.is_coordinator
+    assert g.poll_world() is None        # same epoch never re-fires
+    g.ack_resize()
+    assert (tmp_path / "resize-ack-e001-rank0").exists()
+    # a 1-rank barrier completes trivially under the new membership
+    g.barrier()
+
+
+def test_coordinator_follows_survivors(tmp_path):
+    """Rank 0 (the original coordinator) died: the published world names a
+    surviving coordinator and rank 1 takes over publish duties."""
+    g = _ctx(tmp_path, 1, 2)
+    assert not g.is_coordinator
+    _publish_world(tmp_path, 1, [1], coordinator=1)
+    g.adopt_world(g.poll_world())
+    assert g.is_coordinator
+    # decisions are epoch-namespaced so a joiner can never read a stale one
+    g.broadcast_json({"pass": 3}, name="resume")
+    assert (tmp_path / "pub-resume-e001.json").exists()
+
+
+def test_barrier_aborts_with_gang_resized_when_world_changes(tmp_path):
+    """A rank waiting in a barrier for a peer that just DIED must not wait
+    out the timeout: the supervisor's world publish aborts the wait with
+    GangResized so the trainer can run the resize protocol instead."""
+    g0 = _ctx(tmp_path, 0, 2, barrier_timeout_s=30.0)
+
+    def publish():
+        time.sleep(0.2)
+        _publish_world(tmp_path, 1, [0])
+
+    t = threading.Thread(target=publish)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(GangResized) as ei:
+        g0.barrier()
+    t.join()
+    assert time.monotonic() - t0 < 10.0          # aborted, not timed out
+    assert ei.value.world["epoch"] == 1 and ei.value.world["ranks"] == [0]
+    # inside the resize protocol itself the same wait must NOT abort
+    # (the grow path barriers under the old membership while the new
+    # world is already published): suppressed via resizing()
+    g1 = _ctx(tmp_path, 1, 2, barrier_timeout_s=2.0)
+    g0b = _ctx(tmp_path, 0, 2, barrier_timeout_s=2.0)
+    _publish_world(tmp_path, 2, [0, 1])
+
+    def peer():
+        with g1.resizing():
+            g1.barrier()
+
+    t = threading.Thread(target=peer)
+    t.start()
+    with g0b.resizing():
+        g0b.barrier()                            # completes despite epoch 2
+    t.join()
+
+
+def test_joiner_requires_published_world(tmp_path):
+    """A replacement launched into epoch E must find world.json at least
+    that new — a missing/stale world is a typed error, never a silent
+    fall-back to the full membership."""
+    with pytest.raises(GangError, match="joiner"):
+        GangContext(str(tmp_path), 1, 2, heartbeat_s=0.0, epoch=2)
+    _publish_world(tmp_path, 2, [0, 1], coordinator=0)
+    g = GangContext(str(tmp_path), 1, 2, heartbeat_s=0.0, epoch=2)
+    assert g.epoch == 2 and g.world_size == 2 and not g.is_coordinator
 
 
 # ---------------------------------------------------------------------------
@@ -264,6 +353,149 @@ def test_launcher_poll_and_kill_gang(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# restart-backoff jitter (satellite: thundering-herd protection)
+# ---------------------------------------------------------------------------
+
+
+class _FixedRng:
+    def __init__(self, vals):
+        self._vals = list(vals)
+
+    def random(self):
+        return self._vals.pop(0)
+
+
+def test_restart_backoff_jitter_bounds(tmp_path):
+    """Jitter draws each restart delay from [(1-j)*delay, delay].  Pinned
+    with an injected rng: delay_k = min(backoff * 2^k, cap) * (1 - j*u_k)."""
+    script = tmp_path / "crash.py"
+    script.write_text("import sys\nsys.exit(3)\n")
+    sleeps = []
+    sup = _supervisor(1, script, gang_dir=str(tmp_path / "gang"),
+                      max_restarts=3, backoff_s=1.0, max_backoff_s=8.0,
+                      backoff_jitter=0.5, rng=_FixedRng([0.0, 1.0, 0.5]),
+                      sleep=sleeps.append)
+    with pytest.raises(GangFailedError):
+        sup.run()
+    backoffs = [s for s in sleeps if s >= 0.4]   # drop poll-cadence sleeps
+    assert backoffs == pytest.approx([1.0, 1.0, 3.0])
+    # u=0 keeps the full delay, u=1 halves it at jitter 0.5: every draw
+    # stays inside the documented band
+    for k, s in enumerate(backoffs):
+        base = min(1.0 * 2.0 ** k, 8.0)
+        assert 0.5 * base <= s <= base
+
+
+def test_backoff_jitter_defaults_to_flag(tmp_path, monkeypatch):
+    monkeypatch.setattr(FLAGS, "gang_backoff_jitter", 0.25)
+    monkeypatch.setattr(FLAGS, "gang_elastic", True)
+    sup = GangSupervisor(["localhost"], str(tmp_path / "x.py"))
+    assert sup.backoff_jitter == 0.25
+    assert sup.elastic is True and sup.min_ranks == FLAGS.gang_min_ranks
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor machinery (cheap protocol stubs, no jax import)
+# ---------------------------------------------------------------------------
+
+# Each rank heartbeats, acks every world epoch it is a member of, and
+# exits 0 at an ABSOLUTE wall-clock deadline (argv) so survivors and a
+# late-launched joiner stop together.  Rank `die_rank` (argv) exits
+# nonzero after `die_after` seconds — but only in its epoch-0
+# incarnation, so its replacement survives.
+ELASTIC_STUB = textwrap.dedent("""\
+    import json, os, sys, time
+    d = os.environ["PADDLE_TPU_GANG_DIR"]
+    r = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    epoch = int(os.environ.get("PADDLE_TPU_GANG_EPOCH", "0"))
+    deadline_ts, die_rank, die_after = (
+        float(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3]))
+    joiner = epoch > 0
+    t0 = time.time()
+    def ack(e):
+        with open(os.path.join(d, f"resize-ack-e{e:03d}-rank{r}"), "w") as f:
+            f.write("1")
+    if joiner:
+        ack(epoch)
+    while time.time() < deadline_ts:
+        with open(os.path.join(d, f"hb-rank{r}"), "w") as f:
+            f.write("x")
+        try:
+            with open(os.path.join(d, "world.json")) as f:
+                w = json.load(f)
+            if w["epoch"] > epoch and r in w["ranks"]:
+                epoch = w["epoch"]
+                ack(epoch)
+        except Exception:
+            pass
+        if (not joiner and r == die_rank
+                and time.time() - t0 > die_after):
+            os._exit(9)
+        time.sleep(0.02)
+    sys.exit(0)
+""")
+
+
+def _elastic_stub_sup(tmp_path, *, horizon_s=6.0, die_rank=1,
+                      die_after=0.5, **kw):
+    script = tmp_path / "stub.py"
+    script.write_text(ELASTIC_STUB)
+    kw.setdefault("elastic", True)
+    kw.setdefault("watchdog_s", 2.0)
+    kw.setdefault("startup_grace_s", 10.0)
+    kw.setdefault("max_restarts", 2)
+    return _supervisor(
+        2, script,
+        [str(time.time() + horizon_s), str(die_rank), str(die_after)],
+        gang_dir=str(tmp_path / "gang"), **kw)
+
+
+def test_elastic_shrink_then_grow_back_no_relaunch(tmp_path):
+    """Supervisor half of the elastic path on protocol stubs: rank 1 dies
+    -> world shrinks to rank 0 (no gang kill), then a replacement is
+    relaunched and the world grows back — all inside ONE attempt."""
+    sup = _elastic_stub_sup(tmp_path)
+    result = sup.run()
+    assert result.attempts == 1                  # never relaunched the world
+    assert result.shrinks == 1 and result.grows == 1
+    assert result.resize_fallbacks == 0
+    assert "shrink" in result.last_resize_reason or (
+        "grow" in result.last_resize_reason)
+    shrunk = [x for x in result.reports if "elastic shrink" in x.reason]
+    assert shrunk and shrunk[0].rank == 1 and shrunk[0].exit_code == 9
+
+
+def test_elastic_respects_min_ranks(tmp_path):
+    """Below --gang_min_ranks the elastic path must refuse to shrink and
+    take the classic whole-gang relaunch instead."""
+    sup = _elastic_stub_sup(tmp_path, min_ranks=2, max_restarts=0,
+                            horizon_s=4.0)
+    with pytest.raises(GangFailedError):
+        sup.run()
+    assert sup.shrinks == 0 and sup.grows == 0
+
+
+def test_elastic_hang_is_expelled_by_kill(tmp_path):
+    """A SIGSTOPped (wedged) rank can't be waited out: the shrink must
+    SIGKILL it before publishing the smaller world (a half-alive host
+    must never write into the new epoch)."""
+    sup = _elastic_stub_sup(tmp_path, die_rank=-1, horizon_s=8.0)
+    stopped = []
+
+    def tick(s, attempt, elapsed):
+        if not stopped and s._hb_age(1, time.time()) is not None:
+            chaos.slow_rank(s, 1, stop_s=60.0)   # SIGCONT long after expel
+            stopped.append(True)
+
+    sup._tick = tick
+    result = sup.run()
+    assert result.attempts == 1
+    assert result.shrinks == 1 and result.grows == 1
+    hung = [x for x in result.reports if "hung" in x.reason]
+    assert hung and hung[0].rank == 1
+
+
+# ---------------------------------------------------------------------------
 # end-to-end recovery on a 2-process CPU training gang
 # ---------------------------------------------------------------------------
 
@@ -272,7 +504,7 @@ def test_launcher_poll_and_kill_gang(tmp_path):
 # heartbeats) rides the supervisor's shared gang dir.  Rank 0 dumps its
 # per-(pass,batch) losses and final params on clean completion.
 TRAIN_WORKER = textwrap.dedent("""\
-    import json, os, sys
+    import json, os, sys, time
 
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -287,6 +519,10 @@ TRAIN_WORKER = textwrap.dedent("""\
     from paddle_tpu.utils import FLAGS
 
     save_dir, out_dir, mode, chaos_rank = sys.argv[1:5]
+    # optional per-batch pace: the elastic tests stretch the workload so
+    # protocol latencies (supervisor poll, joiner warmup) land INSIDE
+    # training instead of racing past its end
+    pace = float(sys.argv[5]) if len(sys.argv) > 5 else 0.0
     rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
     FLAGS.save_dir = save_dir
     FLAGS.log_period = 0
@@ -304,11 +540,19 @@ TRAIN_WORKER = textwrap.dedent("""\
     def record(e):
         if isinstance(e, ev.EndIteration):
             losses[f"{e.pass_id}:{e.batch_id}"] = float(e.cost)
+            if pace:
+                time.sleep(pace)
 
     handler = record
     marker = os.path.join(out_dir, "fault-fired")
-    if rank == int(chaos_rank):
-        if mode == "kill":
+    if mode == "resize_die" and rank != int(chaos_rank):
+        # the SURVIVOR dies the moment its elastic resize begins — the
+        # mid-reshard fault that must fall back to whole-gang relaunch
+        handler = chaos.die_during_resize(
+            marker=os.path.join(out_dir, "resize-fault-fired"),
+            inner=record)
+    elif rank == int(chaos_rank):
+        if mode in ("kill", "resize_die"):
             handler = chaos.die_at(pass_id=1, batch=2, marker=marker,
                                    inner=record)
         elif mode == "hang":
@@ -348,14 +592,16 @@ def _reference_run(monkeypatch):
     return losses, {k: np.asarray(v) for k, v in tr.params.items()}
 
 
-def _train_gang(tmp_path, mode, chaos_rank, **kw):
+def _train_gang(tmp_path, mode, chaos_rank, pace=0.0, save_dir=None, **kw):
     script = tmp_path / "worker.py"
     script.write_text(TRAIN_WORKER)
-    save_dir = tmp_path / "ckpts"
+    if save_dir is None:
+        save_dir = str(tmp_path / "ckpts")
     out_dir = tmp_path / "out"
     out_dir.mkdir()
     sup = _supervisor(
-        2, script, [str(save_dir), str(out_dir), mode, str(chaos_rank)],
+        2, script,
+        [save_dir, str(out_dir), mode, str(chaos_rank), str(pace)],
         gang_dir=str(tmp_path / "gang"), max_restarts=2, **kw)
     return sup, out_dir
 
@@ -401,7 +647,10 @@ def test_hung_rank_detected_by_watchdog_and_gang_restarted(
     model).  The watchdog must flag it within the configured timeout and
     the relaunched gang must complete."""
     ref_losses, _ = _reference_run(monkeypatch)
-    watchdog_s = 4.0
+    # headroom matters: under full-suite CPU load a relaunched rank's
+    # post-resume JIT compile can exceed a tight watchdog before its first
+    # heartbeat, buying a spurious extra restart (attempts == 3)
+    watchdog_s = 10.0
     sup, out_dir = _train_gang(tmp_path, "hang", 1, watchdog_s=watchdog_s)
     result = sup.run()
 
@@ -444,6 +693,131 @@ def test_checkpoint_corrupted_between_restarts_falls_back(
     final = np.load(out_dir / "final-rank0.npz")
     for k, v in ref_params.items():
         np.testing.assert_allclose(final[k], v, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# elastic gang: end-to-end on real 2-process CPU training gangs
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_sigkill_midpass_shrinks_and_grows_back_to_oracle(
+        tmp_path, monkeypatch):
+    """THE elastic acceptance proof: rank 1 of a 2-process gang is
+    SIGKILLed mid-pass with elastic mode on.  The supervisor does NOT
+    relaunch the world: the survivor shrinks the gang (drain ->
+    checkpoint-commit -> resume mid-pass) and keeps training, then a
+    replacement is launched and the gang grows back at the next batch
+    boundary — the joiner restores the resize checkpoint and finishes the
+    run.  The surviving rank's losses and final params match an
+    uninterrupted run to 1e-6, and the joiner's tail matches the oracle
+    wherever it trained."""
+    ref_losses, ref_params = _reference_run(monkeypatch)
+    # paced batches (0.1s): the shrink->grow sequence must land while the
+    # survivor still has work, so the joiner provably trains a real tail
+    sup, out_dir = _train_gang(tmp_path, "kill", 1, elastic=True, pace=0.1)
+    result = sup.run()
+
+    assert result.attempts == 1              # never relaunched the world
+    assert result.shrinks == 1 and result.grows == 1
+    assert result.resize_fallbacks == 0
+    assert (out_dir / "fault-fired").exists()
+    shrunk = [r for r in result.reports if "elastic shrink" in r.reason]
+    assert shrunk and shrunk[0].rank == 1
+    assert shrunk[0].exit_code == -signal.SIGKILL
+
+    # the survivor trained EVERY batch, uninterrupted, to oracle losses
+    got = _load_losses(out_dir, rank=0)
+    assert set(got) == set(ref_losses)
+    for key, v in got.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    final = np.load(out_dir / "final-rank0.npz")
+    for k, v in ref_params.items():
+        np.testing.assert_allclose(final[k], v, rtol=1e-6, atol=1e-7)
+
+    # the replacement joined from the resize checkpoint mid-pass and its
+    # tail matches the oracle wherever it trained, through the end
+    got1 = _load_losses(out_dir, rank=1)
+    assert "2:5" in got1
+    for key, v in got1.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=f"joiner {key}")
+
+
+def test_die_during_resize_falls_back_to_whole_gang_relaunch(
+        tmp_path, monkeypatch):
+    """Chaos `die_during_resize`: rank 0 dies mid-pass, and the SURVIVOR
+    is killed the moment its shrink begins (mid-reshard).  The elastic
+    path must fall back to the classic whole-gang relaunch — within the
+    existing restart budget — and the rerun still matches the oracle."""
+    ref_losses, ref_params = _reference_run(monkeypatch)
+    sup, out_dir = _train_gang(tmp_path, "resize_die", 0, elastic=True)
+    result = sup.run()
+
+    assert result.attempts == 2              # fallback relaunch, bounded
+    assert result.resize_fallbacks >= 1
+    assert (out_dir / "fault-fired").exists()
+    assert (out_dir / "resize-fault-fired").exists()
+    fell_back = [r for r in result.reports if "fallback" in r.reason]
+    assert fell_back, result.reports
+
+    got = _load_losses(out_dir)
+    assert "2:5" in got                      # ran to the end after relaunch
+    for key, v in got.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    final = np.load(out_dir / "final-rank0.npz")
+    for k, v in ref_params.items():
+        np.testing.assert_allclose(final[k], v, rtol=1e-6, atol=1e-7)
+
+
+def test_elastic_grow_back_without_save_dir_still_completes(tmp_path):
+    """Regression (review): the joiner's rendezvous (epoch resume
+    decision -> join barrier -> ack) must run for EVERY epoch>0 launch,
+    not only under resume=auto with a save_dir.  With no save_dir there
+    is nothing durable to restore — the resize commit is a bare barrier
+    and the grow decision broadcasts pass -1 — but the grow must still
+    COMPLETE: the survivor shrinks, the replacement joins fresh, and no
+    resize ever times out into the whole-gang-relaunch fallback."""
+    sup, out_dir = _train_gang(tmp_path, "kill", 1, elastic=True, pace=0.1,
+                               save_dir="")
+    result = sup.run()
+
+    assert result.attempts == 1              # never relaunched the world
+    assert result.shrinks == 1 and result.grows == 1
+    assert result.resize_fallbacks == 0
+    assert (out_dir / "fault-fired").exists()
+    # the joiner trained a real (fresh-params, nothing to restore) tail
+    # through the end of the run
+    got1 = _load_losses(out_dir, rank=1)
+    assert "2:5" in got1
+
+
+def test_elastic_observability_in_worker_extras(tmp_path):
+    """Satellite: the trainer surfaces world_size / degraded /
+    resize_count / last_resize_reason next to its step extras when a gang
+    is attached (single-rank gang here — cheap, no supervisor)."""
+    import json as _json
+
+    _publish_world(tmp_path, 0, [0])  # noop; ensures dir exists
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=2)
+    cost = nn.mse_cost(input=nn.fc(x, 2, act="relu", name="eo_h"), label=y)
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+    feeds = [{"x": np.zeros((4, 4), np.float32),
+              "y": np.zeros((4, 2), np.float32)}]
+    os.environ["PADDLE_TPU_GANG_DIR"] = str(tmp_path)
+    os.environ["PADDLE_TPU_GANG_SIZE"] = "1"
+    os.environ["PADDLE_TPU_PROCESS_ID"] = "0"
+    try:
+        tr.train(lambda: iter(feeds), num_passes=1)
+    finally:
+        for k in ("PADDLE_TPU_GANG_DIR", "PADDLE_TPU_GANG_SIZE",
+                  "PADDLE_TPU_PROCESS_ID"):
+            os.environ.pop(k, None)
+    ex = tr._last_extras
+    assert ex["world_size"] == 1 and ex["degraded"] is False
+    assert ex["resize_count"] == 0 and ex["last_resize_reason"] is None
 
 
 # ---------------------------------------------------------------------------

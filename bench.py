@@ -30,38 +30,12 @@ import time
 
 import numpy as np
 
-# chip peak dense FLOP/s (bf16) by device_kind substring, most specific first
-_PEAKS = [
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12), ("v5", 459e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
-]
-
-# chip peak HBM bandwidth (bytes/s) by device_kind substring — the other
-# roofline axis for the small-batch rows
-_BW_PEAKS = [
-    ("v6 lite", 1640e9), ("v6e", 1640e9),
-    ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9), ("v5", 2765e9),
-    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
-]
-
-
-def _chip_lookup(kind: str, table, default):
-    k = kind.lower()
-    if "tpu" not in k:
-        return None
-    for sub, val in table:
-        if sub in k:
-            return val
-    return default
-
-
-def _chip_peak(kind: str):
-    return _chip_lookup(kind, _PEAKS, 197e12)  # unknown TPU: assume v5e
-
-
-def _chip_bw(kind: str):
-    return _chip_lookup(kind, _BW_PEAKS, 819e9)
+# chip peak FLOP/s + HBM bandwidth and the analytic FLOPs walker live in
+# paddle_tpu.analysis.flops — the live MFU gauge (paddle_tpu/obs) and this
+# driver must report identical numbers for the same program, so neither
+# keeps a private copy (drift risk: VERDICT r4 weak #4 null-MFU rows)
+from paddle_tpu.analysis.flops import (chip_peak_bandwidth as _chip_bw,
+                                       chip_peak_flops as _chip_peak)
 
 
 def _fetch(x) -> float:
@@ -145,55 +119,15 @@ def _time_chain(one_step, carry, *, iters, rtt, reps=3):
 
 
 def _jaxpr_flops(fn, carry):
-    """Analytic matmul+conv FLOPs of one step, from walking the jaxpr.
+    """Analytic matmul+conv FLOPs of one step — the fallback for rows
+    where XLA's ``cost_analysis`` returns nothing (VERDICT r4 weak #4:
+    googlenet b128 published ``mfu: null``).  The walker itself is the
+    shared ``paddle_tpu.analysis.flops`` counter, the SAME code the live
+    MFU gauge uses (pinned by tests/test_obs.py), so bench and live
+    telemetry cannot disagree about a model's FLOPs."""
+    from paddle_tpu.analysis.flops import jaxpr_flops
 
-    Fallback for rows where XLA's ``cost_analysis`` returns nothing
-    (VERDICT r4 weak #4: googlenet b128 published ``mfu: null``).  Counts
-    2*M*N*K per dot_general and 2*out_elems*(filter_spatial*Cin/groups) per
-    conv, recursing through pjit/scan/cond/custom-vjp sub-jaxprs (scan
-    bodies multiplied by trip count — the case XLA's counter gets wrong).
-
-    Sub-jaxpr recursion is the shared ``paddle_tpu.analysis`` walker:
-    per-primitive into the KNOWN key (call_jaxpr/jaxpr/branches) — the old
-    recurse-into-every-param loop double-counted primitives carrying
-    several sub-jaxprs (custom_vjp holds primal + fwd/bwd rules)."""
-    import jax
-
-    from paddle_tpu.analysis import eqn_subjaxprs
-
-    def count(jaxpr) -> float:
-        total = 0.0
-        for eqn in jaxpr.eqns:
-            name = eqn.primitive.name
-            if name == "dot_general":
-                (lc, _), _ = eqn.params["dimension_numbers"]
-                lhs = eqn.invars[0].aval
-                k = float(np.prod([lhs.shape[d] for d in lc], dtype=np.float64))
-                out = float(np.prod(eqn.outvars[0].aval.shape, dtype=np.float64))
-                total += 2.0 * out * k
-            elif name == "conv_general_dilated":
-                dn = eqn.params["dimension_numbers"]
-                rhs = eqn.invars[1].aval
-                # rhs_spec[0]=out-chan dim, [1]=in-chan(per group), rest spatial
-                k = float(np.prod([rhs.shape[d] for d in dn.rhs_spec[1:]],
-                                  dtype=np.float64))
-                out = float(np.prod(eqn.outvars[0].aval.shape, dtype=np.float64))
-                total += 2.0 * out * k
-            elif name == "cond":
-                # a cond executes ONE branch: count the worst case, not the
-                # sum (the generic walker yields every branch)
-                branches = eqn.params.get("branches", ())
-                if branches:
-                    total += max(count(b.jaxpr) for b in branches)
-            else:
-                for inner, mult in eqn_subjaxprs(eqn):
-                    total += mult * count(inner)
-        return total
-
-    try:
-        return count(jax.make_jaxpr(fn)(carry).jaxpr)
-    except Exception:
-        return None
+    return jaxpr_flops(fn, carry)
 
 
 def _calibrate_rtt():

@@ -180,8 +180,9 @@ JOBS = {
 
 _USAGE = """\
 usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [--flag=value ...]
-       python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE] ...
+       python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE|--obs] ...
        python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
+       python -m paddle_tpu obs {merge|dump} DIR_OR_FILE... [--format text|json]
 
 The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
 module docstring of paddle_tpu/__main__.py).  `serve` runs the
@@ -205,6 +206,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.analysis.cli import run as lint_run
 
         return lint_run(argv[1:])
+    if argv and argv[0] == "obs":
+        # journal tooling (docs/observability.md): merge per-rank event
+        # journals into one causal timeline, or dump one with counts —
+        # its own argparse surface, no flag-registry init needed
+        from paddle_tpu.obs.cli import run as obs_run
+
+        return obs_run(argv[1:])
     if "-h" in argv or "--help" in argv:
         # also covers `serve --help`: the serve knobs are registered
         # --serve_* flags, so the global table IS its help surface (only

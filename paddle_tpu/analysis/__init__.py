@@ -21,6 +21,8 @@ from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
                                              audit_no_dense_rows)
 from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
                                           lint_source)
+from paddle_tpu.analysis.flops import (chip_peak_bandwidth, chip_peak_flops,
+                                       count_jaxpr_flops, jaxpr_flops)
 
 __all__ = [
     "Finding",
@@ -44,4 +46,8 @@ __all__ = [
     "lint_source",
     "lint_file",
     "lint_path",
+    "count_jaxpr_flops",
+    "jaxpr_flops",
+    "chip_peak_flops",
+    "chip_peak_bandwidth",
 ]

@@ -8,6 +8,7 @@ Usage:
     python -m paddle_tpu lint --decode B,S,K,L
     python -m paddle_tpu lint --serve model.ptz
     python -m paddle_tpu lint --pserver V,D,N,S
+    python -m paddle_tpu lint --obs
 
 ``--path DIR`` runs the AST trace-safety linter over the tree;
 ``--config CONF.py`` additionally builds the config's trainer and audits
@@ -31,6 +32,13 @@ the serving check set, and additionally asserts the "never densify"
 contract: no ``[V, D]``-shaped gradient or optimizer temp may appear in
 the sparse-apply jaxpr, and no broadcast may conjure a per-shard dense
 buffer (``analysis.audit_no_dense_rows``).
+
+``--obs`` gates the telemetry contract (docs/observability.md): the
+trainer's jitted step is traced with the step timeline / MFU plumbing
+enabled, audited for host transfers and constant bloat (the
+``audit_decode`` contract), and diffed equation-for-equation against the
+telemetry-disabled trace — instrumentation must live in host-side Python
+around the existing per-batch sync, never inside the compiled program.
 
 ``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
 generation path (Seq2SeqAttention.beam_search over the fused decode
@@ -198,6 +206,11 @@ def run(argv: Optional[List[str]] = None) -> int:
                    metavar="V,D,N,S",
                    help="audit the pserver lookup/sparse-apply closures "
                         "and gate the never-densify contract")
+    p.add_argument("--obs", action="store_true",
+                   help="audit the telemetry contract: the compiled train "
+                        "step with the timeline/MFU plumbing enabled must "
+                        "be host-transfer-free AND identical to the "
+                        "telemetry-off trace")
     p.add_argument("--serve", action="append", default=[],
                    metavar="BUNDLE.ptz",
                    help="serving preflight: audit a deploy bundle's "
@@ -215,7 +228,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     targets = list(ns.path)
     configs = list(ns.config)
     if (not targets and not configs and ns.decode is None
-            and ns.pserver is None and not ns.serve):
+            and ns.pserver is None and not ns.serve and not ns.obs):
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -236,6 +249,10 @@ def run(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.pserver import audit_pserver
 
         findings.extend(audit_pserver(ns.pserver))
+    if ns.obs:
+        from paddle_tpu.obs.audit import audit_telemetry_step
+
+        findings.extend(audit_telemetry_step())
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
     if ns.serve:

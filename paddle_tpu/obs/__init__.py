@@ -1,0 +1,50 @@
+"""``paddle_tpu.obs`` — the unified telemetry subsystem.
+
+One instrument panel for every tier (docs/observability.md): the
+process-wide metrics registry (counters/gauges/histograms, Prometheus +
+JSON exposition, ``--metrics_port`` HTTP endpoint), the trainer's
+step-time breakdown with a live MFU gauge (same analytic-FLOPs walker as
+``bench.py`` — ``analysis.flops``), the rank-tagged structured event
+journal (``--obs_journal`` + ``python -m paddle_tpu obs merge``), and
+on-demand ``jax.profiler`` capture windows (``--profile_steps`` /
+SIGUSR2).
+
+Consumed by the trainer (phases + journal + profiler), serving
+(``ServerMetrics`` is a registry view), the gang supervisor (resize /
+death / hang journal records), and the pserver tier (snapshot commits).
+Telemetry never adds host transfers inside jit — gated by ``lint --obs``.
+"""
+
+from paddle_tpu.obs.journal import (EventJournal, close_journal, get_journal,
+                                    journal_event, journal_files,
+                                    journal_path, merge_journals,
+                                    read_journal, set_journal_context)
+from paddle_tpu.obs.profiler import ProfilerCapture
+from paddle_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                     MetricsRegistry, ensure_metrics_server,
+                                     get_registry, reset_registry,
+                                     start_metrics_server)
+from paddle_tpu.obs.timeline import PHASES, StepTimeline
+
+__all__ = [
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "get_registry",
+    "reset_registry",
+    "start_metrics_server",
+    "ensure_metrics_server",
+    "StepTimeline",
+    "PHASES",
+    "EventJournal",
+    "journal_path",
+    "journal_files",
+    "read_journal",
+    "merge_journals",
+    "get_journal",
+    "journal_event",
+    "set_journal_context",
+    "close_journal",
+    "ProfilerCapture",
+]

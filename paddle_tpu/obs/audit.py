@@ -1,0 +1,88 @@
+"""``lint --obs`` — prove telemetry never touches the compiled step.
+
+The whole design of ``paddle_tpu.obs`` is that instrumentation lives in
+host-side Python around the already-existing per-batch sync: the jitted
+train step must compile to the SAME program with telemetry on.  This
+audit builds a small trainer twice — timeline/journal/MFU plumbing
+enabled vs disabled — and
+
+1. runs the jaxpr auditor's host-transfer/constant-bloat checks over the
+   telemetry-enabled step (the ``audit_decode`` contract: ERROR-free
+   means no host round-trip per step), and
+2. asserts the two traced programs are equation-for-equation IDENTICAL —
+   zero *added* anything, not merely zero transfers.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from paddle_tpu.analysis.findings import Finding
+
+__all__ = ["audit_telemetry_step"]
+
+#: the checks that matter here — same set a serving/decode closure gets
+_CHECKS = ("host-transfer", "constant-bloat")
+
+
+def _tiny_trainer():
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.trainer import SGDTrainer
+
+    nn.reset_naming()
+    x = nn.data("obs_audit_x", size=8)
+    y = nn.data("obs_audit_y", size=2)
+    cost = nn.mse_cost(input=nn.fc(x, 2, act="relu", name="obs_audit_h"),
+                       label=y)
+    tr = SGDTrainer(cost, Adam(learning_rate=0.01), seed=0)
+    rs = np.random.RandomState(0)
+    feed = {"obs_audit_x": rs.randn(4, 8).astype(np.float32),
+            "obs_audit_y": rs.randn(4, 2).astype(np.float32)}
+    return tr, feed
+
+
+def audit_telemetry_step() -> List[Finding]:
+    """Trace the trainer step with telemetry ON, audit it, and diff the
+    jaxpr against the telemetry-OFF trace; returns findings (ERROR on any
+    host transfer or any added equation)."""
+    import jax
+
+    from paddle_tpu.utils.flags import FLAGS
+
+    findings: List[Finding] = []
+    try:
+        tr, feed = _tiny_trainer()
+        rng = jax.random.PRNGKey(0)
+        args = (tr.params, tr.state, tr.opt_state, {}, rng, feed)
+
+        keep = (FLAGS.obs_timeline, FLAGS.obs_peak_flops)
+        try:
+            FLAGS.obs_timeline = True
+            FLAGS.obs_peak_flops = 1e12  # force the MFU/FLOPs plumbing live
+            from paddle_tpu.analysis import audit_fn
+
+            findings.extend(audit_fn(
+                tr._step_fn, *args, label="obs:train_step", checks=_CHECKS))
+            on = jax.make_jaxpr(tr._step_fn)(*args)
+            FLAGS.obs_timeline = False
+            FLAGS.obs_peak_flops = 0.0
+            off = jax.make_jaxpr(tr._step_fn)(*args)
+        finally:
+            FLAGS.obs_timeline, FLAGS.obs_peak_flops = keep
+        if str(on) != str(off):
+            findings.append(Finding(
+                check="obs-step-drift", severity="ERROR",
+                where="obs:train_step",
+                message="the compiled train step DIFFERS with telemetry "
+                        "enabled — instrumentation must stay host-side "
+                        f"({len(on.jaxpr.eqns)} vs {len(off.jaxpr.eqns)} "
+                        "top-level eqns)"))
+    except Exception as e:  # a step that fails to trace is itself a finding
+        findings.append(Finding(
+            check="obs-build", severity="ERROR", where="obs:train_step",
+            message=f"telemetry audit failed to build/trace the step: "
+                    f"{type(e).__name__}: {e}"))
+    return findings

@@ -1,0 +1,117 @@
+"""``python -m paddle_tpu obs`` — journal tooling for postmortems.
+
+Subcommands:
+
+- ``obs merge DIR_OR_FILE... [--format text|json] [--kind K]`` —
+  interleave per-rank journals (``events-r*.jsonl``) into one causal
+  timeline (sorted by wall-clock, then rank, then per-writer seq) and
+  print it; torn final lines (a rank SIGKILLed mid-write) are tolerated
+  and counted on stderr.  ``--kind`` filters to one record kind
+  (e.g. ``gang_resize``).
+- ``obs dump FILE_OR_DIR [--format text|json]`` — parse journals and
+  print per-kind counts plus the records (the quick "what happened on
+  this rank" view).
+
+Exit status: 0 on success (even with torn lines — they are expected
+after a crash — and when ``--kind`` simply matches nothing), 2 when no
+journal records were found at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import Counter as _Counter
+from typing import List, Optional
+
+from paddle_tpu.obs.journal import journal_files, merge_journals
+
+__all__ = ["run"]
+
+#: context keys promoted into the text rendering, in order
+_CTX = ("pass", "batch", "epoch", "world_size")
+_KNOWN = ("t", "rank", "seq", "kind") + _CTX
+
+
+def _fmt_text(rec: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(rec.get("t", 0)))
+    frac = f"{rec.get('t', 0) % 1:.3f}"[1:]
+    head = (f"{ts}{frac} r{rec.get('rank', '?'):>3} "
+            f"{rec.get('kind', '?'):<20}")
+    ctx = " ".join(f"{k}={rec[k]}" for k in _CTX if k in rec)
+    rest = " ".join(f"{k}={rec[k]}" for k in sorted(rec)
+                    if k not in _KNOWN)
+    return " ".join(x for x in (head, ctx, rest) if x)
+
+
+def _emit(records: List[dict], fmt: str) -> None:
+    if fmt == "json":
+        for rec in records:
+            print(json.dumps(rec, separators=(",", ":")))
+    else:
+        for rec in records:
+            print(_fmt_text(rec))
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu obs",
+        description="Event-journal tooling (docs/observability.md): merge "
+                    "per-rank journals into one causal timeline, or dump "
+                    "one journal with per-kind counts")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pm = sub.add_parser("merge", help="interleave per-rank journals")
+    pm.add_argument("targets", nargs="+", metavar="DIR_OR_FILE")
+    pm.add_argument("--format", choices=("text", "json"), default="text")
+    pm.add_argument("--kind", default=None,
+                    help="only records of this kind (e.g. gang_resize)")
+
+    pd = sub.add_parser("dump", help="parse + summarize journal(s)")
+    pd.add_argument("targets", nargs="+", metavar="DIR_OR_FILE")
+    pd.add_argument("--format", choices=("text", "json"), default="text")
+
+    ns = p.parse_args(argv)
+
+    records, torn = merge_journals(ns.targets)
+    if torn:
+        print(f"obs: tolerated {torn} torn/unparseable line(s)",
+              file=sys.stderr)
+    if not records:
+        paths = [f for t in ns.targets for f in journal_files(t)]
+        print(f"obs: no journal records in {paths or ns.targets}",
+              file=sys.stderr)
+        return 2
+    if ns.cmd == "merge" and ns.kind:
+        total = len(records)
+        records = [r for r in records if r.get("kind") == ns.kind]
+        if not records:
+            # a healthy journal with no matching events is SUCCESS, not
+            # the exit-2 "no journal records at all" condition
+            print(f"obs: no {ns.kind!r} records among {total}",
+                  file=sys.stderr)
+            return 0
+
+    if ns.cmd == "dump":
+        kinds = _Counter(r.get("kind", "?") for r in records)
+        ranks = sorted({r.get("rank") for r in records})
+        print(f"# {len(records)} record(s), rank(s) {ranks}, "
+              f"{torn} torn", file=sys.stderr)
+        for k, n in kinds.most_common():
+            print(f"# {k}: {n}", file=sys.stderr)
+    try:
+        _emit(records, ns.format)
+    except BrokenPipeError:
+        # `obs merge DIR | head` is the normal postmortem gesture: a
+        # closed pipe ends the page, it is not an error.  Detach stdout
+        # so the interpreter's shutdown flush doesn't re-raise.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -1,0 +1,136 @@
+"""On-demand profiler capture — bounded ``jax.profiler`` trace windows.
+
+The whole-run trace (``--profile_dir`` alone) is fine for a 10-batch
+repro but useless on a long job: the trace grows without bound and the
+interesting window (a regression mid-pass, a post-resize slowdown) is
+buried.  ``ProfilerCapture`` arms a WINDOW instead: capture exactly
+``--profile_steps`` steps into a numbered subdirectory of
+``--profile_dir``, either
+
+- **flag-armed**: ``--profile_steps=N`` captures steps 1..N (step 0 is
+  compile — tracing it drowns the steady state), or
+- **signal-armed**: ``SIGUSR2`` at any point arms the NEXT window — poke
+  a live job and collect a fresh N-step trace without restarting it.
+
+Traces carry the ``jax.named_scope`` annotations the trainer/decode
+engine emit (forward / optimizer_apply / decode_step), so XProf timelines
+are legible.  View with TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import threading
+from typing import Optional
+
+__all__ = ["ProfilerCapture"]
+
+
+class ProfilerCapture:
+    """Windowed trace capture driven by ``tick()`` at the START of each
+    batch: a window armed at tick ``b`` traces batches ``b..b+steps-1``
+    exactly (with the default ``skip_first=1``, steps 1..N — batch 0 is
+    the compile).
+
+    Host-side only; when unarmed a tick is one attribute check.  The
+    window is process-global in effect (jax.profiler allows one active
+    trace), so the trainer creates at most one per ``train()``.
+    """
+
+    def __init__(self, trace_dir: str, steps: int,
+                 *, skip_first: int = 1) -> None:
+        self.trace_dir = trace_dir
+        self.steps = int(steps)
+        self.skip_first = int(skip_first)
+        self._armed = self.steps > 0
+        self._active = False
+        self._remaining = 0
+        self._window = 0
+        self._tick_idx = 0
+        self._lock = threading.Lock()
+        self._prev_handler = None
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self) -> None:
+        """Request one more ``steps``-long window at the next boundary
+        (signal-safe: sets a flag, nothing else)."""
+        self._armed = True
+
+    def install_signal(self, signum: int = _signal.SIGUSR2) -> None:
+        """SIGUSR2 arms a window on a live job.  No-op off the main
+        thread (signal.signal raises there — e.g. a supervised serving
+        worker); the flag path still works."""
+        def handler(sig, frame):
+            self.arm()
+
+        try:
+            self._prev_handler = _signal.signal(signum, handler)
+            self._signum = signum
+        except ValueError:
+            self._prev_handler = None
+
+    def uninstall_signal(self) -> None:
+        if self._prev_handler is not None:
+            try:
+                _signal.signal(self._signum, self._prev_handler)
+            except ValueError:
+                pass
+            self._prev_handler = None
+
+    # -- the per-batch hook --------------------------------------------------
+
+    def tick(self) -> None:
+        """Called once at the START of each batch: starts an armed window
+        (skipping the compile step), counts down an active one, stops it
+        when the window's steps have all run."""
+        with self._lock:
+            idx = self._tick_idx
+            self._tick_idx += 1
+            if self._active:
+                self._remaining -= 1
+                if self._remaining <= 0:
+                    self._stop()
+                return
+            if self._armed and idx >= self.skip_first:
+                self._start()
+
+    def close(self) -> None:
+        """Stop a still-open window (end of training / an exception)."""
+        with self._lock:
+            if self._active:
+                self._stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _start(self) -> None:
+        import jax
+
+        from paddle_tpu.utils.log import logger
+
+        d = os.path.join(self.trace_dir, f"window-{self._window:03d}")
+        try:
+            jax.profiler.start_trace(d)
+        except Exception as e:  # an already-active trace must not abort train
+            logger.warning("profiler window failed to start: %s", e)
+            self._armed = False
+            return
+        self._active = True
+        self._armed = False
+        self._remaining = self.steps
+        self._window += 1
+        logger.info("profiler: capturing %d step(s) to %s", self.steps, d)
+
+    def _stop(self) -> None:
+        import jax
+
+        from paddle_tpu.utils.log import logger
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning("profiler window failed to stop: %s", e)
+        self._active = False
+        logger.info("profiler: window closed (%d captured so far)",
+                    self._window)

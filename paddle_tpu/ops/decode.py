@@ -319,6 +319,16 @@ def decode_step(step_fn: Callable, readout, carry: dict, *, vocab_size: int,
     (readout_input, new_state)`` exactly as in ``beam_decode``; per-slot
     ``active``/``step`` masks freeze finished/unoccupied slots and let
     every slot run at its own position in its token buffer."""
+    # named_scope: profiler captures (paddle_tpu/obs, --profile_steps)
+    # show one legible "decode_step" block per token instead of raw ops
+    with jax.named_scope("decode_step"):
+        return _decode_step_inner(step_fn, readout, carry,
+                                  vocab_size=vocab_size, eos=eos,
+                                  use_kernel=use_kernel)
+
+
+def _decode_step_inner(step_fn, readout, carry, *, vocab_size, eos,
+                       use_kernel):
     tokens, logp = carry["tokens"], carry["logp"]
     state, finished = carry["state"], carry["finished"]
     active, step = carry["active"], carry["step"]

@@ -123,6 +123,13 @@ def save_table_snapshot(save_dir: str, spec: TableSpec, data, dirty,
         raise
     logger.info("pserver snapshot %s: %d dirty row(s) over %d shard(s)",
                 final, total, shards)
+    # a published snapshot is a durability anchor like a checkpoint
+    # commit: fsync'd into the causal timeline (no-op without
+    # --obs_journal; docs/observability.md)
+    from paddle_tpu.obs import journal_event
+
+    journal_event("pserver_snapshot", fsync=True, snap_id=snap_id,
+                  table=spec.name, dirty_rows=total, shards=shards)
     return final
 
 

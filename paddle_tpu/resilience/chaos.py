@@ -25,6 +25,12 @@ sees, deterministically:
   ``corrupt_latest_checkpoint`` damages the newest pass dir between
   restarts.
 
+- observability (the event journal, paddle_tpu/obs — docs/
+  observability.md): ``kill_mid_journal_write`` SIGKILLs a REAL child
+  process exactly between the two halves of a journal record write — the
+  torn-final-line model the journal reader (and ``obs merge``) must
+  tolerate;
+
 - serving (the overload-safe inference runtime, paddle_tpu/serving —
   docs/serving.md): ``kill_worker`` crashes the supervised inference
   worker with a batch in flight, ``latency_injection`` wraps a model
@@ -56,6 +62,7 @@ __all__ = [
     "truncate_file",
     "corrupt_checkpoint",
     "corrupt_latest_checkpoint",
+    "kill_mid_journal_write",
     "nan_feed",
     "inject_nan_batches",
     "flaky_reader",
@@ -136,6 +143,74 @@ def corrupt_latest_checkpoint(save_dir: str, *, target: str = "params.npz",
     d = pass_dir(save_dir, p)
     corrupt_checkpoint(d, target=target, mode=mode)
     return d
+
+
+# ---------------------------------------------------------------------------
+# observability faults (the event journal, paddle_tpu/obs)
+# ---------------------------------------------------------------------------
+
+#: the child half of ``kill_mid_journal_write``: write whole records,
+#: then the FIRST HALF of one more (no newline, flushed to disk), raise a
+#: marker, and wait to be killed — a real process genuinely mid-record
+_JOURNAL_VICTIM = """\
+import json, os, sys, time
+from paddle_tpu.obs.journal import EventJournal, journal_path
+
+journal_dir, rank, whole, marker = sys.argv[1:5]
+j = EventJournal(journal_path(journal_dir, int(rank)), rank=int(rank))
+j.set_context(pass_id=1, world_size=2)
+for i in range(int(whole)):
+    j.record("victim_step", fsync=(i == 0), batch_id=i)
+# mid-write: half a record is on disk, the rest never arrives
+frag = json.dumps({"t": time.time(), "rank": int(rank), "seq": int(whole),
+                   "kind": "torn_by_sigkill", "payload": "x" * 256})
+half = frag[: len(frag) // 2]
+j._f.write(half)
+j._f.flush()
+os.fsync(j._f.fileno())
+with open(marker, "w") as f:
+    f.write("mid-write")
+time.sleep(600)
+"""
+
+
+def kill_mid_journal_write(journal_dir: str, *, rank: int = 1,
+                           whole_records: int = 5,
+                           timeout_s: float = 30.0) -> int:
+    """SIGKILL a REAL journal writer mid-record: a child process appends
+    ``whole_records`` complete records to ``journal_dir``'s rank file,
+    then writes HALF of one more (flushed, no newline) and is SIGKILLed —
+    exactly the torn final line a host loss leaves behind.  Returns the
+    number of whole records written; the caller asserts ``read_journal``
+    / ``merge_journals`` survive the tear (tests/test_obs.py)."""
+    import subprocess
+    import sys
+
+    marker = os.path.join(journal_dir, f".mid-write-r{rank}")
+    # the victim must import paddle_tpu regardless of the caller's cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _JOURNAL_VICTIM, journal_dir, str(rank),
+         str(whole_records), marker],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env)
+    deadline = _time.monotonic() + timeout_s
+    while not os.path.exists(marker):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                "journal victim exited before the mid-write marker: "
+                + proc.stderr.read().decode(errors="replace"))
+        if _time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError("journal victim never reached mid-write")
+        _time.sleep(0.01)
+    proc.send_signal(_signal.SIGKILL)
+    proc.wait(timeout=timeout_s)
+    os.remove(marker)
+    return whole_records
 
 
 # ---------------------------------------------------------------------------

@@ -547,9 +547,22 @@ class GangSupervisor:
                                  if resize_timeout_s is None
                                  else float(resize_timeout_s))
         self._rng = rng or _random.Random()
+        # supervisor's own event journal (paddle_tpu/obs; --obs_journal):
+        # rank death/hang, world publishes, relaunches — the supervisor
+        # half of the merged postmortem timeline (events-rsup.jsonl,
+        # merged with worker journals by `python -m paddle_tpu obs merge`)
+        self._journal = None
+        if getattr(FLAGS, "obs_journal", ""):
+            from paddle_tpu.obs import EventJournal, journal_path
+
+            self._journal = EventJournal(
+                journal_path(FLAGS.obs_journal, -1), rank=-1,
+                world_size=len(self.hosts))
         self.shrinks = 0
         self.grows = 0
         self.resize_fallbacks = 0
+        self._jrec("supervisor_start", hosts=len(self.hosts),
+                   elastic=self.elastic)
         self.last_resize_reason: Optional[str] = None
         self.reports: List[RankReport] = []
         self.launcher = None           # live ClusterLauncher, for chaos hooks
@@ -561,6 +574,12 @@ class GangSupervisor:
         self.coordinator = 0
         self._pending: Optional[Dict[str, Any]] = None
         self._rank_start: Dict[int, float] = {}
+
+    def _jrec(self, kind: str, *, fsync: bool = False, **fields) -> None:
+        """Supervisor-side journal record (no-op without --obs_journal):
+        the events-rsup.jsonl half of the merged postmortem timeline."""
+        if self._journal is not None:
+            self._journal.record(kind, fsync=fsync, **fields)
 
     # -- one attempt -----------------------------------------------------
 
@@ -589,6 +608,9 @@ class GangSupervisor:
         self.coordinator = 0
         self._pending = None
         self._rank_start = {r: now for r in range(len(self.hosts))}
+        if self._journal is not None:
+            self._journal.set_context(epoch=0, attempt=attempt)
+        self._jrec("gang_launch", ranks=len(self.hosts))
         return launcher
 
     def _hb_age(self, rank: int, now: float) -> Optional[float]:
@@ -645,6 +667,14 @@ class GangSupervisor:
                         attempt, r, launcher.procs[r].pid, None, "hung",
                         stale_s=age))
             if failed:
+                for f in failed:
+                    # death/hang lands in the causal timeline BEFORE the
+                    # decision it triggers (shrink vs relaunch fallback);
+                    # `failed_rank` — the writer's own `rank` field must
+                    # stay the supervisor's (-1)
+                    self._jrec("rank_failed", failed_rank=f.rank,
+                               reason=f.reason, exit_code=f.exit_code,
+                               stale_s=f.stale_s)
                 if self._pending is not None:
                     # mid-resize failure: the new path must never be less
                     # safe than the old one — whole-gang relaunch fallback
@@ -655,6 +685,8 @@ class GangSupervisor:
                     logger.warning("gang %s resize failed (%s): falling "
                                    "back to whole-gang relaunch", kind,
                                    "; ".join(f.describe() for f in failed))
+                    self._jrec("resize_fallback", fsync=True, during=kind,
+                               epoch=self.world_epoch)
                     return failed
                 survivors = self.active - {f.rank for f in failed}
                 if self.elastic and len(survivors) >= self.min_ranks:
@@ -684,6 +716,9 @@ class GangSupervisor:
                 if self._acks_done(self._pending):
                     kind = self._pending["kind"]
                     self._pending = None
+                    self._jrec("resize_complete", resize=kind,
+                               epoch=self.world_epoch,
+                               world=len(self.active))
                     if kind == "shrink":
                         self.shrinks += 1
                         logger.info("gang shrink complete (epoch %d, %d "
@@ -725,6 +760,9 @@ class GangSupervisor:
                 elif now > self._pending["deadline"]:
                     self.resize_fallbacks += 1
                     kind = self._pending["kind"]
+                    self._jrec("resize_fallback", fsync=True, during=kind,
+                               epoch=self._pending["epoch"],
+                               reason="ack timeout")
                     missing = [r for r in self._pending["acks"]
                                if not self._acked(self._pending["epoch"], r)]
                     return [RankReport(
@@ -759,6 +797,15 @@ class GangSupervisor:
         _atomic_write(os.path.join(self.attempt_dir, _WORLD_FILE),
                       json.dumps(world))
         self.last_resize_reason = reason
+        if self._journal is not None:
+            # fsync'd: world publishes are the anchors an elastic-incident
+            # postmortem orders rank records against
+            self._journal.set_context(epoch=self.world_epoch,
+                                      world_size=len(self.active))
+            self._journal.record("world_publish", fsync=True,
+                                 ranks=sorted(self.active),
+                                 coordinator=self.coordinator,
+                                 reason=reason)
 
     def _begin_shrink(self, launcher, attempt: int,
                       failed: List[RankReport]) -> None:
@@ -839,6 +886,9 @@ class GangSupervisor:
                 logger.info("gang attempt %d: all %d active ranks exited 0",
                             attempt, len(self.active))
                 self._scrub_attempt_dirs()
+                self._jrec("gang_done", attempts=attempt + 1,
+                           shrinks=self.shrinks, grows=self.grows,
+                           fallbacks=self.resize_fallbacks)
                 return GangResult(attempts=attempt + 1, reports=self.reports,
                                   shrinks=self.shrinks, grows=self.grows,
                                   resize_fallbacks=self.resize_fallbacks,
@@ -858,6 +908,8 @@ class GangSupervisor:
                            "; ".join(f.describe() for f in failed))
             launcher.kill_gang()
             if attempt >= self.max_restarts:
+                self._jrec("gang_failed", fsync=True, attempts=attempt + 1,
+                           reasons=[f.describe() for f in failed])
                 raise GangFailedError(
                     f"gang failed {attempt + 1} times "
                     f"(max_restarts={self.max_restarts}); per-rank: "
@@ -873,6 +925,9 @@ class GangSupervisor:
                 delay *= 1.0 - self.backoff_jitter * self._rng.random()
             logger.info("gang restart %d/%d in %.1fs", attempt + 1,
                         self.max_restarts, delay)
+            self._jrec("gang_relaunch", fsync=True, attempt=attempt + 1,
+                       backoff_s=round(delay, 3),
+                       reasons=[f.describe() for f in failed])
             self._sleep(delay)
             attempt += 1
 

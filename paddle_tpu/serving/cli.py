@@ -105,6 +105,11 @@ def run(argv: Optional[List[str]] = None) -> int:
     rest = init(list(argv or []))
     if rest:
         raise ConfigError(f"serve: unrecognized arguments: {rest}")
+    # --metrics_port exposes the shared registry ServerMetrics now lives
+    # in (docs/observability.md): /metrics + /metrics.json
+    from paddle_tpu.obs import ensure_metrics_server
+
+    ensure_metrics_server()
     if FLAGS.serve_continuous:
         if FLAGS.serve_smoke <= 0:
             raise ConfigError(

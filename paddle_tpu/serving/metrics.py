@@ -1,15 +1,24 @@
 """Observable health surface of the serving runtime.
 
-One lock-protected ``ServerMetrics`` instance per server: monotonic
-counters for every admission/ completion/ failure path, a rolling latency
-window with p50/p99, and the ``snapshot()`` dict that backs
-``InferenceServer.healthz()``.  Counters are named after the typed error
-that produced them so the health surface and the exception surface can
-never tell different stories.
+One ``ServerMetrics`` instance per server, now a VIEW over the shared
+``paddle_tpu.obs`` metrics registry (docs/observability.md): every
+counter is a registry counter ``serving_<name>{server=<id>}``, and
+completed-request latency additionally feeds the registry histogram
+``serving_latency_seconds`` — so a ``--metrics_port`` scrape and
+``healthz()`` read the SAME monotonic series and can never tell
+different stories.  Counters are named after the typed error that
+produced them, so the health surface and the exception surface agree
+too.
+
+The ``snapshot()`` schema is pinned by tests/test_serving.py: every
+``_COUNTERS`` key is pre-seeded (a dashboard sees ``shed=0``, not a
+missing key, before the first shed) and the percentile definition is the
+same nearest-rank rule ``percentile_ms`` uses.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import deque
 from typing import Dict, Optional
@@ -40,27 +49,58 @@ _COUNTERS = (
     "slot_evicted",         # slots released by mid-generation deadline expiry
 )
 
+#: distinguishes the registry children of servers sharing one process
+_server_ids = itertools.count()
+
 
 class ServerMetrics:
-    def __init__(self, window: int = 512) -> None:
+    def __init__(self, window: int = 512, registry=None) -> None:
+        from paddle_tpu.obs import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        self._label = f"s{next(_server_ids)}"
+        self._counters = {
+            name: reg.counter("serving_" + name,
+                              "serving counter (docs/serving.md)",
+                              labels=("server",), server=self._label)
+            for name in _COUNTERS
+        }
+        self._registry = reg
+        self._latency_hist = reg.histogram(
+            "serving_latency_seconds",
+            "completed-request latency", labels=("server",),
+            server=self._label)
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
         self._latencies = deque(maxlen=window)  # seconds, completed only
         self._batch_rows = deque(maxlen=window)
         self._occupancy = deque(maxlen=window)  # occupied/capacity per step
         self._req_steps = deque(maxlen=window)  # decode steps per request
 
+    def _counter(self, name: str):
+        c = self._counters.get(name)
+        if c is None:
+            # unknown names keep working (the old dict accepted any key);
+            # insertion under the lock so a concurrent snapshot() never
+            # iterates a dict changing size
+            with self._lock:
+                c = self._counters.get(name)
+                if c is None:
+                    c = self._counters[name] = self._registry.counter(
+                        "serving_" + name, "serving counter (dynamic)",
+                        labels=("server",), server=self._label)
+        return c
+
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+        self._counter(name).inc(n)
 
     def observe_latency(self, seconds: float) -> None:
+        self._latency_hist.observe(seconds)
         with self._lock:
             self._latencies.append(seconds)
 
     def observe_batch(self, rows: int) -> None:
+        self._counter("batches").inc()
         with self._lock:
-            self._counters["batches"] += 1
             self._batch_rows.append(rows)
 
     def observe_slots(self, occupied: int, capacity: int) -> None:
@@ -76,8 +116,29 @@ class ServerMetrics:
             self._req_steps.append(int(steps))
 
     def count(self, name: str) -> int:
+        c = self._counters.get(name)
+        return 0 if c is None else int(c.value)
+
+    def unregister(self) -> None:
+        """Drop this server's series from the shared registry exposition
+        (called on server close): a process that creates and retires many
+        servers must not scrape dead servers' counters forever.  The
+        local child objects keep working — a closed server's
+        ``healthz()`` still reads its final numbers."""
         with self._lock:
-            return self._counters.get(name, 0)
+            names = list(self._counters)
+        for name in names:
+            self._registry.remove_series("serving_" + name,
+                                         server=self._label)
+        self._registry.remove_series("serving_latency_seconds",
+                                     server=self._label)
+
+    def set_count(self, name: str, value: int) -> None:
+        """Force a counter to an externally-owned value (the supervisor
+        owns worker_restarts — healthz mirrors it, and the registry view
+        must agree).  Atomic: concurrent healthz probes mirroring the
+        same value must not race a read-then-inc into a wrong total."""
+        self._counter(name).set_to(value)
 
     @staticmethod
     def _pct_ms(lat_sorted, p: float) -> Optional[float]:
@@ -96,7 +157,9 @@ class ServerMetrics:
 
     def snapshot(self) -> dict:
         with self._lock:
-            counters = dict(self._counters)
+            items = list(self._counters.items())
+        counters = {name: int(c.value) for name, c in items}
+        with self._lock:
             lat = sorted(self._latencies)
             rows = list(self._batch_rows)
             occ = list(self._occupancy)

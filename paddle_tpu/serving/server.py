@@ -362,6 +362,10 @@ class InferenceServer:
             in_flight,
             lambda: ServerClosed("server shut down with the batch in flight"),
             "server_closed")
+        # retire this server's series from the shared obs registry: the
+        # scrape endpoint must not grow a dead server=sN label set per
+        # restart (healthz() keeps reading the detached counters)
+        self.metrics.unregister()
 
     # ------------------------------------------------------------------
     # admission control
@@ -566,6 +570,12 @@ class InferenceServer:
         self.breaker.record_failure()
         if self.breaker.trips > trips_before:
             self.metrics.inc("breaker_trips")
+            # a trip is an incident: it joins the cross-rank causal
+            # timeline next to the gang/checkpoint records (no-op when
+            # --obs_journal is unarmed)
+            from paddle_tpu.obs import journal_event
+
+            journal_event("breaker_trip", trips=self.breaker.trips)
 
     # ------------------------------------------------------------------
     # the generation worker: continuous slot loop (serving/slots.py)
@@ -824,11 +834,12 @@ class InferenceServer:
         self._feeder = feeder
 
     def healthz(self) -> dict:
-        snap = self.metrics.snapshot()
         # the supervisor owns the relaunch count (it alone knows whether a
-        # crash led to a restart or exhausted the budget) — mirror it so
-        # the counter can never disagree with worker.restarts
-        snap["counters"]["worker_restarts"] = self.supervisor.restarts
+        # crash led to a restart or exhausted the budget) — mirror it into
+        # the registry view FIRST so healthz, /metrics, and
+        # worker.restarts can never disagree
+        self.metrics.set_count("worker_restarts", self.supervisor.restarts)
+        snap = self.metrics.snapshot()
         out = {
             "ready": self.ready,
             "state": self._state,

@@ -185,6 +185,30 @@ class SGDTrainer:
         # for supervised serving replicas, healthz())
         self._resize_count = 0
         self._last_resize_reason: Optional[str] = None
+        # unified telemetry (paddle_tpu/obs; docs/observability.md):
+        # the step timeline + event journal + profiler windows are bound
+        # per train() call; the registry handles live for the whole
+        # trainer so train_batch() outside train() still counts
+        from paddle_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._obs_gauges = {
+            "cost": reg.gauge("train_last_cost", "cost of the last step"),
+            "world": reg.gauge("train_world_size", "live gang world size"),
+        }
+        self._obs_counters = {
+            "batches": reg.counter("train_batches_total",
+                                   "optimizer steps taken"),
+            "bad_steps": reg.counter("train_bad_steps_total",
+                                     "guard-skipped non-finite steps"),
+            "checkpoints": reg.counter("train_checkpoints_total",
+                                       "checkpoint commits published"),
+            "resizes": reg.counter("train_resizes_total",
+                                   "elastic resizes adopted"),
+        }
+        self.timeline = None
+        self._journal = None
+        self._profiler = None
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -215,17 +239,23 @@ class SGDTrainer:
             proxies = tier.make_proxies(feed) if tier is not None else {}
 
             def loss_fn(p, px):
-                overrides = (tier.make_overrides(ps["tables"], px)
-                             if tier is not None else None)
-                outs, new_state = topo.apply(
-                    p, state, feed, train=True, rng=rng,
-                    device_specs=device_specs,
-                    param_overrides=overrides,
-                )
-                extras = {k: outs[k].value for k in extra_names}
-                total = sum(
-                    w * outs[n].value for n, w in zip(cost_names, cost_weights)
-                )
+                # named_scope: the backward ops XLA derives from this
+                # trace inherit "transpose(forward)" provenance, so an
+                # on-demand profiler capture (obs/profiler.py) reads as
+                # forward / backward / optimizer_apply in XProf
+                with jax.named_scope("forward"):
+                    overrides = (tier.make_overrides(ps["tables"], px)
+                                 if tier is not None else None)
+                    outs, new_state = topo.apply(
+                        p, state, feed, train=True, rng=rng,
+                        device_specs=device_specs,
+                        param_overrides=overrides,
+                    )
+                    extras = {k: outs[k].value for k in extra_names}
+                    total = sum(
+                        w * outs[n].value
+                        for n, w in zip(cost_names, cost_weights)
+                    )
                 return total, (new_state, extras)
 
             (loss, (new_state, extras)), (grads, px_grads) = (
@@ -233,6 +263,10 @@ class SGDTrainer:
                     params, proxies))
 
             def do_update(pack, gpack, o):
+                with jax.named_scope("optimizer_apply"):
+                    return do_update_inner(pack, gpack, o)
+
+            def do_update_inner(pack, gpack, o):
                 p, ps_in = pack
                 g, pxg = gpack
                 clip = True
@@ -362,6 +396,60 @@ class SGDTrainer:
                 out[k] = put(v)
         return out
 
+    # -- telemetry helpers (paddle_tpu/obs) ----------------------------
+
+    def _ph(self, name: str, sync: Any = None):
+        """Timeline phase context (nullcontext when the timeline is off —
+        the uninstrumented loop pays one attribute check per phase)."""
+        from contextlib import nullcontext
+
+        tl = self.timeline
+        if tl is None:
+            return nullcontext()
+        return tl.phase(name, sync=sync)
+
+    @property
+    def _h2d_measurable(self) -> bool:
+        """Whether an explicit synced transfer would measure anything
+        real: yes across a mesh (sharded placement) or to an
+        accelerator; no on single-device CPU, where the backend aliases
+        host buffers and an explicit ``device_put`` is a pure extra copy
+        (measured: ~0.4ms/batch of fake 'transfer' for a 512 KiB feed)."""
+        return self.mesh is not None or jax.default_backend() != "cpu"
+
+    def _device_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
+        """Transfer the prepared feed host->device and BLOCK, so the
+        timeline's ``h2d`` phase measures real transfer time and the
+        ``step`` phase that follows is pure compute+dispatch.
+        ``device_put`` + one tree-level block: the cheapest explicit
+        transfer (no per-leaf op machinery, transfers overlap)."""
+        if self.mesh is not None:
+            out = self._shard_feed(feed)
+        else:
+            put = jax.device_put
+            out = {k: (tuple(put(x) for x in v) if isinstance(v, tuple)
+                       else put(v))
+                   for k, v in feed.items()}
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass  # non-array leaves (host-side aux) pass through
+        return out
+
+    def step_flops(self, feed: Dict[str, Any]) -> Optional[float]:
+        """Analytic matmul+conv FLOPs of ONE train step (forward +
+        backward + optimizer), from the SAME ``analysis.flops`` walker
+        ``bench.py`` uses — the live MFU gauge and the bench rows cannot
+        disagree (pinned by tests/test_obs.py)."""
+        from paddle_tpu.analysis.flops import jaxpr_flops
+
+        if self.mesh is not None:
+            feed = self._shard_feed(feed)
+        ps = self.pserver.state() if self.pserver is not None else {}
+        rng = jax.random.PRNGKey(0)
+        return jaxpr_flops(self._step_fn, self.params, self.state,
+                           self.opt_state, ps, rng, feed)
+
     # ------------------------------------------------------------------
 
     def rebuild_masks(self) -> None:
@@ -441,8 +529,10 @@ class SGDTrainer:
             self.pserver.adopt(new_ps)
         if self.averager is not None:
             self.avg_params = self.averager.update(self.avg_params, self.params)
+        self._obs_counters["batches"].inc()
         self._last_extras = extras
         if self._gang is not None:
+            self._obs_gauges["world"].set(self._gang.world_size)
             # elastic observability: the live world, whether it is running
             # degraded (fewer ranks than configured), and the resize story
             self._last_extras = {
@@ -456,6 +546,13 @@ class SGDTrainer:
             if bool(jax.device_get(extras["bad_step"])):
                 self.bad_steps_total += 1
                 self._bad_streak += 1
+                self._obs_counters["bad_steps"].inc()
+                if self._journal is not None:
+                    # a skipped step is an incident, not a log line: it
+                    # lands in the causal timeline with pass/batch context
+                    self._journal.record("bad_step",
+                                         streak=self._bad_streak,
+                                         total=self.bad_steps_total)
                 logger.warning(
                     "non-finite loss/grad: optimizer update skipped "
                     "(streak %d, total %d)", self._bad_streak,
@@ -513,13 +610,35 @@ class SGDTrainer:
         ``--enable_timers`` (Stat.h:70-247 print-per-pass), and an opt-in
         ``jax.profiler`` trace via ``--profile_dir`` — the hl_profiler_start/
         end analog (hl_cuda.h:338-343), viewable in TensorBoard/XProf."""
+        from paddle_tpu.obs import (ProfilerCapture, StepTimeline,
+                                    ensure_metrics_server, get_journal)
         from paddle_tpu.utils.stat import print_stats, timer
 
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
-        profiling = bool(FLAGS.profile_dir)
+        # --profile_steps turns the whole-run trace into bounded windows
+        profiling = bool(FLAGS.profile_dir) and not FLAGS.profile_steps
 
         gang = self._gang = current_gang()
+        # unified telemetry (docs/observability.md): exposition endpoint,
+        # step timeline, per-rank event journal, profiler windows
+        ensure_metrics_server()
+        tl = self.timeline = (StepTimeline(
+            n_devices=(self.mesh.devices.size if self.mesh is not None
+                       else 1)) if FLAGS.obs_timeline else None)
+        jr = self._journal = get_journal(
+            rank=(getattr(gang, "rank", 0) if gang is not None else 0),
+            world_size=(gang.world_size if gang is not None else 1))
+        if jr is not None:
+            if gang is not None:
+                jr.set_context(epoch=gang.epoch)
+            jr.record("train_start", num_passes=num_passes,
+                      resume=resume or FLAGS.resume or "")
+        profiler = self._profiler = (
+            ProfilerCapture(FLAGS.profile_dir, FLAGS.profile_steps)
+            if FLAGS.profile_dir and FLAGS.profile_steps else None)
+        if profiler is not None:
+            profiler.install_signal()
         resume = resume or FLAGS.resume or None
         start_pass, start_batch = FLAGS.start_pass, 0
         if resume is not None and resume != "auto":
@@ -547,6 +666,9 @@ class SGDTrainer:
         try:
             for pass_id in range(start_pass, num_passes):
                 handler(ev.BeginPass(pass_id))
+                if jr is not None:
+                    jr.set_context(pass_id=pass_id, batch_id=0)
+                    jr.record("begin_pass")
                 costs: List[float] = []
                 loss = None
                 t0 = time.time()
@@ -555,6 +677,9 @@ class SGDTrainer:
                     # pass teardown reaches the handlers even on failure,
                     # and the crash is attributed to the reader tier
                     handler(ev.EndPass(pass_id))
+                    if jr is not None:
+                        jr.record("reader_error",
+                                  error=f"{type(e).__name__}: {e}")
                     if isinstance(e, ReaderError):
                         return e
                     return ReaderError(
@@ -591,7 +716,7 @@ class SGDTrainer:
                         self._preempt_exit(pass_id, batch_id + skip,
                                            preemption, handler)
                         return
-                    with timer("DataWaitTimer"):
+                    with timer("DataWaitTimer"), self._ph("data_wait"):
                         try:
                             data_batch = next(it, None)
                         except Exception as e:
@@ -604,15 +729,43 @@ class SGDTrainer:
                         skip -= 1
                         batch_id += 1
                         continue
-                    handler(ev.BeginIteration(pass_id, batch_id))
-                    with timer("PrepareBatch"):
+                    if jr is not None:
+                        jr.set_context(batch_id=batch_id)
+                    with self._ph("callback"):
+                        handler(ev.BeginIteration(pass_id, batch_id))
+                    with timer("PrepareBatch"), self._ph("prepare"):
                         feed = feeder(data_batch) if feeder else data_batch
+                    if tl is not None and self._h2d_measurable:
+                        # explicit, synced host->device transfer: the h2d
+                        # phase is real transfer time, and the step phase
+                        # that follows starts device-resident (on single-
+                        # device CPU there is no boundary to measure —
+                        # skipped, the alias-copy rides inside `step`)
+                        with tl.phase("h2d"):
+                            feed = self._device_feed(feed)
+                    if profiler is not None:
+                        # BEFORE the step: a window armed at batch b
+                        # traces batches b..b+N-1 exactly — ticking after
+                        # the step would shift the capture one step late
+                        # and make the first post-compile step untraceable
+                        profiler.tick()
                     try:
-                        with timer("TrainBatch", sync=lambda: loss):
+                        with timer("TrainBatch", sync=lambda: loss), \
+                                self._ph("step", sync=lambda: loss):
                             loss = self.train_batch(feed)
                     except TooManyBadSteps:
                         handler(ev.EndPass(pass_id))
+                        if jr is not None:
+                            jr.record("train_abort",
+                                      reason="too_many_bad_steps")
                         raise
+                    if tl is not None and tl.wants_mfu and \
+                            not tl.flops_attempted:
+                        # ONE extra host-side trace per compiled program,
+                        # only when a chip peak is resolvable — a failed
+                        # trace (None) is not retried per batch
+                        tl.set_flops(self.step_flops(feed))
+                        tl.recompute_mfu()
                     drops = getattr(feeder, "dropped_features", None)
                     if drops is not None:
                         # sparse-bag truncation is a data-loss event, not a
@@ -623,7 +776,15 @@ class SGDTrainer:
                                              "dropped_features": int(drops)}
                     cost = float(loss)
                     costs.append(cost)
-                    handler(ev.EndIteration(pass_id, batch_id, cost))
+                    if tl is not None:
+                        self._obs_gauges["cost"].set(cost)
+                        self._last_extras = {
+                            **self._last_extras,
+                            "step_time_s": tl.last.get("step"),
+                            "mfu": tl.mfu,
+                        }
+                    with self._ph("callback"):
+                        handler(ev.EndIteration(pass_id, batch_id, cost))
                     if log_period and (batch_id + 1) % log_period == 0:
                         logger.info(
                             "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
@@ -639,22 +800,25 @@ class SGDTrainer:
                             and (batch_id + 1) % tp == 0):
                         # mid-pass eval — test_period batches (Trainer.cpp
                         # trainOneBatch "testing" branch; 0 = per pass only)
-                        with timer("TestTimer"):
+                        with timer("TestTimer"), self._ph("eval"):
                             mid = self.test(test_reader, feeder=feeder)
                         logger.info("Pass %d, Batch %d, Test cost %.5f",
                                     pass_id, batch_id + 1, mid["cost"])
                     batch_id += 1
                 result = {}
                 if test_reader is not None:
-                    with timer("TestTimer"):
+                    with timer("TestTimer"), self._ph("eval"):
                         result = self.test(test_reader, feeder=feeder)
-                handler(ev.EndPass(pass_id, evaluator=result))
+                with self._ph("callback"):
+                    handler(ev.EndPass(pass_id, evaluator=result))
+                if jr is not None:
+                    jr.record("end_pass", batches=batch_id)
                 if FLAGS.enable_timers:
                     print_stats()
                 if FLAGS.save_dir and FLAGS.saving_period and (
                     (pass_id + 1) % FLAGS.saving_period == 0
                 ):
-                    with timer("SaveCheckpoint"):
+                    with timer("SaveCheckpoint"), self._ph("checkpoint"):
                         try:
                             self.save(FLAGS.save_dir, pass_id)
                         except GangResized as e:
@@ -663,6 +827,11 @@ class SGDTrainer:
                             # end-of-pass checkpoint
                             self._gang_resize(gang, e.world, pass_id,
                                               None, handler)
+                if tl is not None:
+                    if FLAGS.enable_timers:
+                        logger.info("step timeline (pass %d):\n%s",
+                                    pass_id, tl.table())
+                    tl.end_pass(pass_id, journal=jr)
             if gang is not None and num_passes > start_pass:
                 # one last look before returning — and, while the gang is
                 # running DEGRADED, a bounded linger.  The supervisor
@@ -688,6 +857,11 @@ class SGDTrainer:
         finally:
             if profiling:
                 jax.profiler.stop_trace()
+            if profiler is not None:
+                profiler.close()
+                profiler.uninstall_signal()
+            if jr is not None:
+                jr.record("train_end", preempted=self.preempted)
             if preemption is not None:
                 preemption.uninstall()
 
@@ -698,6 +872,8 @@ class SGDTrainer:
         checkpoint (manifest records ``next_batch`` so ``resume="auto"``
         re-enters this pass at this exact batch) and return cleanly."""
         self.preempted = True
+        if self._journal is not None:
+            self._journal.record("preempt", saving=bool(FLAGS.save_dir))
         if FLAGS.save_dir:
             try:
                 d = self.save(FLAGS.save_dir, pass_id,
@@ -765,6 +941,15 @@ class SGDTrainer:
         self._mesh_resize()
         self._resize_count += 1
         self._last_resize_reason = world.get("reason")
+        self._obs_counters["resizes"].inc()
+        if self._journal is not None:
+            self._journal.set_context(epoch=epoch,
+                                      world_size=len(new_ranks))
+            self._journal.record(
+                "gang_resize", fsync=True, epoch=epoch,
+                new_world=len(new_ranks), grew=grew,
+                reason=world.get("reason", ""),
+                next_batch=-1 if next_batch is None else next_batch)
         logger.warning(
             "elastic resize: %s to %d rank(s) (epoch %d) at pass %d%s — %s",
             "grew" if grew else "shrank", len(new_ranks), epoch, pass_id,
@@ -811,6 +996,13 @@ class SGDTrainer:
             self.pserver.resize(self.mesh)
         self._place_sharded()
         self._step = self._build_step()
+        if self.timeline is not None:
+            # the program changed shape: stale FLOPs would skew the MFU
+            # gauge — recompute lazily at the next step, against the
+            # resized mesh's aggregate peak
+            self.timeline.invalidate_flops()
+            self.timeline.set_devices(
+                self.mesh.devices.size if self.mesh is not None else 1)
         logger.info("mesh re-instantiated: %r", cfg)
 
     def _auto_resume(self) -> tuple:
@@ -879,6 +1071,11 @@ class SGDTrainer:
         gang.ack_resize()
         self._resize_count += 1
         self._last_resize_reason = "joined"
+        if self._journal is not None:
+            self._journal.set_context(epoch=gang.epoch,
+                                      world_size=gang.world_size)
+            self._journal.record("gang_join", epoch=gang.epoch,
+                                 restored_pass=p)
         return int(decision["start_pass"]), int(decision["start_batch"])
 
     def _gang_auto_resume(self, gang, save_dir: str) -> tuple:
@@ -1055,13 +1252,21 @@ class SGDTrainer:
             # atomic CRC-manifested checkpoint: a lost shard rank restores
             # its rows from the manifest through the gang supervisor
             extra["pserver"] = self.pserver.state()
-        return save_checkpoint(
+        d = save_checkpoint(
             save_dir, pass_id,
             params=self.params, state=self.state, opt_state=self.opt_state,
             extra=extra or None, meta=meta,
             barrier=(gang.barrier if gang is not None and gang.size > 1
                      else None),
         )
+        self._obs_counters["checkpoints"].inc()
+        if self._journal is not None:
+            # fsync'd: the durable anchor a postmortem orders everything
+            # against (torn-tail tolerance covers everything after it)
+            self._journal.record("checkpoint_commit", fsync=True,
+                                 saved_pass=pass_id, dir=d,
+                                 preempted=bool(meta.get("preempted")))
+        return d
 
     def load(self, save_dir: str, pass_id: int, *,
              validate: bool = True) -> Dict[str, Any]:

@@ -346,4 +346,28 @@ define_flag("deploy_lint", True,
 define_flag("enable_timers", False, "collect Stat timer registry stats")
 define_flag("profile_dir", "", "write a jax.profiler trace here during train() "
             "(hl_profiler_start/end analog; view with TensorBoard/XProf)")
+define_flag("profile_steps", 0, "capture bounded jax.profiler windows of N "
+            "steps into --profile_dir instead of one whole-run trace "
+            "(first window flag-armed after the compile step; SIGUSR2 "
+            "arms another on a live job; 0 = whole-run behavior)",
+            validator=lambda v: v >= 0)
 define_flag("prefetch_batches", 2, "data provider background prefetch depth")
+
+# Unified telemetry (paddle_tpu/obs; docs/observability.md)
+define_flag("metrics_port", 0, "serve the process-wide metrics registry "
+            "over HTTP on this port (/metrics Prometheus text, "
+            "/metrics.json snapshot; 0 = off)",
+            validator=lambda v: 0 <= v <= 65535)
+define_flag("obs_journal", "", "directory for the rank-tagged structured "
+            "event journal (append-only events-r*.jsonl; merge ranks with "
+            "`python -m paddle_tpu obs merge DIR`; '' = off)")
+define_flag("obs_timeline", True, "instrument the training loop into "
+            "phases (data-wait/prepare/h2d/step/callback/checkpoint/eval) "
+            "aggregated per pass and into registry histograms, plus the "
+            "live MFU gauge when a chip peak is known (host-side only — "
+            "the compiled step is unchanged, gated by `lint --obs`)")
+define_flag("obs_peak_flops", 0.0, "override the TOTAL peak FLOP/s the "
+            "live MFU gauge divides by (0 = chip table x mesh size from "
+            "the device kind; off-TPU there is no peak, so the gauge "
+            "stays dark unless this is set)",
+            validator=lambda v: v >= 0.0)

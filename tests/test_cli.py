@@ -178,6 +178,58 @@ def test_cli_help_lists_serve_flags(capsys):
         assert flag in out, flag
 
 
+def test_cli_help_lists_obs_flags(capsys):
+    """The telemetry knobs (docs/observability.md) ride the auto-generated
+    flag table."""
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "python -m paddle_tpu obs" in out
+    for flag in ("--metrics_port", "--obs_journal", "--obs_timeline",
+                 "--obs_peak_flops", "--profile_steps"):
+        assert flag in out, flag
+
+
+def _write_obs_journal(journal_dir, rank, kinds):
+    from paddle_tpu.obs import EventJournal, journal_path
+
+    j = EventJournal(journal_path(str(journal_dir), rank), rank=rank,
+                     world_size=2)
+    j.set_context(pass_id=0)
+    for k in kinds:
+        j.record(k)
+    j.close()
+
+
+def test_cli_obs_merge_interleaves_rank_journals(tmp_path, capsys):
+    """`python -m paddle_tpu obs merge DIR` — one causal timeline out of
+    per-rank journals, with --kind filtering and a JSON mode."""
+    _write_obs_journal(tmp_path, 0, ["begin_pass", "checkpoint_commit"])
+    _write_obs_journal(tmp_path, 1, ["begin_pass", "gang_resize"])
+    assert main(["obs", "merge", str(tmp_path)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 4 and "begin_pass" in out[0]
+    assert main(["obs", "merge", str(tmp_path), "--format", "json",
+                 "--kind", "gang_resize"]) == 0
+    import json as _json
+
+    rows = [_json.loads(x) for x in
+            capsys.readouterr().out.strip().splitlines()]
+    assert len(rows) == 1 and rows[0]["kind"] == "gang_resize"
+    assert rows[0]["rank"] == 1 and rows[0]["pass"] == 0
+
+
+def test_cli_obs_dump_summarizes_and_empty_exits_2(tmp_path, capsys):
+    _write_obs_journal(tmp_path, 0, ["bad_step", "bad_step", "end_pass"])
+    assert main(["obs", "dump", str(tmp_path)]) == 0
+    captured = capsys.readouterr()
+    assert "# bad_step: 2" in captured.err
+    assert len(captured.out.strip().splitlines()) == 3
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["obs", "merge", str(empty)]) == 2
+    assert "no journal records" in capsys.readouterr().err
+
+
 def test_cli_rejects_bad_args():
     with pytest.raises(ConfigError, match="unrecognized"):
         main([f"--config={CONF}", "--job=train", "--no_such_flag=1"])

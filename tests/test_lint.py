@@ -638,3 +638,14 @@ def test_unrolled_scans_lock_serializes_and_restores():
         t.join()
     assert all(patched_seen)
     assert jax.lax.scan is orig  # fully restored after concurrent exports
+
+
+def test_lint_obs_gates_telemetry_contract(capsys):
+    """`python -m paddle_tpu lint --obs` (docs/observability.md): the
+    train step traced with telemetry enabled must be host-transfer-free
+    AND equation-identical to the telemetry-off trace — exit 0 today,
+    and any instrumentation leaking into the compiled program fails CI."""
+    from paddle_tpu.analysis.cli import run
+
+    assert run(["--obs"]) == 0
+    assert "0 error(s)" in capsys.readouterr().out

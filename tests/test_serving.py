@@ -616,3 +616,47 @@ def test_preflight_audit_clean_on_tiny_bundle(tmp_path, rng):
     findings = audit_serving(model)
     assert not [f for f in findings if f.severity == "ERROR"], findings
     check_serving(model)  # must not raise
+
+
+def test_healthz_counter_key_set_pinned_for_dashboards():
+    """Regression pin (docs/observability.md): the healthz() snapshot
+    pre-seeds EVERY counter key — a dashboard must see shed=0, never a
+    vanished key — and the schema survives the migration of ServerMetrics
+    onto the shared obs registry.  Keys are spelled out on purpose:
+    renaming or dropping one is a dashboard-facing break that must fail
+    CI, not slide through a refactor."""
+    from paddle_tpu.obs import get_registry
+    from paddle_tpu.serving.metrics import ServerMetrics
+
+    expected = {
+        "submitted", "accepted", "completed", "shed", "invalid_request",
+        "deadline_infeasible", "deadline_expired", "breaker_rejected",
+        "breaker_trips", "inference_failed", "worker_crashed",
+        "server_closed", "worker_restarts", "degraded", "batches",
+        "gen_steps", "slot_recycled", "slot_evicted",
+    }
+    m = ServerMetrics()
+    snap = m.snapshot()
+    assert set(snap["counters"]) == expected
+    assert all(v == 0 for v in snap["counters"].values())
+    for key in ("p50_ms", "p99_ms", "mean_batch_rows",
+                "mean_slot_occupancy", "mean_request_steps"):
+        assert key in snap
+    # the counters ARE registry series: scrape and healthz agree, and
+    # set_count (supervisor-owned worker_restarts) keeps them in step
+    m.inc("shed")
+    m.set_count("worker_restarts", 3)
+    snap2 = m.snapshot()
+    assert snap2["counters"]["shed"] == 1
+    assert snap2["counters"]["worker_restarts"] == 3
+    reg = {s["labels"]["server"]: s["value"]
+           for s in get_registry().snapshot()[
+               "serving_worker_restarts"]["series"]}
+    assert reg[m._label] == 3.0
+    # a retired server drops out of exposition (no unbounded server=sN
+    # growth across restarts) but its local snapshot keeps working
+    m.unregister()
+    gone = {s["labels"]["server"]
+            for s in get_registry().snapshot()["serving_shed"]["series"]}
+    assert m._label not in gone
+    assert m.snapshot()["counters"]["shed"] == 1

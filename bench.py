@@ -165,8 +165,16 @@ def _roofline(sec, carry):
     implies at the measured step time.  Reading the pair: bw_frac near 1
     with modest MFU = the row sits on the memory roofline (structural
     ceiling); bw_frac AND mfu both low = launch-bound (the documented
-    smallnet/googlenet-b64 floor), not a kernel regression."""
+    smallnet/googlenet-b64 floor), not a kernel regression.
+
+    bf16-aware (--amp; docs/mixed_precision.md): under amp the two
+    compute-side parameter streams (forward read + gradient write) move
+    at the compute-dtype width while the four optimizer streams stay f32
+    masters — the floor shrinks to (2*cw/4 + 4) x param bytes, so an amp
+    row's bw_frac is judged against the traffic it actually moves."""
     import jax
+
+    from paddle_tpu.utils.flags import FLAGS
 
     bw = _chip_bw(jax.devices()[0].device_kind)
     if bw is None or sec <= 0:
@@ -175,7 +183,8 @@ def _roofline(sec, carry):
     nbytes = lambda x: int(getattr(x, "nbytes", 0))  # no host pulls
     pbytes = sum(nbytes(x) for x in jax.tree_util.tree_leaves(params))
     fbytes = sum(nbytes(x) for x in jax.tree_util.tree_leaves(feeds))
-    floor = 6 * pbytes + 2 * fbytes
+    cw = 2.0 if FLAGS.amp else 4.0  # compute-stream bytes/elem (f32 masters)
+    floor = (2.0 * cw / 4.0 + 4.0) * pbytes + 2 * fbytes
     return {"bytes_floor": int(floor),
             "bw_frac": round(floor / sec / bw, 4)}
 
@@ -185,12 +194,15 @@ def _roofline(sec, carry):
 # ---------------------------------------------------------------------------
 
 
-def _topology_step(cost, opt, feeds, *, extra_state=True):
+def _topology_step(cost, opt, feeds, *, extra_state=True, remat=False):
     """(carry -> (carry, loss)) train step over a nn.Topology graph.
 
     ``feeds`` ride in the carry (unchanged) rather than the closure: a
     closed-over batch becomes an HLO *constant*, and a b512 image batch
-    (403 MB) overflows the axon tunnel's remote-compile request limit."""
+    (403 MB) overflows the axon tunnel's remote-compile request limit.
+    ``remat=True`` wraps the loss in ``jax.checkpoint`` — the backward
+    recomputes the forward (the --remat trainer flag's policy), the lever
+    that fits larger batches for the MFU-starved recurrent rows."""
     import jax
 
     import paddle_tpu.nn as nn
@@ -207,6 +219,8 @@ def _topology_step(cost, opt, feeds, *, extra_state=True):
                                          rng=jax.random.PRNGKey(0))
             return outs[cost.name].value, new_state
 
+        if remat:
+            loss_fn = jax.checkpoint(loss_fn)
         (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         new_params, new_opt = opt.update(params, grads, opt_state)
         return (new_params, new_state, new_opt, feeds), loss
@@ -360,7 +374,7 @@ def bench_seq2seq_decode(rtt, peak):
     }
 
 
-def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256):
+def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256, remat=False):
     """Published RNN benchmark rows: 2-layer LSTM text-clf, T100 vocab 30k
     on 1x K40m — 83 ms (b64 h256), 184 (b64 h512), 641 (b64 h1280),
     110 (b128 h256), 170 (b256 h256) (reference: benchmark/README.md:112-135,
@@ -382,7 +396,8 @@ def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256):
                   jnp.asarray(rng.randint(T // 2, T + 1, B).astype(np.int32))),
         "label": jnp.asarray(rng.randint(0, 2, (B, 1))),
     }
-    one_step, carry = _topology_step(cost, Adam(learning_rate=1e-3), feeds)
+    one_step, carry = _topology_step(cost, Adam(learning_rate=1e-3), feeds,
+                                     remat=remat)
     sec, flops, (lo, hi) = _time_chain(one_step, carry, iters=50, rtt=rtt)
     ms = sec * 1e3
     # analytic 3x-forward FLOPs (cost_analysis undercounts scan bodies):
@@ -391,9 +406,11 @@ def bench_lstm_textclf(rtt, peak, batch_size=64, hidden=256):
            + (L - 1) * (B * T * HID * 4 * HID * 2 * 2)               # deeper
            + B * HID * 2 * 2)
     base = published.get((B, HID))
+    tag = ",remat" if remat else ""
     return {
-        "metric": f"lstm_textclf_train_ms_per_batch(b{B},h{HID},T100,vocab30k)",
-        "short": f"lstm_b{B}h{HID}",
+        "metric": f"lstm_textclf_train_ms_per_batch(b{B},h{HID},T100,"
+                  f"vocab30k{tag})",
+        "short": f"lstm_b{B}h{HID}" + ("r" if remat else ""),
         "value": round(ms, 3),
         "unit": "ms/batch",
         "vs_baseline": round(base / ms, 3) if base else None,
@@ -708,6 +725,110 @@ def bench_pallas_decode_ab(rtt, peak):
     }
 
 
+def bench_amp_ab(rtt, peak):
+    """A/B mixed-precision (--amp) vs the default policy on the headline
+    seq2seq shape AND one LSTM text-clf config — settles FLAGS.amp the way
+    pallas_lstm_ab settles its kernel flag (winner/default_flag contract).
+
+    The baseline on TPU already runs bf16 MATMUL OPERANDS with f32
+    activations (FLAGS.compute_dtype); --amp additionally keeps
+    activations — and, via dtype-carrying cotangents, the whole backward —
+    in bf16 (docs/mixed_precision.md), so the delta isolates the
+    activation-width halving.  Both arms time the raw fwd+bwd+update step
+    (the dynamic loss-scale multiply is one scalar op and rides inside the
+    amp arm).  ``vs_baseline`` = f32_ms / amp_ms on the seq2seq row (>1 =
+    amp faster); winner needs a >=5% seq2seq win, like the other A/Bs."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import Seq2SeqAttention, lstm_benchmark_net
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.utils.flags import FLAGS
+
+    import paddle_tpu.nn as nn
+
+    def seq2seq_step():
+        B, S, T = 384, 32, 32
+        m = Seq2SeqAttention()
+        params = m.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        trg_core = rng.randint(3, m.trg_vocab, (B, T - 1)).astype(np.int32)
+        batch = {
+            "src_ids": jnp.asarray(
+                rng.randint(3, m.src_vocab, (B, S)).astype(np.int32)),
+            "src_len": jnp.full((B,), S, jnp.int32),
+            "trg_in": jnp.asarray(
+                np.concatenate([np.zeros((B, 1), np.int32), trg_core], 1)),
+            "trg_next": jnp.asarray(
+                np.concatenate([trg_core, np.ones((B, 1), np.int32)], 1)),
+            "trg_len": jnp.full((B,), T, jnp.int32),
+        }
+        opt = Adam(learning_rate=1e-3)
+        opt_state = opt.init_state(params)
+
+        def one_step(carry):
+            params, opt_state, batch = carry
+            loss, grads = jax.value_and_grad(m.loss)(params, batch)
+            new_params, new_opt = opt.update(params, grads, opt_state)
+            return (new_params, new_opt, batch), loss
+
+        return one_step, (params, opt_state, batch)
+
+    def lstm_step():
+        VOCAB, B, T, HID, EMB = 30000, 64, 100, 256, 128
+        nn.reset_naming()
+        cost, _ = lstm_benchmark_net(VOCAB, emb_dim=EMB, hid_dim=HID,
+                                     num_layers=2)
+        rng = np.random.RandomState(0)
+        feeds = {
+            "words": (jnp.asarray(
+                rng.randint(3, VOCAB, (B, T)).astype(np.int32)),
+                jnp.asarray(
+                    rng.randint(T // 2, T + 1, B).astype(np.int32))),
+            "label": jnp.asarray(rng.randint(0, 2, (B, 1))),
+        }
+        return _topology_step(cost, Adam(learning_rate=1e-3), feeds)
+
+    def run(build, amp, iters):
+        old = FLAGS.amp
+        FLAGS.amp = amp  # dtype policy reads the flag at trace time
+        try:
+            one_step, carry = build()  # fresh closures -> fresh jit cache
+            sec, _, spread = _time_chain(one_step, carry, iters=iters,
+                                         rtt=rtt, reps=5)
+            return sec, spread
+        finally:
+            FLAGS.amp = old
+
+    s2s_f32, s2s_f32_sp = run(seq2seq_step, False, 20)
+    s2s_amp, s2s_amp_sp = run(seq2seq_step, True, 20)
+    lstm_f32, _ = run(lstm_step, False, 50)
+    lstm_amp, _ = run(lstm_step, True, 50)
+    if s2s_amp < 0.95 * s2s_f32:
+        winner = "amp"
+    elif s2s_f32 < 0.95 * s2s_amp:
+        winner = "f32"
+    else:
+        winner = "tie"
+    return {
+        "metric": "amp_ab_seq2seq_ms(B384,S32,T32)+lstm(b64,h256)",
+        "short": "amp_ab",
+        "value": round(s2s_amp * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(s2s_f32 / s2s_amp, 3),
+        "mfu": None,
+        "f32_ms": round(s2s_f32 * 1e3, 3),
+        "f32_ms_min": round(s2s_f32_sp[0] * 1e3, 3),
+        "f32_ms_max": round(s2s_f32_sp[1] * 1e3, 3),
+        "amp_ms_min": round(s2s_amp_sp[0] * 1e3, 3),
+        "amp_ms_max": round(s2s_amp_sp[1] * 1e3, 3),
+        "lstm_f32_ms": round(lstm_f32 * 1e3, 3),
+        "lstm_amp_ms": round(lstm_amp * 1e3, 3),
+        "winner": winner,
+        "default_flag": bool(FLAGS.amp),
+    }
+
+
 def bench_serving_continuous_ab(rtt, peak):
     """A/B continuous slot-based batching (serving/slots.py) vs lock-step
     bucket batching under a mixed-length synthetic trace: 90% short
@@ -986,8 +1107,10 @@ def main() -> None:
         safe(bench_googlenet, batch_size=64),
         safe(bench_googlenet),
         safe(bench_googlenet, batch_size=256),
+        safe(bench_lstm_textclf, batch_size=512, hidden=256, remat=True),
         safe(bench_pallas_lstm_ab),
         safe(bench_pallas_decode_ab),
+        safe(bench_amp_ab),
         safe(bench_serving_continuous_ab),
         safe(bench_sharded_embedding_ab),
     ]

@@ -18,6 +18,7 @@ from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
 from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
                                              audit_decode, audit_fn,
                                              audit_jaxpr,
+                                             audit_amp_matmuls,
                                              audit_no_dense_rows)
 from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
                                           lint_source)
@@ -40,6 +41,7 @@ __all__ = [
     "audit_fn",
     "audit_decode",
     "audit_no_dense_rows",
+    "audit_amp_matmuls",
     "DECODE_CHECKS",
     "JAXPR_CHECKS",
     "AST_CHECKS",

@@ -1,0 +1,81 @@
+"""``lint --amp`` — prove the mixed-precision step is actually bf16.
+
+The whole point of ``--amp`` (docs/mixed_precision.md) is that every
+matmul/conv in the compiled train step takes bf16 operands — the f32
+allowlist (BN statistics, softmax/logsumexp reductions, the loss) is made
+of reductions, which this gate does not touch.  A single silently-promoted
+``dot_general`` costs 2x MXU cycles exactly where the mode exists to save
+them, and nothing at runtime would ever tell you.
+
+This audit builds a representative trainer — embedding, stacked LSTM (the
+scan-heavy shape the MFU push targets), batch-norm'd fc head, softmax CE —
+with ``FLAGS.amp`` forced on, traces the REAL jitted step (forward +
+backward + loss scaling + guarded fused optimizer apply, the exact closure
+``train_batch`` compiles), and ERRORs on
+
+1. any all-f32 ``dot_general``/``conv_general_dilated`` outside the
+   allowlist (``analysis.audit_amp_matmuls``), and
+2. an amp trace containing NO bf16 MXU op at all (the policy never
+   engaged).
+
+The same check runs over user models via ``SGDTrainer.audit`` +
+``audit_amp_matmuls``, and tests assert it over a real model's step
+(tests/test_amp.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from paddle_tpu.analysis.findings import Finding
+
+__all__ = ["audit_amp_step"]
+
+
+def _amp_trainer():
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import lstm_benchmark_net
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.trainer import SGDTrainer
+
+    nn.reset_naming()
+    cost, _ = lstm_benchmark_net(256, emb_dim=32, hid_dim=32, num_layers=1)
+    tr = SGDTrainer(cost, Adam(learning_rate=1e-3), seed=0)
+    rs = np.random.RandomState(0)
+    B, T = 4, 8
+    feed = {
+        "words": (rs.randint(3, 256, (B, T)).astype(np.int32),
+                  np.full((B,), T, np.int32)),
+        "label": rs.randint(0, 2, (B, 1)).astype(np.int32),
+    }
+    return tr, feed
+
+
+def audit_amp_step(allow: Sequence[str] = ()) -> List[Finding]:
+    """Trace the representative trainer step under ``--amp`` and gate the
+    zero-non-allowlisted-f32-matmuls contract; returns findings."""
+    import jax
+
+    from paddle_tpu.analysis.jaxpr_audit import audit_amp_matmuls
+    from paddle_tpu.utils.flags import FLAGS
+
+    findings: List[Finding] = []
+    keep = FLAGS.amp
+    try:
+        FLAGS.amp = True
+        tr, feed = _amp_trainer()
+        rng = jax.random.PRNGKey(0)
+        closed = jax.make_jaxpr(tr._step_fn)(
+            tr.params, tr.state, tr.opt_state, {}, rng, feed)
+        findings.extend(audit_amp_matmuls(closed, label="amp:train_step",
+                                          allow=allow))
+    except Exception as e:  # a step that fails to trace is itself a finding
+        findings.append(Finding(
+            check="amp-build", severity="ERROR", where="amp:train_step",
+            message=f"amp audit failed to build/trace the step: "
+                    f"{type(e).__name__}: {e}"))
+    finally:
+        FLAGS.amp = keep
+    return findings

@@ -211,6 +211,12 @@ def run(argv: Optional[List[str]] = None) -> int:
                         "step with the timeline/MFU plumbing enabled must "
                         "be host-transfer-free AND identical to the "
                         "telemetry-off trace")
+    p.add_argument("--amp", action="store_true",
+                   help="audit the mixed-precision contract: the compiled "
+                        "--amp train step (forward + backward + loss "
+                        "scaling + fused apply) must contain ZERO "
+                        "non-allowlisted all-f32 dot_general/conv eqns "
+                        "(docs/mixed_precision.md)")
     p.add_argument("--serve", action="append", default=[],
                    metavar="BUNDLE.ptz",
                    help="serving preflight: audit a deploy bundle's "
@@ -228,7 +234,8 @@ def run(argv: Optional[List[str]] = None) -> int:
     targets = list(ns.path)
     configs = list(ns.config)
     if (not targets and not configs and ns.decode is None
-            and ns.pserver is None and not ns.serve and not ns.obs):
+            and ns.pserver is None and not ns.serve and not ns.obs
+            and not ns.amp):
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -253,6 +260,10 @@ def run(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.obs.audit import audit_telemetry_step
 
         findings.extend(audit_telemetry_step())
+    if ns.amp:
+        from paddle_tpu.analysis.amp_audit import audit_amp_step
+
+        findings.extend(audit_amp_step())
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
     if ns.serve:

@@ -42,7 +42,8 @@ from paddle_tpu.analysis.findings import Finding
 from paddle_tpu.analysis.jaxpr_walk import walk_eqns
 
 __all__ = ["audit_jaxpr", "audit_fn", "audit_decode", "audit_no_dense_rows",
-           "DECODE_CHECKS", "JAXPR_CHECKS", "CONSTANT_BLOAT_BYTES"]
+           "audit_amp_matmuls", "DECODE_CHECKS", "JAXPR_CHECKS",
+           "CONSTANT_BLOAT_BYTES"]
 
 #: constants folded into the executable above this size are flagged
 CONSTANT_BLOAT_BYTES = 1 << 20
@@ -356,6 +357,50 @@ def audit_no_dense_rows(closed, *, full_rows: int,
                             f"dense temp {'x'.join(map(str, shape))} "
                             f"(shard rows {shard_rows}) — row-sparse "
                             f"updates must stay O(touched-rows)"))
+    return out
+
+
+def audit_amp_matmuls(closed, *, label: str = "step",
+                      allow: Sequence[str] = ()) -> List[Finding]:
+    """The ``lint --amp`` gate (docs/mixed_precision.md): under ``--amp``
+    the compiled step must contain ZERO all-f32 ``dot_general``/conv
+    equations outside the allowlist — a silently-promoted matmul costs 2x
+    the MXU cycles exactly where the mode exists to save them.  The f32
+    allowlist (BN statistics, softmax/logsumexp reductions, the loss) is
+    made of REDUCTIONS, not matmuls, so by default nothing is exempt;
+    ``allow`` takes provenance-path substrings for deliberately-f32 dots
+    (e.g. a numerically-fragile head a model pins wide).
+
+    Escalates the dtype-promotion auditor's WARN heuristic to a hard
+    ERROR with an explicit opt-out, and additionally ERRORs when the trace
+    contains NO low-precision MXU op at all — an "amp" step that never
+    reached bf16 means the mode silently did not engage."""
+    mxu = [(eqn, path) for eqn, path in walk_eqns(closed.jaxpr, label)
+           if eqn.primitive.name in _MXU_PRIMS]
+    out: List[Finding] = []
+    low = 0
+    for eqn, path in mxu:
+        fdts = _float_dtypes(eqn)
+        if any(d in _LOW_PRECISION for d in fdts):
+            low += 1
+            continue
+        if fdts and all(d == "float32" for d in fdts):
+            if any(a in path for a in allow):
+                continue
+            out.append(Finding(
+                check="amp-f32-matmul", severity="ERROR", where=path,
+                message=f"{eqn.primitive.name} ({_shapes(eqn)}) runs "
+                        f"wholly in f32 under --amp — outside the "
+                        f"BN/softmax/loss allowlist every matmul/conv "
+                        f"must take bf16 operands (2x MXU cycles + HBM "
+                        f"otherwise)"))
+    if mxu and not low:
+        out.append(Finding(
+            check="amp-f32-matmul", severity="ERROR", where=label,
+            message=f"no bf16 matmul/conv anywhere in the --amp step "
+                    f"({len(mxu)} MXU eqns, all f32) — the amp dtype "
+                    f"policy never engaged (is FLAGS.amp set at trace "
+                    f"time?)"))
     return out
 
 

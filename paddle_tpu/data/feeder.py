@@ -15,11 +15,15 @@ dense_vector_sequence, sparse later).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, \
+    Tuple
 
 import numpy as np
 
-__all__ = ["DataFeeder", "bucket_length", "feeder_kind_for_layer"]
+__all__ = ["DataFeeder", "bucket_length", "feeder_kind_for_layer",
+           "BatchPrefetcher", "PreparedFeed", "PrepareError"]
 
 _DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
@@ -49,6 +53,114 @@ def feeder_kind_for_layer(layer) -> str:
     if spec.get("is_seq"):
         return "ids_seq" if is_int else "dense_seq"
     return "int" if is_int else "dense"
+
+
+class PrepareError(Exception):
+    """A batch failed in the prefetcher's ``prepare`` (DataFeeder) or
+    ``transfer`` (h2d) stage — NOT in the reader.  Raised at the
+    consumer's ``next()`` with the original exception as ``__cause__``;
+    the trainer unwraps it so a feeder bug keeps its own type instead of
+    being misattributed to the data-reading tier as a ``ReaderError``."""
+
+
+class PreparedFeed:
+    """Marker wrapper for a batch the :class:`BatchPrefetcher` has already
+    pushed through the feeder (and, when configured, host->device
+    transfer): the trainer consumes ``.feed`` directly instead of paying
+    ``prepare``/``h2d`` on the step critical path."""
+
+    __slots__ = ("feed",)
+
+    def __init__(self, feed: Any) -> None:
+        self.feed = feed
+
+
+class BatchPrefetcher:
+    """Double-buffered async feeding (ROADMAP item 3; ``--prefetch_depth``).
+
+    Wraps a raw batch iterator: a background thread pulls batch N+1..N+depth,
+    runs ``prepare`` (the DataFeeder) and ``transfer`` (synced ``device_put``)
+    on them, and parks the results in a bounded queue — all of it OVERLAPPED
+    with the device step of batch N, so the training loop's ``data_wait`` /
+    ``prepare`` / ``h2d`` phases collapse to a queue pop.  Semantics are
+    loop-equivalent to serial feeding:
+
+    - order is preserved exactly (single producer, FIFO queue);
+    - a reader/feeder exception is re-raised at the consumer's ``next()``,
+      so the trainer's reader-attribution path is unchanged;
+    - the queue depth bounds read-ahead: at most ``depth`` prepared batches
+      (plus the one in flight) exist, so a preemption or resize at a batch
+      boundary abandons a bounded amount of work and the resume point —
+      which counts batches the STEP consumed, not batches read ahead —
+      stays batch-exact;
+    - ``close()`` stops the producer and joins it (called by the trainer at
+      pass end, preemption exit, and on any loop exception).
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, *, prepare: Optional[Callable] = None,
+                 transfer: Optional[Callable] = None, depth: int = 2) -> None:
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._prepare = prepare
+        self._transfer = transfer
+        self._thread = threading.Thread(
+            target=self._run, args=(it,), name="batch-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item: Any) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for raw in it:
+                if self._stop.is_set():
+                    return
+                try:
+                    feed = self._prepare(raw) if self._prepare else raw
+                    if self._transfer is not None:
+                        feed = self._transfer(feed)
+                except BaseException as e:
+                    # prepare/h2d failures keep their own identity — the
+                    # reader did NOT raise (see PrepareError)
+                    raise PrepareError(
+                        f"batch prepare/transfer failed: "
+                        f"{type(e).__name__}: {e}") from e
+                if not self._put(PreparedFeed(feed)):
+                    return
+            self._put(self._DONE)
+        except BaseException as e:  # noqa: BLE001 — delivered to consumer
+            self._put(e)
+
+    def __iter__(self) -> "BatchPrefetcher":
+        return self
+
+    def __next__(self) -> PreparedFeed:
+        item = self._q.get()
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and join it; pending prepared batches are
+        dropped (the consumer's batch counter, not the read-ahead cursor,
+        is the resume point — docs/mixed_precision.md 'feeding')."""
+        self._stop.set()
+        while True:  # unblock a producer stuck in put()
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
 
 
 class DataFeeder:

@@ -103,7 +103,11 @@ def reciprocal(x):
 
 @_reg("softmax")
 def softmax(x, axis=-1):
-    return jax.nn.softmax(x, axis=axis)
+    # the exp/sum statistics run in f32 (the --amp allowlist: bf16
+    # normalizers lose the probability mass of every small-logit tail);
+    # the result returns in the caller's dtype
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=axis)
+    return out.astype(x.dtype)
 
 
 @_reg("sequence_softmax")
@@ -114,12 +118,12 @@ def sequence_softmax(x, mask=None, axis=-2):
     (SequenceSoftmaxActivation); padding positions get probability 0.
     """
     if mask is None:
-        return jax.nn.softmax(x, axis=axis)
+        return softmax(x, axis=axis)
     if x.ndim == mask.ndim + 1:
         m = mask[..., None]
     else:
         m = mask
     neg = jnp.finfo(x.dtype).min
     z = jnp.where(m > 0, x, neg)
-    p = jax.nn.softmax(z, axis=axis)
+    p = softmax(z, axis=axis)  # f32 statistics (--amp allowlist)
     return p * m.astype(p.dtype)

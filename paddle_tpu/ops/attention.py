@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.matmul import linear, matmul
-from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+from paddle_tpu.ops.numerics import acc_dtype, dot_dtype, mxu_cast
 
 __all__ = ["additive_attention_scores", "attend", "dot_product_attention"]
 
@@ -54,8 +54,10 @@ def attend(scores, values, mask):
     w = jax.nn.softmax(z, axis=-1) * mask.astype(scores.dtype)
     w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
     wc, vc = mxu_cast(w, values)
+    # the context is an ACTIVATION (scores/softmax above stay f32 — the
+    # --amp allowlist): it leaves at dot_dtype, bf16 under amp
     ctx = jnp.einsum("bs,bsd->bd", wc, vc,
-                     preferred_element_type=acc_dtype()).astype(acc_dtype())
+                     preferred_element_type=dot_dtype()).astype(dot_dtype())
     return ctx, w
 
 
@@ -75,6 +77,7 @@ def dot_product_attention(q, k, v, mask=None, *, scale=None):
         logits = jnp.where(mask > 0, logits, jnp.finfo(logits.dtype).min)
     w = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bhqk,bhkd->bhqd", w.astype(vc.dtype), vc, preferred_element_type=acc_dtype()
+        "bhqk,bhkd->bhqd", w.astype(vc.dtype), vc,
+        preferred_element_type=dot_dtype(),
     )
     return out.astype(q.dtype)

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.ops.matmul import linear
-from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+from paddle_tpu.ops.numerics import (acc_dtype, bwd_einsum,
+                                     bwd_mm, dot_dtype, mxu_cast)
 
 __all__ = ["attention_gru_decoder"]
 
@@ -97,8 +98,10 @@ def _fwd_step(s, xp_y_t, enc, enc_proj, src_mask, att_w, att_v, wx_c, wh):
     n = jnp.maximum(jnp.sum(w1, axis=-1, keepdims=True), 1e-9)
     w = w1 / n
     wc, vc = mxu_cast(w, enc)
+    # the context is an ACTIVATION (the softmax above stays f32): it
+    # leaves at dot_dtype, bf16 under --amp
     ctx = jnp.einsum("bs,bsd->bd", wc, vc,
-                     preferred_element_type=acc_dtype()).astype(acc_dtype())
+                     preferred_element_type=dot_dtype()).astype(dot_dtype())
     # --- input projection + gru_step ---
     xp = xp_y_t + linear(ctx, wx_c)
     zr = xp[..., : 2 * D] + linear(s, wh[:, : 2 * D])
@@ -224,13 +227,13 @@ def _agd_bwd(res, d_states):
         d_cand = d_snew * (1.0 - u)
         d_h = d_snew * u
         d_zc = d_cand * (1.0 - cand * cand)
-        d_rh = d_zc @ wh_f[:, 2 * D:].T
+        d_rh = bwd_mm(d_zc, wh_f[:, 2 * D:].T)
         d_r = d_rh * sp
         d_h = d_h + d_rh * r
         d_zr = jnp.concatenate([d_r * r * (1 - r), d_u * u * (1 - u)], -1)
-        d_h = d_h + d_zr @ wh_f[:, : 2 * D].T
+        d_h = d_h + bwd_mm(d_zr, wh_f[:, : 2 * D].T)
         d_xp = jnp.concatenate([d_zr, d_zc], -1)           # [B,3D]
-        d_ctx = d_xp @ wx_f[E:].T                          # [B,2H]
+        d_ctx = bwd_mm(d_xp, wx_f[E:].T)                   # [B,2H]
 
         # ---- attention backward (attend) ----
         d_w = jnp.einsum("bh,bsh->bs", d_ctx.astype(enc.dtype), enc,
@@ -259,8 +262,8 @@ def _agd_bwd(res, d_states):
         # more than the narrower carry saves)
         d_encP = d_encP + d_pre
         sum_dpre = jnp.sum(d_pre, axis=1)                  # [B,A]
-        d_h = d_h + sum_dpre @ att_w_f.T
-        d_v = d_v + jnp.einsum("bs,bsa->a", d_scores, pre_f)
+        d_h = d_h + bwd_mm(sum_dpre, att_w_f.T)
+        d_v = d_v + bwd_einsum("bs,bsa->a", d_scores, pre_f)
 
         d_s_out = (1.0 - mcol) * d_s + d_h
         return (d_s_out, d_encP, d_v), (d_xp, sum_dpre)
@@ -288,21 +291,22 @@ def _agd_bwd(res, d_states):
 
     # ---- batched post-scan contractions (weight grads were carried
     # through the scan before — each is now ONE MXU einsum) ----
-    d_ctx_tb = d_xp_tb @ wx_f[E:].T                        # [T,B,2H]
+    d_ctx_tb = bwd_mm(d_xp_tb, wx_f[E:].T)                 # [T,B,2H]
     sp_f = s_prev.astype(f32)
     d_wh = jnp.concatenate(
-        [jnp.einsum("tbd,tbz->dz", sp_f, d_xp_tb[..., : 2 * D]),
-         jnp.einsum("tbd,tbz->dz", r_all * sp_f, d_xp_tb[..., 2 * D:])],
+        [bwd_einsum("tbd,tbz->dz", sp_f, d_xp_tb[..., : 2 * D]),
+         bwd_einsum("tbd,tbz->dz", r_all * sp_f, d_xp_tb[..., 2 * D:])],
         axis=1)
-    d_attw = jnp.einsum("tbd,tba->da", sp_f, sum_dpre_tb)
+    d_attw = bwd_einsum("tbd,tba->da", sp_f, sum_dpre_tb)
     # d_enc: the only use of enc is ctx_t = w_t @ enc
-    d_enc = jnp.einsum("tbs,tbh->bsh", probs, d_ctx_tb).astype(enc.dtype)
+    d_enc = bwd_einsum("tbs,tbh->bsh", probs,
+                       d_ctx_tb).astype(enc.dtype)
     # d_wx in two blocks (x = [y, ctx]); identical to the old einsum over
     # the concatenated x
-    d_wx_y = jnp.einsum("tbi,tbo->io", y_tb.astype(f32), d_xp_tb)
-    d_wx_c = jnp.einsum("tbi,tbo->io", ctxs.astype(f32), d_xp_tb)
+    d_wx_y = bwd_einsum("tbi,tbo->io", y_tb.astype(f32), d_xp_tb)
+    d_wx_c = bwd_einsum("tbi,tbo->io", ctxs.astype(f32), d_xp_tb)
     d_wx = jnp.concatenate([d_wx_y, d_wx_c], axis=0)
-    d_y = (d_xp_tb @ wx_f[:E].T).astype(y_emb.dtype)       # [T,B,E]
+    d_y = bwd_mm(d_xp_tb, wx_f[:E].T).astype(y_emb.dtype)  # [T,B,E]
     d_y_emb = jnp.moveaxis(d_y, 0, 1)
 
     return (d_y_emb, d_s0.astype(s0.dtype), d_enc,

@@ -34,21 +34,30 @@ __all__ = [
 ]
 
 
+def _f32(x):
+    """Losses and their softmax/logsumexp statistics run in f32 — the
+    ``--amp`` allowlist (bf16 logsumexp loses ~3 decimal digits exactly
+    where training signal lives); a no-op for f32 inputs."""
+    return x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else x
+
+
 def cross_entropy(logits, labels, *, axis=-1):
     """Multi-class CE from logits and integer labels; per-example losses."""
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(_f32(logits), axis=axis)
     lab = jnp.expand_dims(labels.astype(jnp.int32), axis)
     nll = -jnp.take_along_axis(logp, lab, axis=axis)
     return jnp.squeeze(nll, axis)
 
 
 def soft_cross_entropy(logits, target_probs, *, axis=-1):
-    logp = jax.nn.log_softmax(logits, axis=axis)
-    return -jnp.sum(target_probs * logp, axis=axis)
+    logp = jax.nn.log_softmax(_f32(logits), axis=axis)
+    return -jnp.sum(_f32(target_probs) * logp, axis=axis)
 
 
 def binary_cross_entropy(logits, labels):
     # stable BCE-with-logits
+    logits, labels = _f32(logits), _f32(labels)
     z = jax.nn.log_sigmoid(logits)
     zneg = jax.nn.log_sigmoid(-logits)
     return -(labels * z + (1.0 - labels) * zneg)
@@ -61,11 +70,11 @@ def multi_binary_label_cross_entropy(logits, label_matrix):
 
 
 def mse(pred, target):
-    return 0.5 * jnp.sum(jnp.square(pred - target), axis=-1)
+    return 0.5 * jnp.sum(jnp.square(_f32(pred) - _f32(target)), axis=-1)
 
 
 def huber(pred, target, delta=1.0):
-    d = pred - target
+    d = _f32(pred) - _f32(target)
     a = jnp.abs(d)
     quad = 0.5 * jnp.square(d)
     lin = delta * (a - 0.5 * delta)

@@ -12,19 +12,21 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+from paddle_tpu.ops.numerics import dot_dtype, mxu_cast
 
 __all__ = ["matmul", "linear"]
 
 
 def matmul(a, b, *, transpose_a=False, transpose_b=False):
-    """MXU matmul: bf16 operands, f32 accumulation, batch dims broadcast."""
+    """MXU matmul: bf16 operands, f32 accumulation, batch dims broadcast.
+    Under ``--amp`` the output stays bf16 (``dot_dtype``) so activations
+    never widen between MXU ops."""
     a, b = mxu_cast(a, b)
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    out = jnp.matmul(a, b, preferred_element_type=acc_dtype())
+    out = jnp.matmul(a, b, preferred_element_type=dot_dtype())
     return out
 
 
@@ -35,7 +37,7 @@ def linear(x, w, b=None):
         xc,
         wc,
         (((xc.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype(),
+        preferred_element_type=dot_dtype(),
     )
     if b is not None:
         y = y + b.astype(y.dtype)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.ops.matmul import matmul
-from paddle_tpu.ops.numerics import acc_dtype, mxu_cast
+from paddle_tpu.ops.numerics import acc_dtype, dot_dtype, mxu_cast
 
 __all__ = [
     "row_sum",
@@ -89,7 +89,8 @@ def outer_prod(a, b):
 def tensor_bilinear(a, b, w):
     """TensorLayer: out[b, k] = a[b] @ W[k] @ b[b]; w: [K, Da, Db]."""
     ac, bc, wc = mxu_cast(a, b, w)
-    return jnp.einsum("bi,kij,bj->bk", ac, wc, bc, preferred_element_type=acc_dtype())
+    return jnp.einsum("bi,kij,bj->bk", ac, wc, bc,
+                      preferred_element_type=dot_dtype())
 
 
 def sum_cost(x):

@@ -6,13 +6,23 @@ mixed precision: parameters and accumulations in float32, matmul/conv operands
 in bfloat16 so they hit the MXU at full rate.  ``matmul_compute_dtype`` is
 controlled by FLAGS.compute_dtype; tests pin it to float32 so finite-difference
 gradient checks are meaningful.
+
+``--amp`` (docs/mixed_precision.md) escalates this to END-TO-END bf16
+compute: matmul/conv OUTPUTS also stay bf16 (``dot_dtype``), so activations
+— and, because JAX cotangents carry the primal dtype, the whole backward —
+live in bf16, halving activation HBM traffic.  Master weights stay f32
+(``param_dtype`` is untouched; ``mxu_cast`` re-derives the bf16 operand per
+use), and the f32 allowlist — BN statistics, softmax/logsumexp reductions,
+the loss — is enforced by explicit upcasts at those sites, gated by
+``lint --amp``.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["param_dtype", "compute_dtype", "mxu_cast", "acc_dtype"]
+__all__ = ["param_dtype", "compute_dtype", "mxu_cast", "acc_dtype",
+           "dot_dtype", "amp_enabled", "bwd_mm", "bwd_einsum"]
 
 
 def param_dtype():
@@ -21,14 +31,42 @@ def param_dtype():
     return jnp.dtype(FLAGS.dtype)
 
 
+def amp_enabled() -> bool:
+    """Whether ``--amp`` mixed-precision training is on (read at trace
+    time, like every other dtype-policy switch here)."""
+    from paddle_tpu.utils.flags import FLAGS
+
+    return bool(FLAGS.amp)
+
+
 def compute_dtype():
     from paddle_tpu.utils.flags import FLAGS
 
+    if FLAGS.amp:
+        # --amp pins the operand dtype regardless of --compute_dtype: the
+        # test harness pins compute_dtype=f32 for FD checks, and amp must
+        # still mean bf16 there
+        return jnp.dtype(jnp.bfloat16)
     return jnp.dtype(FLAGS.compute_dtype)
 
 
 def acc_dtype():
+    """Accumulation dtype for reductions and statistics — ALWAYS f32
+    (bf16 squares overflow at ~256; BN stats and softmax/logsumexp live
+    here, the --amp allowlist)."""
     return jnp.float32
+
+
+def dot_dtype():
+    """``preferred_element_type`` for matmul/conv: f32 accumulation by
+    default; under ``--amp`` the output stays bf16 so activations (and the
+    cotangents that inherit their dtype) never widen back to f32 between
+    MXU ops.  The MXU accumulates partial products in f32 internally
+    either way — bf16 output is one final rounding, not bf16
+    accumulation."""
+    if amp_enabled():
+        return jnp.dtype(jnp.bfloat16)
+    return acc_dtype()
 
 
 def mxu_cast(*arrays):
@@ -36,3 +74,24 @@ def mxu_cast(*arrays):
     cd = compute_dtype()
     out = tuple(a.astype(cd) if jnp.issubdtype(a.dtype, jnp.floating) else a for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def bwd_mm(a, b):
+    """Matmul for HAND-WRITTEN backward rules (the fused RNN /
+    attention-decoder custom VJPs): f32 operands by default — their
+    deliberate f32 accumulation policy — but bf16 OPERANDS with f32
+    accumulation under ``--amp``, so a mixed-precision step contains no
+    all-f32 MXU eqns (the ``lint --amp`` gate) and the reverse loops' dots
+    run at full MXU rate.  f32 result either way (gradient chains and
+    scan carries stay f32-stable)."""
+    if amp_enabled():
+        a, b = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+
+def bwd_einsum(expr, a, b):
+    """Weight-gradient einsum with the same operand policy as ``bwd_mm``
+    (f32 result either way — weight grads accumulate wide)."""
+    if amp_enabled():
+        a, b = a.astype(jnp.bfloat16), b.astype(jnp.bfloat16)
+    return jnp.einsum(expr, a, b, preferred_element_type=jnp.float32)

@@ -76,13 +76,13 @@ def lstm_step(xp, h, c, w_h, *, peep_i=None, peep_f=None, peep_o=None,
     z = xp + linear(h, w_h)
     i, f, o, g = jnp.split(z, 4, axis=-1)
     if peep_i is not None:
-        i = i + peep_i * c
+        i = i + peep_i.astype(z.dtype) * c
     if peep_f is not None:
-        f = f + peep_f * c
+        f = f + peep_f.astype(z.dtype) * c
     i, f = ga(i), ga(f)
     c_new = f * c + i * aa(g)
     if peep_o is not None:
-        o = o + peep_o * c_new
+        o = o + peep_o.astype(z.dtype) * c_new
     o = ga(o)
     h_new = o * sa(c_new)
     return h_new, c_new
@@ -160,9 +160,12 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
         c0a = jnp.zeros((B, H), xp.dtype) if c0 is None else c0
         has_peeps = any(p is not None for p in (peep_i, peep_f, peep_o))
         zp = jnp.zeros((H,), xp.dtype)
-        pi = zp if peep_i is None else peep_i
-        pf = zp if peep_f is None else peep_f
-        po = zp if peep_o is None else peep_o
+        # peepholes join the carry arithmetic: f32 check params would
+        # promote the bf16 scan carry under --amp (scan requires a stable
+        # carry dtype) — cast at the boundary like every other operand
+        pi = zp if peep_i is None else peep_i.astype(xp.dtype)
+        pf = zp if peep_f is None else peep_f.astype(xp.dtype)
+        po = zp if peep_o is None else peep_o.astype(xp.dtype)
         xp_r = jnp.flip(xp, 1) if reverse else xp
         m_r = jnp.flip(mask, 1) if reverse else mask
         h_seq, h_fin, c_fin = lstm_sequence_fused(xp_r, m_r, w_h, h0a, c0a,

@@ -58,6 +58,13 @@ def residual_dtype(hidden: int):
     return cd if (cd == jnp.bfloat16 and hidden <= 512) else jnp.float32
 
 
+# amp-aware backward matmul/einsum policy — shared with the hand-written
+# attention-decoder backward (ops/numerics.bwd_mm/bwd_einsum): f32
+# operands by default, bf16 operands + f32 accumulation under --amp
+from paddle_tpu.ops.numerics import bwd_einsum as _bwd_einsum  # noqa: E402
+from paddle_tpu.ops.numerics import bwd_mm as _bwd_mm  # noqa: E402
+
+
 def _bwd_pallas_ok(batch: int, hidden: int) -> bool:
     """Backward Pallas gate: forward tile constraints PLUS a VMEM cap that
     depends on the residual stream dtype.  The reverse kernel's per-step
@@ -194,12 +201,12 @@ def _gru_seq_bwd(allow_pallas, res, ct):
             d_cand = d_hnew * (1.0 - u_t)
             d_hp = d_hnew * u_t
             d_zc = d_cand * (1.0 - cand_t * cand_t)
-            d_rh = d_zc @ w_f[:, 2 * H:].T
+            d_rh = _bwd_mm(d_zc, w_f[:, 2 * H:].T)
             d_r = d_rh * hp_t
             d_hp = d_hp + d_rh * r_t
             d_zr = jnp.concatenate(
                 [d_r * r_t * (1 - r_t), d_u * u_t * (1 - u_t)], -1)
-            d_hp = d_hp + d_zr @ w_f[:, : 2 * H].T
+            d_hp = d_hp + _bwd_mm(d_zr, w_f[:, : 2 * H].T)
             d_xp_t = jnp.concatenate([d_zr, d_zc], -1)
             d_c_out = (1.0 - mcol) * d_c + d_hp
             return d_c_out, d_xp_t
@@ -211,8 +218,8 @@ def _gru_seq_bwd(allow_pallas, res, ct):
     # shared tail — batched weight gradient: zr part against h_prev, cand
     # part against r*h (ONE copy for both reverse-loop implementations)
     rh = jax.nn.sigmoid(z_r[..., :H].astype(f32)) * hp_f
-    d_w_gates = jnp.einsum("tbh,tbz->hz", hp_f, d_xp_tb[..., : 2 * H])
-    d_w_cand = jnp.einsum("tbh,tbz->hz", rh, d_xp_tb[..., 2 * H:])
+    d_w_gates = _bwd_einsum("tbh,tbz->hz", hp_f, d_xp_tb[..., : 2 * H])
+    d_w_cand = _bwd_einsum("tbh,tbz->hz", rh, d_xp_tb[..., 2 * H:])
     d_wh = jnp.concatenate([d_w_gates, d_w_cand], axis=1).astype(w_h.dtype)
     d_xp = jnp.moveaxis(d_xp_tb, 0, 1).astype(xp_dtype)
     return d_xp, None, d_wh, d_h0.astype(h0_dtype)
@@ -363,7 +370,7 @@ def _lstm_seq_bwd(allow_pallas, has_peepholes, res, ct):
             d_zg = d_cnew * i_t * (1 - g_t * g_t)
             d_cp = d_cnew * f_t + d_zi * pi_f + d_zf * pf_f
             d_z = jnp.concatenate([d_zi, d_zf, d_zo, d_zg], -1)
-            d_hp = d_z @ w_f.T
+            d_hp = _bwd_mm(d_z, w_f.T)
             d_h_out = (1.0 - mcol) * d_h + d_hp
             d_c_out = (1.0 - mcol) * d_c + d_cp
             return (d_h_out, d_c_out), d_z
@@ -375,17 +382,18 @@ def _lstm_seq_bwd(allow_pallas, has_peepholes, res, ct):
     # shared tail (ONE copy for both reverse-loop implementations)
     if has_peepholes:
         # peephole gradients: one batched reduction each, outside the loop
-        d_pi = jnp.einsum("tbh,tbh->h", d_z_tb[..., :H], cp_f).astype(pi.dtype)
-        d_pf = jnp.einsum("tbh,tbh->h",
-                          d_z_tb[..., H: 2 * H], cp_f).astype(pf.dtype)
-        d_po = jnp.einsum("tbh,tbh->h",
-                          d_z_tb[..., 2 * H: 3 * H], cn_tb).astype(po.dtype)
+        d_pi = _bwd_einsum("tbh,tbh->h", d_z_tb[..., :H],
+                           cp_f).astype(pi.dtype)
+        d_pf = _bwd_einsum("tbh,tbh->h",
+                           d_z_tb[..., H: 2 * H], cp_f).astype(pf.dtype)
+        d_po = _bwd_einsum("tbh,tbh->h",
+                           d_z_tb[..., 2 * H: 3 * H], cn_tb).astype(po.dtype)
     else:
         d_pi = jnp.zeros_like(pi)
         d_pf = jnp.zeros_like(pf)
         d_po = jnp.zeros_like(po)
-    d_wh = jnp.einsum("tbh,tbz->hz",
-                      hprev_r.astype(f32), d_z_tb).astype(w_h.dtype)
+    d_wh = _bwd_einsum("tbh,tbz->hz",
+                       hprev_r.astype(f32), d_z_tb).astype(w_h.dtype)
     d_xp = jnp.moveaxis(d_z_tb, 0, 1).astype(xp_dt)
     return (d_xp, None, d_wh, d_h0.astype(h0_dt), d_c0.astype(c0_dt),
             d_pi, d_pf, d_po)

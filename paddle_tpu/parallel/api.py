@@ -57,7 +57,11 @@ def make_parallel_train_step(
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        new_params, new_opt = optimizer.update(params, grads, opt_state)
+        # fused multi-tensor apply only without tensor-parallel rules:
+        # concatenating differently-sharded leaves mispartitions under
+        # GSPMD (see Optimizer.update's caller contract)
+        new_params, new_opt = optimizer.update(params, grads, opt_state,
+                                               fused=rules is None)
         return loss, new_params, new_opt
 
     donate_argnums = (0, 1) if donate else ()

@@ -134,7 +134,12 @@ class PServerTier:
                 initial_std=(attr.initial_std
                              if attr.initial_std is not None else 0.01),
                 initial_mean=attr.initial_mean,
-                seed=seed)
+                seed=seed,
+                # --amp (ROADMAP item 2 follow-up): gathered rows leave the
+                # lookup in bf16 — the cast sits AFTER the grad proxy add
+                # (lookup.TableProxy), so masters, row gradients, and the
+                # row-sparse update path stay f32 and bit-identical
+                compute_dtype=("bfloat16" if FLAGS.amp else None))
             table = ShardedTable(tspec, mesh, axis=self.axis, pad=pad)
             self.tables[pname] = table
             slots = optimizer.init_leaf(table.data)

@@ -44,7 +44,9 @@ from paddle_tpu.resilience.checkpoint_io import (MANIFEST_VERSION,
                                                  save_checkpoint,
                                                  save_pytree,
                                                  validate_checkpoint)
-from paddle_tpu.resilience.guard import global_grad_norm, guarded_update
+from paddle_tpu.resilience.guard import (global_grad_norm, guarded_update,
+                                         init_loss_scale,
+                                         scaled_guarded_update)
 from paddle_tpu.resilience.reader import resilient_reader
 from paddle_tpu.resilience.signals import PreemptionHandler
 from paddle_tpu.resilience import chaos
@@ -75,6 +77,8 @@ __all__ = [
     "pass_dir",
     "global_grad_norm",
     "guarded_update",
+    "init_loss_scale",
+    "scaled_guarded_update",
     "resilient_reader",
     "PreemptionHandler",
     "chaos",

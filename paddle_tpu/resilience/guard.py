@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["global_grad_norm", "guarded_update"]
+__all__ = ["global_grad_norm", "guarded_update", "init_loss_scale",
+           "scaled_guarded_update"]
 
 
 def global_grad_norm(grads) -> jnp.ndarray:
@@ -75,3 +76,96 @@ def guarded_update(
         "bad_step": (~finite).astype(jnp.int32),
     }
     return new_params, new_opt, sel_state, extras
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling (--amp; docs/mixed_precision.md)
+# ---------------------------------------------------------------------------
+
+
+def init_loss_scale(scale: float, *,
+                    growth_interval: int = 2000) -> Dict[str, Any]:
+    """Fresh loss-scale state: the scale itself plus the consecutive-good-
+    steps counter the growth schedule runs on.  Lives inside the trainer's
+    ``opt_state['amp']`` so it is donated with the slots and rides
+    checkpoints for free (a resumed ``--amp`` run continues the exact
+    scale trajectory)."""
+    del growth_interval  # static, read from flags at trace time
+    return {"scale": jnp.asarray(float(scale), jnp.float32),
+            "good_steps": jnp.zeros((), jnp.int32)}
+
+
+def scaled_guarded_update(
+    update_fn: Callable[[Any, Any, Any], Tuple[Any, Any]],
+    *,
+    loss,
+    scaled_grads,
+    amp_state: Dict[str, Any],
+    params,
+    opt_state,
+    new_state,
+    old_state,
+    growth_interval: int,
+    max_scale: float,
+    min_scale: float = 1.0,
+) -> Tuple[Any, Any, Any, Dict[str, Any], Dict[str, jnp.ndarray]]:
+    """The bad-step guard with dynamic loss scaling folded in — the
+    mixed-precision state machine (Micikevicius et al.):
+
+    - ``scaled_grads`` are d(scale * loss)/dp.  A finite step unscales
+      them (f32 multiply by 1/scale) and applies ``update_fn``; the
+      good-steps counter advances and, every ``growth_interval``
+      consecutive finite steps, the scale DOUBLES (capped at
+      ``max_scale``) to track the widest representable gradient range.
+    - an overflow (non-finite scaled-grad norm, or a non-finite loss)
+      skips the update — params, slots, and layer state held, exactly the
+      plain guard's skip — and HALVES the scale (floored at
+      ``min_scale``), so the next step retries in range instead of the
+      process aborting.
+
+    ``extras['bad_step']`` stays the abort signal and fires only when the
+    LOSS itself is non-finite (a poisoned batch — same abort pressure as
+    the unscaled guard); a pure gradient overflow is a normal
+    loss-scaling event (``extras['amp_overflow']``) and must NOT count
+    toward ``max_bad_steps``: a too-high initial scale legitimately takes
+    several halvings to find range.  Pure and jit/pjit-safe.
+    """
+    scale = amp_state["scale"]
+    gnorm_s = global_grad_norm(scaled_grads)
+    loss_finite = jnp.isfinite(loss)
+    finite = jnp.isfinite(gnorm_s) & loss_finite
+    # unscale in f32; inv=0 on overflow keeps the (discarded) skip-branch
+    # operands NaN-free so XLA's speculative execution can't trap
+    inv = jnp.where(finite, 1.0 / scale, 0.0)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+        scaled_grads)
+
+    def _apply(op):
+        p, g, o = op
+        return update_fn(p, g, o)
+
+    def _skip(op):
+        p, _, o = op
+        return p, o
+
+    new_params, new_opt = jax.lax.cond(
+        finite, _apply, _skip, (params, grads, opt_state))
+    sel_state = jax.lax.cond(
+        finite, lambda s: s[0], lambda s: s[1], (new_state, old_state))
+
+    good = jnp.where(finite, amp_state["good_steps"] + 1, 0)
+    grow = (growth_interval > 0) & (good >= growth_interval)
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grow, jnp.minimum(scale * 2.0, max_scale), scale),
+        jnp.maximum(scale * 0.5, min_scale))
+    new_amp = {"scale": new_scale,
+               "good_steps": jnp.where(grow, 0, good)}
+    extras = {
+        "grad_norm": jnp.where(finite, gnorm_s * inv, jnp.inf),
+        "bad_step": (~loss_finite).astype(jnp.int32),
+        "amp_overflow": (~finite).astype(jnp.int32),
+        "loss_scale": new_scale,
+    }
+    return new_params, new_opt, sel_state, new_amp, extras

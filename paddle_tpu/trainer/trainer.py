@@ -24,11 +24,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.data.feeder import PreparedFeed, PrepareError
 from paddle_tpu.nn.graph import LayerOutput, Topology
 from paddle_tpu.param.optimizers import Optimizer, ParameterAverager, SGD
 from paddle_tpu.resilience import (GangResized, PreemptionHandler,
                                    ReaderError, TooManyBadSteps,
-                                   guarded_update)
+                                   guarded_update, init_loss_scale,
+                                   scaled_guarded_update)
 from paddle_tpu.resilience.checkpoint_io import (latest_pass, load_checkpoint,
                                                  read_manifest, pass_dir,
                                                  save_checkpoint)
@@ -59,6 +61,8 @@ class SGDTrainer:
         pipeline: Optional[Dict[str, Any]] = None,
         guard_nonfinite: Optional[bool] = None,
         max_bad_steps: Optional[int] = None,
+        amp: Optional[bool] = None,
+        remat: Optional[bool] = None,
     ) -> None:
         # several costs train jointly (MultiNetwork analog,
         # gserver/gradientmachines/MultiNetwork.h:24): total loss is the
@@ -167,7 +171,35 @@ class SGDTrainer:
         self.masks = build_masks(self.params, self.pruning_ratios)
         self.params = apply_masks(self.params, self.masks)
 
+        # mixed precision (--amp; docs/mixed_precision.md): forward and
+        # backward run in bf16 end-to-end (ops/numerics dtype policy reads
+        # the flag at trace time), while self.params — the MASTERS — stay
+        # f32; the dynamic loss-scale state lives inside opt_state so it
+        # is donated with the slots and checkpointed with them
+        if amp is not None and bool(amp) != bool(FLAGS.amp):
+            # the bf16 dtype policy (ops/numerics) reads FLAGS.amp at
+            # trace time; a constructor override that disagrees would run
+            # loss scaling without bf16 (no speedup) or bf16 without the
+            # overflow machinery (spurious TooManyBadSteps) — refuse the
+            # split-brain instead of training wrong
+            raise ValueError(
+                f"SGDTrainer(amp={amp!r}) disagrees with FLAGS.amp="
+                f"{FLAGS.amp!r}: the compute dtype policy is flag-driven, "
+                f"set FLAGS.amp (or --amp) to toggle mixed precision")
+        self.amp = bool(FLAGS.amp if amp is None else amp)
+        self.remat = bool(FLAGS.remat if remat is None else remat)
+        # fused multi-tensor apply is safe only when every dense leaf
+        # shares placement: tensor-parallel sharding rules and pipeline
+        # stage-stacked params mix shardings, and concatenating those
+        # mispartitions under GSPMD (see Optimizer.update) — data-parallel
+        # replicated params (the common case) fuse freely
+        self.fused_apply = bool(FLAGS.fused_apply
+                                and sharding_rules is None
+                                and pipeline is None)
+        self.amp_overflows_total = 0
         self.opt_state = self.optimizer.init_state(self.params)
+        if self.amp:
+            self.opt_state["amp"] = init_loss_scale(FLAGS.loss_scale)
         self.avg_params = self.averager.init_state(self.params) if self.averager else None
         if self.mesh is not None:
             self._place_sharded()
@@ -209,6 +241,7 @@ class SGDTrainer:
         self.timeline = None
         self._journal = None
         self._profiler = None
+        self._prefetcher = None
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -228,6 +261,11 @@ class SGDTrainer:
         device_specs = self.device_specs
         guard = self.guard_nonfinite
         tier = self.pserver
+        amp = self.amp
+        remat = self.remat
+        fused_apply = self.fused_apply
+        growth_interval = int(FLAGS.loss_scale_growth)
+        max_scale = float(FLAGS.loss_scale_max)
 
         def step(params, state, opt_state, ps, rng, feed):
             # ``ps`` is the pserver tier's pytree (tables/slots/dirty/step;
@@ -237,6 +275,11 @@ class SGDTrainer:
             # segments the sparse apply pushes — no [V, D] cotangent ever
             # exists (pserver/tier.py, gated by `lint --pserver`).
             proxies = tier.make_proxies(feed) if tier is not None else {}
+            # --amp: the loss-scale state rides INSIDE opt_state (donated,
+            # checkpointed); split it out so the optimizer sees only its
+            # own keys and the scale update happens OUTSIDE the skip cond
+            amp_state = opt_state.get("amp") if amp else None
+            opt_core = {k: v for k, v in opt_state.items() if k != "amp"}
 
             def loss_fn(p, px):
                 # named_scope: the backward ops XLA derives from this
@@ -256,9 +299,20 @@ class SGDTrainer:
                         w * outs[n].value
                         for n, w in zip(cost_names, cost_weights)
                     )
-                return total, (new_state, extras)
+                # dynamic loss scaling: the DIFFERENTIATED value is
+                # scale * loss so bf16 gradients use the representable
+                # range; the reported loss (aux) stays unscaled
+                scaled = total * amp_state["scale"] if amp else total
+                return scaled, (total, new_state, extras)
 
-            (loss, (new_state, extras)), (grads, px_grads) = (
+            if remat:
+                # jax.checkpoint: the backward recomputes the forward
+                # instead of holding every activation — O(layers) memory
+                # for ~1/3 extra FLOPs (the larger-batch lever for the
+                # MFU-starved recurrent models, ROADMAP item 3)
+                loss_fn = jax.checkpoint(loss_fn)
+
+            (_, (loss, new_state, extras)), (grads, px_grads) = (
                 jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
                     params, proxies))
 
@@ -287,13 +341,27 @@ class SGDTrainer:
                 np_, no_ = opt.update(
                     p, g, o,
                     lr_scales=lr_scales, decays=decays, statics=statics,
-                    sparse_rows=sparse_rows, clip=clip,
+                    sparse_rows=sparse_rows, clip=clip, fused=fused_apply,
                 )
                 ps_out = (tier.apply_grads(ps_in, feed, pxg)
                           if tier is not None else ps_in)
                 return (apply_masks(np_, masks), ps_out), no_
 
-            if guard:
+            if amp:
+                # loss scaling REQUIRES the skip machinery: an overflow is
+                # a normal rescale event, so the guard is always on under
+                # --amp (scale halves + step skips, outside the cond so
+                # the scale advances even on a skip)
+                ((new_params, new_ps), new_opt, new_state, new_amp,
+                 gextras) = scaled_guarded_update(
+                    do_update, loss=loss, scaled_grads=(grads, px_grads),
+                    amp_state=amp_state, params=(params, ps),
+                    opt_state=opt_core, new_state=new_state,
+                    old_state=state, growth_interval=growth_interval,
+                    max_scale=max_scale)
+                extras = {**extras, **gextras}
+                new_opt = {**new_opt, "amp": new_amp}
+            elif guard:
                 # finite checks on loss + grad global-norm (row grads
                 # included), update skipped via lax.cond — on-device, no
                 # host round-trip (gated by the audit in
@@ -302,12 +370,12 @@ class SGDTrainer:
                 (new_params, new_ps), new_opt, new_state, gextras = (
                     guarded_update(
                         do_update, loss=loss, grads=(grads, px_grads),
-                        params=(params, ps), opt_state=opt_state,
+                        params=(params, ps), opt_state=opt_core,
                         new_state=new_state, old_state=state))
                 extras = {**extras, **gextras}
             else:
                 (new_params, new_ps), new_opt = do_update(
-                    (params, ps), (grads, px_grads), opt_state)
+                    (params, ps), (grads, px_grads), opt_core)
             return loss, new_params, new_state, new_opt, new_ps, extras
 
         # kept un-jitted for the lint auditor (audit() re-traces it)
@@ -542,7 +610,20 @@ class SGDTrainer:
                 "resize_count": self._resize_count,
                 "last_resize_reason": self._last_resize_reason,
             }
-        if self.guard_nonfinite and "bad_step" in extras:
+        if self.amp and "amp_overflow" in extras:
+            if bool(jax.device_get(extras["amp_overflow"])):
+                self.amp_overflows_total += 1
+                scale = float(jax.device_get(extras["loss_scale"]))
+                if self._journal is not None:
+                    # a rescale is part of the causal story of an --amp
+                    # run — journaled like bad_step, next to its context
+                    self._journal.record("amp_overflow", scale=scale,
+                                         total=self.amp_overflows_total)
+                logger.warning(
+                    "amp: non-finite scaled gradients — step skipped, "
+                    "loss scale halved to %g (overflow %d)", scale,
+                    self.amp_overflows_total)
+        if (self.guard_nonfinite or self.amp) and "bad_step" in extras:
             if bool(jax.device_get(extras["bad_step"])):
                 self.bad_steps_total += 1
                 self._bad_streak += 1
@@ -690,9 +771,38 @@ class SGDTrainer:
                     it = iter(reader())
                 except Exception as e:
                     raise _reader_failed(e) from e
+                self._prefetcher = None
                 skip = start_batch if pass_id == start_pass else 0
                 if skip:
                     logger.info("resuming pass %d at batch %d", pass_id, skip)
+
+                def _wrap_prefetch():
+                    # double-buffered async feeding (--prefetch_depth):
+                    # prepare + h2d of batch N+1 overlap the device step
+                    # of batch N in a background thread; the loop below
+                    # sees PreparedFeed markers and skips its own
+                    # prepare/h2d phases.  Built lazily AFTER the resume
+                    # fast-forward (skipped batches are consumed raw — no
+                    # prepare/h2d paid for batches the skip discards) and
+                    # closed at every loop exit (pass end, preemption,
+                    # exception) so a drain point never leaves a torn
+                    # batch.  An elastic resize mid-pass needs no rebuild:
+                    # ``transfer`` reads self.mesh at call time, and the
+                    # jitted runner re-shards every feed per batch, so the
+                    # <=depth feeds prepared under the old mesh are
+                    # re-placed exactly like the params themselves.
+                    nonlocal it
+                    if FLAGS.prefetch_depth > 0:
+                        from paddle_tpu.data.feeder import BatchPrefetcher
+
+                        it = self._prefetcher = BatchPrefetcher(
+                            it, prepare=feeder,
+                            transfer=(self._device_feed
+                                      if self._h2d_measurable else None),
+                            depth=FLAGS.prefetch_depth)
+
+                if not skip:
+                    _wrap_prefetch()
                 batch_id = 0
                 while True:
                     if gang is not None:
@@ -713,29 +823,48 @@ class SGDTrainer:
                             self._gang_resize(gang, world, pass_id,
                                               batch_id + skip, handler)
                     if preemption is not None and preemption.poll():
+                        # the prefetcher's read-ahead is abandoned HERE, at
+                        # the drain point: the checkpoint records the
+                        # batches the STEP consumed, so resume re-reads
+                        # the prepared-but-unstepped ones — batch-exact
+                        self._close_prefetcher()
                         self._preempt_exit(pass_id, batch_id + skip,
                                            preemption, handler)
                         return
                     with timer("DataWaitTimer"), self._ph("data_wait"):
                         try:
                             data_batch = next(it, None)
+                        except PrepareError as e:
+                            # a prefetched batch failed in PREPARE/H2D,
+                            # not in the reader: re-raise the original so
+                            # a feeder bug keeps its own type, exactly as
+                            # it would without prefetch
+                            raise (e.__cause__ if e.__cause__ is not None
+                                   else e)
                         except Exception as e:
                             raise _reader_failed(e) from e
                     if data_batch is None:
                         break
                     if skip:
                         # fast-forward a deterministic reader to the batch
-                        # the preemption checkpoint recorded
+                        # the preemption checkpoint recorded (raw items —
+                        # the prefetcher attaches once the skip is done)
                         skip -= 1
                         batch_id += 1
+                        if not skip:
+                            _wrap_prefetch()
                         continue
                     if jr is not None:
                         jr.set_context(batch_id=batch_id)
                     with self._ph("callback"):
                         handler(ev.BeginIteration(pass_id, batch_id))
+                    prefetched = isinstance(data_batch, PreparedFeed)
                     with timer("PrepareBatch"), self._ph("prepare"):
-                        feed = feeder(data_batch) if feeder else data_batch
-                    if tl is not None and self._h2d_measurable:
+                        feed = (data_batch.feed if prefetched
+                                else feeder(data_batch) if feeder
+                                else data_batch)
+                    if tl is not None and self._h2d_measurable \
+                            and not prefetched:
                         # explicit, synced host->device transfer: the h2d
                         # phase is real transfer time, and the step phase
                         # that follows starts device-resident (on single-
@@ -805,6 +934,7 @@ class SGDTrainer:
                         logger.info("Pass %d, Batch %d, Test cost %.5f",
                                     pass_id, batch_id + 1, mid["cost"])
                     batch_id += 1
+                self._close_prefetcher()
                 result = {}
                 if test_reader is not None:
                     with timer("TestTimer"), self._ph("eval"):
@@ -855,6 +985,7 @@ class SGDTrainer:
                     gang.heartbeat()
                     time.sleep(0.05)
         finally:
+            self._close_prefetcher()  # exception paths: join the producer
             if profiling:
                 jax.profiler.stop_trace()
             if profiler is not None:
@@ -864,6 +995,13 @@ class SGDTrainer:
                 jr.record("train_end", preempted=self.preempted)
             if preemption is not None:
                 preemption.uninstall()
+
+    def _close_prefetcher(self) -> None:
+        """Stop and join the current pass's background feeding pipeline
+        (no-op when ``--prefetch_depth`` is off or already closed)."""
+        pf, self._prefetcher = self._prefetcher, None
+        if pf is not None:
+            pf.close()
 
     def _preempt_exit(self, pass_id: int, batch_id: int,
                       preemption: PreemptionHandler,

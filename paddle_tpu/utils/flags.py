@@ -173,6 +173,35 @@ define_flag("seed", 1, "global RNG seed (0 = nondeterministic)")
 define_flag("dtype", "float32", "default parameter dtype")
 define_flag("compute_dtype", "bfloat16", "preferred matmul/conv compute dtype on TPU")
 
+# Mixed precision (docs/mixed_precision.md): end-to-end bf16 compute with
+# f32 master weights + dynamic loss scaling wired into the bad-step guard
+define_flag("amp", False, "mixed-precision training: activations and "
+            "matmul/conv outputs run in bf16 end-to-end (f32 master "
+            "weights, f32 optimizer state); BN statistics, softmax/"
+            "logsumexp reductions, and the loss stay f32 (the allowlist); "
+            "dynamic loss scaling rides the bad-step guard — an overflow "
+            "skips the step and halves the scale instead of aborting "
+            "(gated by `lint --amp`)")
+define_flag("loss_scale", 65536.0, "initial dynamic loss scale under "
+            "--amp (grads are computed on scale*loss and unscaled before "
+            "the update; 1 = start unscaled)",
+            validator=lambda v: v >= 1.0)
+define_flag("loss_scale_growth", 2000, "double the loss scale after N "
+            "consecutive finite steps (0 = never grow: static scale)",
+            validator=lambda v: v >= 0)
+define_flag("loss_scale_max", 16777216.0, "dynamic loss scale ceiling "
+            "(growth never doubles past this; halving floors at 1.0)",
+            validator=lambda v: v >= 1.0)
+define_flag("remat", False, "rematerialize the forward inside the "
+            "backward (jax.checkpoint around the loss closure): trades "
+            "~1/3 more FLOPs for O(layer) activation memory, buying the "
+            "larger batches the MFU-starved recurrent models need")
+define_flag("fused_apply", True, "fused multi-tensor optimizer apply: "
+            "same-dtype/same-attribute parameter leaves are flattened "
+            "into one concatenated segment so SGD/Momentum/Adam/... "
+            "update as O(1) fused kernels instead of one launch chain "
+            "per leaf — bit-identical to the per-leaf path")
+
 # Trainer loop (log_period, test_period, checkgrad ...)
 define_flag("log_period", 100, "log every N batches")
 define_flag("test_period", 0, "test every N batches (0 = per pass)")
@@ -351,7 +380,13 @@ define_flag("profile_steps", 0, "capture bounded jax.profiler windows of N "
             "(first window flag-armed after the compile step; SIGUSR2 "
             "arms another on a live job; 0 = whole-run behavior)",
             validator=lambda v: v >= 0)
-define_flag("prefetch_batches", 2, "data provider background prefetch depth")
+define_flag("prefetch_depth", 0, "double-buffered async host->device "
+            "feeding: a background thread runs the DataFeeder AND the "
+            "h2d transfer for batch N+1..N+depth while the device steps "
+            "batch N, so `data_wait`/`prepare`/`h2d` collapse out of the "
+            "step critical path (0 = off; 2 = classic double buffering; "
+            "drains cleanly at checkpoint/resize/preemption boundaries)",
+            validator=lambda v: v >= 0)
 
 # Unified telemetry (paddle_tpu/obs; docs/observability.md)
 define_flag("metrics_port", 0, "serve the process-wide metrics registry "

@@ -43,6 +43,7 @@ _UNITS = [
     ("alexnet", "ms/batch"),
     ("googlenet", "ms/batch"),
     ("pallas_", "ms (best variant)"),
+    ("amp_ab", "ms (amp step; vs = ×f32)"),
     ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
     ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
 ]

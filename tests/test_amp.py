@@ -1,0 +1,600 @@
+"""Mixed-precision training pipeline (--amp; docs/mixed_precision.md).
+
+Coverage map (the PR's acceptance bars):
+
+- dtype policy: matmul/conv outputs bf16 under --amp, BN statistics and
+  softmax/logsumexp reductions and the loss stay f32 (the allowlist),
+  master weights stay f32;
+- `lint --amp` gate: the REAL trainer step's jaxpr contains zero
+  non-allowlisted all-f32 dot_generals (asserted over an lstm model AND
+  via the CLI), and the check itself catches a planted f32 dot;
+- loss scaling <-> bad-step guard interplay: an injected overflow halves
+  the scale and skips without aborting, the growth schedule recovers,
+  pure gradient overflow never advances the abort streak;
+- checkpoint/resume: masters restore bit-exact, a resumed --amp run
+  (scale state included) matches an uninterrupted one exactly;
+- convergence parity bf16-vs-f32 on a small model within tolerance;
+- fused multi-tensor apply: bit-identical params AND slots vs the
+  per-leaf path for every shipped optimizer (clipping, lr scales, decays,
+  statics, sparse exclusions), with a >=5x compute-equation reduction;
+- --remat: identical training trajectory with remat in the jaxpr.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import (SGD, Adam, AdaGrad, AdaMax,
+                                         AdaDelta, DecayedAdaGrad, Momentum,
+                                         RMSProp)
+from paddle_tpu.resilience import chaos
+from paddle_tpu.trainer import SGDTrainer, events as ev
+from paddle_tpu.utils.flags import FLAGS
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+@pytest.fixture
+def amp_on(monkeypatch):
+    monkeypatch.setattr(FLAGS, "amp", True)
+    yield
+
+
+def _mse_trainer(seed=0, **kw):
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=2)
+    cost = nn.mse_cost(input=nn.fc(x, 2, act="relu", name="h"), label=y)
+    return SGDTrainer(cost, Adam(learning_rate=0.05), seed=seed, **kw)
+
+
+def _feeds(n=6, batch=4):
+    rs = np.random.RandomState(0)
+    return [{"x": rs.randn(batch, 4).astype(np.float32),
+             "y": rs.randn(batch, 2).astype(np.float32)} for _ in range(n)]
+
+
+def _host(params):
+    return {k: np.asarray(v).copy() for k, v in params.items()}
+
+
+def _lstm_trainer(seed=0):
+    from paddle_tpu.models import lstm_benchmark_net
+
+    cost, _ = lstm_benchmark_net(128, emb_dim=16, hid_dim=16, num_layers=1)
+    return SGDTrainer(cost, Adam(learning_rate=1e-3), seed=seed)
+
+
+def _lstm_feed(B=4, T=8):
+    rs = np.random.RandomState(0)
+    return {"words": (rs.randint(3, 128, (B, T)).astype(np.int32),
+                      np.full((B,), T, np.int32)),
+            "label": rs.randint(0, 2, (B, 1)).astype(np.int32)}
+
+
+# ---------------------------------------------------------------------------
+# dtype policy
+# ---------------------------------------------------------------------------
+
+
+def test_amp_dtype_policy_bf16_activations_f32_allowlist(amp_on):
+    from paddle_tpu.ops.conv import batch_norm, conv2d
+    from paddle_tpu.ops.losses import cross_entropy, mse
+    from paddle_tpu.ops.matmul import linear, matmul
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+    assert linear(x, w).dtype == jnp.bfloat16          # activation bf16
+    assert matmul(x, w).dtype == jnp.bfloat16
+    img = jnp.asarray(rs.randn(2, 8, 8, 3).astype(np.float32))
+    k = jnp.asarray(rs.randn(3, 3, 3, 4).astype(np.float32))
+    assert conv2d(img, k).dtype == jnp.bfloat16
+    # BN statistics accumulate f32 even over bf16 activations
+    xb = img.astype(jnp.bfloat16)
+    y, nm, nv = batch_norm(xb, jnp.ones(3), jnp.zeros(3),
+                           jnp.zeros(3), jnp.ones(3), train=True)
+    assert y.dtype == jnp.bfloat16          # activation stream stays bf16
+    assert nm.dtype == jnp.float32 and nv.dtype == jnp.float32
+    # losses leave in f32 regardless of input dtype
+    logits = jnp.asarray(rs.randn(4, 10).astype(np.float32)).astype(
+        jnp.bfloat16)
+    assert cross_entropy(logits, jnp.arange(4)).dtype == jnp.float32
+    assert mse(logits, logits).dtype == jnp.float32
+
+
+def test_amp_off_keeps_f32_everything():
+    from paddle_tpu.ops.matmul import linear
+
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((4, 4), jnp.float32)
+    assert linear(x, w).dtype == jnp.float32
+
+
+def test_softmax_statistics_run_f32_but_keep_caller_dtype():
+    from paddle_tpu.ops.activations import softmax
+
+    x = jnp.linspace(-4, 4, 16, dtype=jnp.float32).astype(jnp.bfloat16)
+    out = softmax(x)
+    assert out.dtype == jnp.bfloat16
+    # f32 statistics: the normalizer really summed in f32 (a bf16 sum of
+    # these 16 terms deviates past bf16 ULP of 1.0)
+    np.testing.assert_allclose(float(out.astype(jnp.float32).sum()), 1.0,
+                               atol=2e-2)
+
+
+def test_amp_masters_stay_f32_and_loss_tracks_f32(amp_on, monkeypatch):
+    feeds = _feeds(4)
+    tr_amp = _mse_trainer()
+    losses_amp = [float(tr_amp.train_batch(f)) for f in feeds]
+    assert all(str(v.dtype) == "float32" for v in tr_amp.params.values())
+    assert all(str(l.dtype) == "float32"
+               for l in jax.tree_util.tree_leaves(
+                   {k: v for k, v in tr_amp.opt_state["slots"].items()}))
+    monkeypatch.setattr(FLAGS, "amp", False)
+    nn.reset_naming()
+    tr_f32 = _mse_trainer()
+    losses_f32 = [float(tr_f32.train_batch(f)) for f in feeds]
+    np.testing.assert_allclose(losses_amp, losses_f32, rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# lint --amp gate
+# ---------------------------------------------------------------------------
+
+
+def test_real_lstm_step_has_zero_f32_matmuls_under_amp(amp_on):
+    """Acceptance: the compiled --amp train step (embedding + LSTM + CE +
+    loss scaling + guarded fused apply) contains ZERO non-allowlisted f32
+    dot_generals — asserted over the REAL trainer step jaxpr."""
+    from paddle_tpu.analysis import audit_amp_matmuls
+
+    tr = _lstm_trainer()
+    rng = jax.random.PRNGKey(0)
+    closed = jax.make_jaxpr(tr._step_fn)(
+        tr.params, tr.state, tr.opt_state, {}, rng, _lstm_feed())
+    findings = audit_amp_matmuls(closed, label="test:amp_step")
+    assert findings == [], "\n".join(f.message for f in findings)
+
+
+def test_lint_amp_cli_gate_green(capsys):
+    from paddle_tpu.analysis.cli import run
+
+    assert run(["--amp"]) == 0
+    assert "0 error" in capsys.readouterr().out
+
+
+def test_audit_amp_matmuls_catches_planted_f32_dot():
+    from paddle_tpu.analysis import audit_amp_matmuls
+
+    def f(a, b):
+        good = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+        bad = jnp.matmul(a, b)  # all-f32 dot in an otherwise-bf16 net
+        return good.astype(jnp.float32) + bad
+
+    a = jnp.ones((4, 4), jnp.float32)
+    closed = jax.make_jaxpr(f)(a, a)
+    findings = audit_amp_matmuls(closed, label="planted")
+    assert len(findings) == 1 and findings[0].severity == "ERROR"
+    assert findings[0].check == "amp-f32-matmul"
+    # the allowlist (path substring) releases a deliberate f32 island
+    assert audit_amp_matmuls(closed, label="planted",
+                             allow=("planted",)) == []
+
+
+def test_audit_amp_matmuls_flags_never_engaged_policy():
+    """An 'amp' trace with NO bf16 MXU op at all is itself an ERROR — the
+    policy silently not engaging is the worst failure mode."""
+    from paddle_tpu.analysis import audit_amp_matmuls
+
+    a = jnp.ones((4, 4), jnp.float32)
+    closed = jax.make_jaxpr(lambda x: jnp.matmul(x, x))(a)
+    findings = audit_amp_matmuls(closed, label="allf32")
+    assert any("never engaged" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# loss scaling <-> bad-step guard
+# ---------------------------------------------------------------------------
+
+
+def test_nan_batch_halves_scale_and_skips_without_abort(amp_on):
+    """Satellite: injected overflow (chaos NaN-grad) halves the scale and
+    skips — params, slots, and scale-halving all observable — and training
+    continues (no TooManyBadSteps)."""
+    tr = _mse_trainer()
+    feeds = _feeds(4)
+    tr.train_batch(feeds[0])
+    p_before = _host(tr.params)
+    scale0 = float(tr.opt_state["amp"]["scale"])
+    tr.train_batch(chaos.nan_feed(feeds[1]))
+    assert int(tr._last_extras["bad_step"]) == 1
+    assert int(tr._last_extras["amp_overflow"]) == 1
+    assert float(tr.opt_state["amp"]["scale"]) == scale0 / 2
+    for k in p_before:  # the poisoned step held the params
+        np.testing.assert_array_equal(p_before[k], np.asarray(tr.params[k]))
+    assert tr.amp_overflows_total == 1
+    # a good batch afterwards trains normally and resets the streak
+    tr.train_batch(feeds[2])
+    assert tr.bad_steps_streak == 0
+
+
+def test_pure_grad_overflow_never_advances_abort_streak(amp_on, monkeypatch):
+    """A too-high initial scale takes several halvings to find range; with
+    max_bad_steps=2 that search must NOT abort — pure gradient overflow
+    (finite loss) is a rescale event, not a bad step."""
+    monkeypatch.setattr(FLAGS, "loss_scale", 3.0e38)
+    monkeypatch.setattr(FLAGS, "max_bad_steps", 2)
+    tr = _mse_trainer(max_bad_steps=2)
+    feeds = _feeds(8)
+    overflowed = 0
+    for f in feeds:  # never raises TooManyBadSteps
+        tr.train_batch(f)
+        overflowed += int(tr._last_extras["amp_overflow"])
+        assert int(tr._last_extras["bad_step"]) == 0
+    assert overflowed >= 2                      # the search actually ran
+    assert tr.bad_steps_streak == 0
+    assert float(tr.opt_state["amp"]["scale"]) < 3.0e38  # and came down
+
+
+def test_growth_schedule_doubles_and_caps(amp_on, monkeypatch):
+    monkeypatch.setattr(FLAGS, "loss_scale", 1024.0)
+    monkeypatch.setattr(FLAGS, "loss_scale_growth", 2)
+    monkeypatch.setattr(FLAGS, "loss_scale_max", 4096.0)
+    tr = _mse_trainer()
+    feeds = _feeds(8)
+    for f in feeds:
+        tr.train_batch(f)
+    # 8 good steps / growth 2 -> doubled until the 4096 cap
+    assert float(tr.opt_state["amp"]["scale"]) == 4096.0
+
+
+def test_scale_recovers_after_overflow(amp_on, monkeypatch):
+    """Satellite: growth schedule recovers the scale after an overflow."""
+    monkeypatch.setattr(FLAGS, "loss_scale", 1024.0)
+    monkeypatch.setattr(FLAGS, "loss_scale_growth", 2)
+    tr = _mse_trainer()
+    feeds = _feeds(6)
+    tr.train_batch(chaos.nan_feed(feeds[0]))
+    assert float(tr.opt_state["amp"]["scale"]) == 512.0
+    for f in feeds[1:5]:
+        tr.train_batch(f)
+    assert float(tr.opt_state["amp"]["scale"]) >= 1024.0
+
+
+def test_persistent_nan_loss_still_aborts(amp_on):
+    """--amp must not weaken the abort contract: persistently poisoned
+    LOSS (not a scale problem) still raises after max_bad_steps."""
+    from paddle_tpu.resilience import TooManyBadSteps
+
+    tr = _mse_trainer(max_bad_steps=3)
+    bad = chaos.nan_feed(_feeds(1)[0])
+    with pytest.raises(TooManyBadSteps):
+        for _ in range(5):
+            tr.train_batch(bad)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+def test_amp_checkpoint_restores_masters_and_scale_bitexact(
+        amp_on, tmp_path, monkeypatch):
+    monkeypatch.setattr(FLAGS, "loss_scale_growth", 2)
+    tr = _mse_trainer()
+    for f in _feeds(5):
+        tr.train_batch(f)
+    tr.save(str(tmp_path), 0)
+    nn.reset_naming()
+    tr2 = _mse_trainer(seed=123)        # different init — the load wins
+    tr2.load(str(tmp_path), 0)
+    for k in tr.params:
+        assert str(np.asarray(tr2.params[k]).dtype) == "float32"
+        np.testing.assert_array_equal(np.asarray(tr.params[k]),
+                                      np.asarray(tr2.params[k]))
+    assert float(tr2.opt_state["amp"]["scale"]) == \
+        float(tr.opt_state["amp"]["scale"])
+    assert int(tr2.opt_state["amp"]["good_steps"]) == \
+        int(tr.opt_state["amp"]["good_steps"])
+
+
+def test_amp_resumed_run_matches_uninterrupted(amp_on, tmp_path, monkeypatch):
+    """Acceptance: a resumed --amp run (params + slots + RNG + loss-scale
+    state all restored) matches an uninterrupted one bit-for-bit."""
+    feeds = _feeds(6)
+
+    def reader():
+        return iter(feeds)
+
+    monkeypatch.setattr(FLAGS, "save_dir", "")
+    tr_a = _mse_trainer()
+    tr_a.train(reader, num_passes=3)
+    final_a = _host(tr_a.params)
+
+    monkeypatch.setattr(FLAGS, "save_dir", str(tmp_path))
+    nn.reset_naming()
+    tr_b = _mse_trainer()
+    tr_b.train(reader, num_passes=1)    # checkpoint after pass 0
+    nn.reset_naming()
+    tr_c = _mse_trainer(seed=99)
+    tr_c.train(reader, num_passes=3, resume="auto")
+    for k in final_a:
+        np.testing.assert_array_equal(final_a[k], np.asarray(tr_c.params[k]))
+
+
+# ---------------------------------------------------------------------------
+# convergence parity
+# ---------------------------------------------------------------------------
+
+
+def test_amp_convergence_parity_small_model(monkeypatch):
+    """bf16-vs-f32 training parity: the same small regression net reaches
+    the same loss neighborhood after 60 steps."""
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(4, 2).astype(np.float32)
+    xs = rs.randn(64, 4).astype(np.float32)
+    ys = xs @ w_true
+    feeds = [{"x": xs[i:i + 8], "y": ys[i:i + 8]} for i in range(0, 64, 8)]
+
+    def linear_trainer():
+        nn.reset_naming()
+        x = nn.data("x", size=4)
+        y = nn.data("y", size=2)
+        cost = nn.mse_cost(input=nn.fc(x, 2, act="linear", name="h"),
+                           label=y)
+        return SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+
+    final = {}
+    for amp in (False, True):
+        monkeypatch.setattr(FLAGS, "amp", amp)
+        tr = linear_trainer()
+        loss = None
+        for _ in range(40):
+            for f in feeds:
+                loss = float(tr.train_batch(f))
+        final[amp] = loss
+    assert final[False] < 0.05                     # the f32 oracle converges
+    assert final[True] < 0.1                       # amp converges too
+    assert abs(final[True] - final[False]) < 0.1   # within bf16 tolerance
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor apply
+# ---------------------------------------------------------------------------
+
+
+_FUSE_PARAMS = None
+
+
+def _fuse_fixtures():
+    global _FUSE_PARAMS
+    if _FUSE_PARAMS is None:
+        rs = np.random.RandomState(0)
+        shapes = [(4, 8), (8,), (3, 3, 2), (16,), (2, 2), (5, 5), (7,),
+                  (4, 4, 4), (10,), (6, 2), (8, 8), (3,)]
+        params = {f"p{i}": jnp.asarray(rs.randn(*s).astype(np.float32))
+                  for i, s in enumerate(shapes)}
+        grads = {k: jnp.asarray(rs.randn(*v.shape).astype(np.float32))
+                 for k, v in params.items()}
+        _FUSE_PARAMS = (params, grads)
+    return _FUSE_PARAMS
+
+
+@pytest.mark.parametrize("opt", [
+    SGD(learning_rate=0.1),
+    Momentum(learning_rate=0.05, momentum=0.9),
+    Momentum(learning_rate=0.05, momentum=0.9, use_nesterov=True),
+    AdaGrad(learning_rate=0.5),
+    AdaDelta(learning_rate=5.0, rho=0.9),
+    RMSProp(learning_rate=0.05),
+    DecayedAdaGrad(learning_rate=0.1),
+    Adam(learning_rate=0.2),
+    Adam(learning_rate=0.2, gradient_clipping_threshold=1.0),
+    Adam(learning_rate=0.2, slot_dtype="bfloat16"),
+    AdaMax(learning_rate=0.2),
+], ids=lambda o: f"{type(o).__name__}"
+       f"{'_clip' if o.gradient_clipping_threshold else ''}"
+       f"{'_bf16slots' if getattr(o, 'slot_dtype', None) else ''}"
+       f"{'_nesterov' if getattr(o, 'use_nesterov', False) else ''}")
+def test_fused_apply_bit_identical_params_and_slots(opt):
+    """Acceptance: fused multi-tensor apply == per-leaf path, bit for bit,
+    params AND slots, for all shipped optimizers incl. clipping — with
+    mixed per-param attributes so several fuse groups exist."""
+    import copy
+
+    params, grads = _fuse_fixtures()
+    a, b = opt, copy.deepcopy(opt)
+    kw = dict(lr_scales={"p1": 0.5}, decays={"p2": 0.01},
+              statics={"p3": True})
+    sa, sb = a.init_state(params), b.init_state(params)
+    pa, pb = dict(params), dict(params)
+    for _ in range(3):
+        pa, sa = a.update(pa, grads, sa, fused=False, **kw)
+        pb, sb = b.update(pb, grads, sb, fused=True, **kw)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pa[k]), np.asarray(pb[k]),
+                                      err_msg=k)
+    for x, y in zip(jax.tree_util.tree_leaves(sa),
+                    jax.tree_util.tree_leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_fused_apply_excludes_sparse_rows_and_matches():
+    """Row-sparse leaves keep their dedicated paths under the fused
+    default (no pserver interference); results match the unfused call."""
+    rs = np.random.RandomState(3)
+    V, D = 50, 8
+    params = {"emb": jnp.asarray(rs.randn(V, D).astype(np.float32)),
+              "w": jnp.asarray(rs.randn(D, 4).astype(np.float32)),
+              "b": jnp.asarray(rs.randn(4).astype(np.float32))}
+    ge = np.zeros((V, D), np.float32)
+    for r in (3, 7, 20):
+        ge[r] = rs.randn(D)
+    grads = {"emb": jnp.asarray(ge),
+             "w": jnp.asarray(rs.randn(D, 4).astype(np.float32)),
+             "b": jnp.asarray(rs.randn(4).astype(np.float32))}
+    a, b = Adam(learning_rate=0.1), Adam(learning_rate=0.1)
+    sa, sb = a.init_state(params), b.init_state(params)
+    pa, sa = a.update(dict(params), grads, sa, fused=False,
+                      sparse_rows={"emb": 8})
+    pb, sb = b.update(dict(params), grads, sb, fused=True,
+                      sparse_rows={"emb": 8})
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pa[k]), np.asarray(pb[k]))
+
+
+#: primitives that are pure data layout — XLA folds them into the
+#: adjacent fused kernels, so they do not launch work of their own
+_LAYOUT_PRIMS = {"reshape", "concatenate", "slice", "squeeze", "transpose",
+                 "broadcast_in_dim"}
+
+
+def test_fused_apply_reduces_compute_equations_5x():
+    """Acceptance: the fused apply reduces the optimizer-apply equation
+    count by >=5x on a multi-leaf model.  Counted over COMPUTE equations
+    (layout-only reshape/concat/slice excluded — they are free data
+    movement XLA folds into neighbors; the per-leaf path's cost is one
+    elementwise kernel CHAIN per leaf, which is exactly what collapses)."""
+    from paddle_tpu.analysis.jaxpr_walk import walk_eqns
+
+    params, grads = _fuse_fixtures()
+    opt = Adam(learning_rate=0.1)
+    s = opt.init_state(params)
+
+    def count(fused):
+        jx = jax.make_jaxpr(
+            lambda p, g, st: opt.update(p, g, st, fused=fused))(
+            params, grads, s)
+        return sum(1 for e, _ in walk_eqns(jx.jaxpr)
+                   if e.primitive.name not in _LAYOUT_PRIMS)
+
+    per_leaf, fused = count(False), count(True)
+    assert per_leaf >= 5 * fused, (per_leaf, fused)
+
+
+def test_trainer_disables_fusion_under_tensor_parallel_shardings():
+    """Caller contract: concatenating differently-sharded leaves
+    mispartitions under GSPMD (measured: results scaled by the data-axis
+    size on a DPxTP mesh), and shardings are invisible on tracers — so
+    the trainer must disable fusion whenever sharding rules or pipeline
+    stages mix placements, and keep it for replicated data-parallel."""
+    import paddle_tpu.parallel as par
+    from paddle_tpu.utils.devices import make_mesh
+
+    tr = _mse_trainer()
+    assert tr.fused_apply                        # no mesh: fuse freely
+    mesh = make_mesh((8,), ("data",))
+    nn.reset_naming()
+    tr_dp = _mse_trainer(mesh=mesh)
+    assert tr_dp.fused_apply                     # replicated params: safe
+    rules = par.ShardingRules([("*", par.P())])
+    nn.reset_naming()
+    tr_tp = _mse_trainer(mesh=mesh, sharding_rules=rules)
+    assert not tr_tp.fused_apply                 # rules may mix shardings
+
+
+def test_fused_apply_in_real_trainer_matches_unfused(monkeypatch):
+    feeds = _feeds(3)
+    monkeypatch.setattr(FLAGS, "fused_apply", True)
+    tr_a = _mse_trainer()
+    for f in feeds:
+        tr_a.train_batch(f)
+    monkeypatch.setattr(FLAGS, "fused_apply", False)
+    nn.reset_naming()
+    tr_b = _mse_trainer()
+    for f in feeds:
+        tr_b.train_batch(f)
+    for k in tr_a.params:
+        np.testing.assert_array_equal(np.asarray(tr_a.params[k]),
+                                      np.asarray(tr_b.params[k]))
+
+
+# ---------------------------------------------------------------------------
+# remat
+# ---------------------------------------------------------------------------
+
+
+def test_remat_matches_plain_training_and_marks_jaxpr(monkeypatch):
+    feeds = _feeds(3)
+    tr_a = _mse_trainer(remat=False)
+    losses_a = [float(tr_a.train_batch(f)) for f in feeds]
+    nn.reset_naming()
+    tr_b = _mse_trainer(remat=True)
+    losses_b = [float(tr_b.train_batch(f)) for f in feeds]
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-6)
+    for k in tr_a.params:
+        np.testing.assert_allclose(np.asarray(tr_a.params[k]),
+                                   np.asarray(tr_b.params[k]),
+                                   rtol=1e-6, atol=1e-7)
+    from paddle_tpu.analysis.jaxpr_walk import walk_eqns
+
+    rng = jax.random.PRNGKey(0)
+    closed = jax.make_jaxpr(tr_b._step_fn)(
+        tr_b.params, tr_b.state, tr_b.opt_state, {}, rng, feeds[0])
+    prims = {e.primitive.name for e, _ in walk_eqns(closed.jaxpr)}
+    assert prims & {"remat", "remat2", "checkpoint"}, prims
+
+
+# ---------------------------------------------------------------------------
+# pserver lookups under --amp (ROADMAP item 2 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_pserver_lookup_casts_bf16_under_amp(amp_on):
+    """Gathered rows leave the lookup bf16 under --amp; the cast sits
+    AFTER the grad-proxy add so row gradients stay f32 (masters and the
+    row-sparse update path untouched — their bit-identity tests run
+    without amp and are unchanged)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.pserver.lookup import TableProxy
+    from paddle_tpu.utils.devices import make_mesh
+
+    mesh = make_mesh((4,), ("model",))
+    rs = np.random.RandomState(0)
+    table = jax.device_put(
+        jnp.asarray(rs.randn(32, 8).astype(np.float32)),
+        NamedSharding(mesh, P("model", None)))
+    ids = jnp.asarray(rs.randint(0, 32, (6,)), jnp.int32)
+    proxies = {("t", "l"): jnp.zeros((6, 8), jnp.float32)}
+    proxy = TableProxy("t", mesh, "model", table, proxies,
+                       compute_dtype="bfloat16")
+    rows = proxy.pserver_lookup(ids, layer="l")
+    assert rows.dtype == jnp.bfloat16
+    # gradient w.r.t. the zeros proxy comes back f32 (master precision)
+    g = jax.grad(lambda px: proxy.__class__(
+        "t", mesh, "model", table, {("t", "l"): px},
+        compute_dtype="bfloat16").pserver_lookup(
+            ids, layer="l").astype(jnp.float32).sum())(proxies[("t", "l")])
+    assert g.dtype == jnp.float32
+
+
+def test_tier_table_spec_defaults_bf16_compute_under_amp(amp_on):
+    """PServerTier stamps compute_dtype='bfloat16' on its TableSpecs when
+    --amp is on (and the trainer routes tables exactly as before)."""
+    from paddle_tpu.utils.devices import make_mesh
+
+    uid = nn.data("amp_uid", size=64, dtype="int32")
+    lab = nn.data("amp_y", size=1)
+    emb = nn.embedding(uid, 16, name="amp_emb", sparse_grad=True)
+    pred = nn.fc(emb, 1, act="linear", name="amp_p")
+    cost = nn.mse_cost(pred, lab, name="amp_cost")
+    mesh = make_mesh((8,), ("model",))
+    tr = SGDTrainer(cost, SGD(learning_rate=0.1), seed=1, mesh=mesh)
+    assert tr.pserver is not None and tr.pserver.active
+    spec = next(iter(tr.pserver.tables.values())).spec
+    assert spec.compute_dtype == "bfloat16"
+    assert spec.dtype == "float32"              # master stays f32
+    # one amp step through the routed path runs and returns a finite loss
+    rs = np.random.RandomState(0)
+    feed = {"amp_uid": rs.randint(0, 64, (8, 1)).astype(np.int32),
+            "amp_y": rs.randn(8, 1).astype(np.float32)}
+    assert np.isfinite(float(tr.train_batch(feed)))

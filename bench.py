@@ -26,6 +26,7 @@ null-program calibration.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -982,6 +983,101 @@ def bench_serving_continuous_ab(rtt, peak):
     }
 
 
+def bench_cold_start_ab(rtt, peak):
+    """A/B the fleet cold-start tentpole (docs/deploy.md): server boot to
+    ``ready`` with a COLD compile cache (every warmup bucket pays XLA)
+    vs a WARM one (every executable deserializes from the persistent
+    cache), in BOTH serving modes — bucket buckets over an int8-quantized
+    bundle, and the continuous slot table's prefill/step/write/release/
+    finalize closures.  ``value`` is the warm bucket-mode boot;
+    ``vs_baseline`` the cold/warm speedup.  Winner requires the warm
+    boot to beat cold by >5% in both modes; ``default_flag`` mirrors
+    whether ``--compile_cache_dir`` defaults on (it does not — the cache
+    is opt-in per fleet)."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.config import load_inference_model, merge_model
+    from paddle_tpu.config.compile_cache import CompileCacheDir
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.serving.server import InferenceServer
+    from paddle_tpu.serving.slots import example_slot_backend
+    from paddle_tpu.trainer import SGDTrainer
+    from paddle_tpu.utils.flags import FLAGS
+
+    root = tempfile.mkdtemp(prefix="cold_start_ab_")
+    try:
+        nn.reset_naming()
+        x = nn.data("x", size=128)
+        h = nn.fc(x, 256, act="tanh", name="h")
+        out = nn.fc(h, 64, act="softmax", name="out")
+        label = nn.data("label", size=1, dtype="int32")
+        cost = nn.classification_cost(out, label, name="cost")
+        tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+        tr.train_batch({"x": np.zeros((8, 128), np.float32),
+                        "label": np.zeros((8, 1), np.int32)})
+        bundle = merge_model(os.path.join(root, "m.ptz"), tr.topology,
+                             tr.params, tr.state, name="cold_start_ab",
+                             quantize="int8")
+
+        def boot_bucket(cache):
+            model = load_inference_model(bundle)
+            srv = InferenceServer(model, max_batch=8, outputs=["out"],
+                                  default_deadline_ms=60000)
+            t0 = _t.perf_counter()
+            srv.start(warmup_feed={"x": np.zeros((1, 128), np.float32)},
+                      compile_cache=cache)
+            dt = _t.perf_counter() - t0
+            misses = srv.metrics.count("compile_cache_misses")
+            srv.close()
+            return dt, misses
+
+        def boot_continuous(cache):
+            backend = example_slot_backend(beam_size=2, src_len=8,
+                                           max_len=8, vocab=256, dim=32)
+            srv = InferenceServer(backend, mode="generation", slots=4,
+                                  default_deadline_ms=60000)
+            t0 = _t.perf_counter()
+            srv.start(compile_cache=cache)
+            dt = _t.perf_counter() - t0
+            misses = srv.metrics.count("compile_cache_misses")
+            srv.close()
+            return dt, misses
+
+        bdir, cdir = (os.path.join(root, d) for d in ("bucket", "cont"))
+        cold_b, _ = boot_bucket(CompileCacheDir(bdir))
+        warm_b, warm_b_miss = boot_bucket(CompileCacheDir(bdir))
+        cold_c, _ = boot_continuous(CompileCacheDir(cdir))
+        warm_c, warm_c_miss = boot_continuous(CompileCacheDir(cdir))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    if warm_b < 0.95 * cold_b and warm_c < 0.95 * cold_c:
+        winner = "cache"
+    elif warm_b > 1.05 * cold_b or warm_c > 1.05 * cold_c:
+        winner = "cold_jit"
+    else:
+        winner = "tie"
+    return {
+        "metric": "cold_start_ab_warm_boot_s(bucket_int8_bundle+continuous)",
+        "short": "cold_start_ab",
+        "value": round(warm_b, 3),
+        "unit": "s",
+        "mfu": None,
+        "vs_baseline": round(cold_b / warm_b, 3),
+        "cold_bucket_s": round(cold_b, 3),
+        "warm_bucket_s": round(warm_b, 3),
+        "cold_continuous_s": round(cold_c, 3),
+        "warm_continuous_s": round(warm_c, 3),
+        "continuous_speedup": round(cold_c / warm_c, 3),
+        "warm_cache_misses": warm_b_miss + warm_c_miss,
+        "winner": winner,
+        "default_flag": bool(FLAGS.compile_cache_dir),
+    }
+
+
 def bench_sharded_embedding_ab(rtt, peak):
     """A/B the pserver all-to-all sharded-embedding lookup
     (paddle_tpu/pserver/lookup.py) vs the previous psum-of-zeros broadcast
@@ -1113,6 +1209,7 @@ def main() -> None:
         safe(bench_amp_ab),
         safe(bench_serving_continuous_ab),
         safe(bench_sharded_embedding_ab),
+        safe(bench_cold_start_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

@@ -7,6 +7,7 @@ Usage:
     python -m paddle_tpu lint --config conf.py --allowlist .tpu-lint-allow
     python -m paddle_tpu lint --decode B,S,K,L
     python -m paddle_tpu lint --serve model.ptz
+    python -m paddle_tpu lint --deploy model.ptz
     python -m paddle_tpu lint --pserver V,D,N,S
     python -m paddle_tpu lint --obs
 
@@ -25,6 +26,12 @@ closure (the slot-table fused step, serving/slots.py) with the decode
 check set — a host transfer there fires once per token per resident
 request, the same contract as ``audit_decode``; both readout variants
 are traced (the kernel in interpret mode off-TPU).
+
+``--deploy BUNDLE.ptz`` extends the offline preflight to QUANTIZED
+bundles (docs/deploy.md): the dequantized forward — and, for int8
+bundles, the in-trace-dequantize closure — is audited for
+dtype-promotion and constant-bloat; an int8 table accidentally
+materialized as f32 constants is exactly the constant-bloat check's job.
 
 ``--pserver [V,D,N,S]`` audits the sharded-embedding tier's compiled
 all-to-all lookup and row-sparse apply closures (paddle_tpu/pserver) with
@@ -171,6 +178,57 @@ def _audit_serving_bundle(bundle: str) -> List[Finding]:
                     f"{type(e).__name__}: {e}")]
 
 
+def _audit_deploy_bundle(bundle: str) -> List[Finding]:
+    """``lint --deploy BUNDLE.ptz`` — the offline preflight extended to
+    QUANTIZED bundles (docs/deploy.md): the dequantized forward is traced
+    through the dtype-promotion and constant-bloat checks (params ride as
+    arguments, so an int8 table accidentally materialized as f32
+    *constants* is exactly what constant-bloat catches), and for int8
+    bundles the in-trace-dequantize closure is audited too — the same
+    gate ``load_inference_model(int8_in_trace=True)`` applies before it
+    keeps weights quantized in HBM.  Bundle-integrity failures are ERROR
+    findings, never crashes."""
+    try:
+        from paddle_tpu.config.deploy import load_inference_model
+        from paddle_tpu.nn.feeds import example_feed
+
+        model = load_inference_model(bundle)
+    except Exception as e:
+        return [Finding(
+            check="deploy-build", severity="ERROR", file=bundle,
+            message=f"bundle failed to load: {type(e).__name__}: {e}")]
+    base = os.path.basename(bundle)
+    qmode = (model.manifest.get("quantize") or {}).get("mode") or "f32"
+    variants = [(model, f"deploy[{qmode}]:{base}")]
+    if any(m.get("mode") == "int8" for m in
+           (model.manifest.get("quantize") or {}).get("arrays", {}).values()):
+        try:
+            m8 = load_inference_model(bundle, int8_in_trace=True)
+            if m8._int8:  # the gate admitted the in-trace closure
+                variants.append((m8, f"deploy[int8_in_trace]:{base}"))
+        except Exception as e:  # noqa: BLE001 — audited best-effort
+            return [Finding(
+                check="deploy-build", severity="ERROR", file=bundle,
+                message=f"int8 in-trace load failed: "
+                        f"{type(e).__name__}: {e}")]
+    findings: List[Finding] = []
+    for m, label in variants:
+        try:
+            from paddle_tpu.analysis.jaxpr_audit import audit_fn
+
+            names = tuple(m.output_names)
+            findings.extend(audit_fn(
+                m._make_run(names), m.params, m.state,
+                example_feed(m.topology), label=label,
+                checks=["dtype-promotion", "constant-bloat"]))
+        except Exception as e:  # a closure that fails to TRACE is a finding
+            findings.append(Finding(
+                check="deploy-build", severity="ERROR", file=bundle,
+                message=f"{label} failed to trace: "
+                        f"{type(e).__name__}: {e}"))
+    return findings
+
+
 def _audit_slot_step_closure() -> List[Finding]:
     """The continuous-batching half of ``--serve``: audit the compiled
     ``decode_step`` closure over a slot table at a compact flagship shape
@@ -222,6 +280,12 @@ def run(argv: Optional[List[str]] = None) -> int:
                    help="serving preflight: audit a deploy bundle's "
                         "serving closure (host-transfer/constant-bloat; "
                         "repeatable)")
+    p.add_argument("--deploy", action="append", default=[],
+                   metavar="BUNDLE.ptz",
+                   help="deploy preflight incl. QUANTIZED bundles: audit "
+                        "the dequantized forward (and the int8 in-trace "
+                        "closure) for dtype-promotion and constant-bloat "
+                        "(repeatable; docs/deploy.md)")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--fail-on", default="ERROR", type=str.upper,
                    choices=("ERROR", "WARN", "INFO", "NEVER"),
@@ -235,7 +299,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     configs = list(ns.config)
     if (not targets and not configs and ns.decode is None
             and ns.pserver is None and not ns.serve and not ns.obs
-            and not ns.amp):
+            and not ns.amp and not ns.deploy):
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -269,6 +333,8 @@ def run(argv: Optional[List[str]] = None) -> int:
     if ns.serve:
         # --serve also gates the continuous path's fused step (once)
         findings.extend(_audit_slot_step_closure())
+    for bundle in ns.deploy:
+        findings.extend(_audit_deploy_bundle(bundle))
 
     if ns.allowlist:
         findings = apply_allowlist(findings, load_allowlist(ns.allowlist))

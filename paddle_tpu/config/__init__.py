@@ -22,8 +22,14 @@ _EXPORTS = {
     "InferenceModel": "deploy",
     "export_aot": "deploy",
     "export_aot_hlo": "deploy",
+    "load_exported": "deploy",
     "load_inference_model": "deploy",
     "merge_model": "deploy",
+    "quantize_params": "deploy",
+    "BundleAotCache": "compile_cache",
+    "CompileCacheDir": "compile_cache",
+    "open_cache": "compile_cache",
+    "warm_bundle": "compile_cache",
     "configurable": "capture",
     "wrap_module": "capture",
 }

@@ -29,7 +29,8 @@ from paddle_tpu.nn.graph import Topology
 from paddle_tpu.proto import model_config_pb2 as pb
 
 __all__ = ["merge_model", "InferenceModel", "load_inference_model",
-           "export_aot", "export_aot_hlo", "BundleCorruptError"]
+           "export_aot", "export_aot_hlo", "load_exported",
+           "BundleCorruptError", "quantize_params", "feed_signature"]
 
 _MAGIC = "paddle_tpu.bundle.v1"
 
@@ -61,6 +62,183 @@ def _npz_load(data: bytes) -> Dict[str, np.ndarray]:
     return dict(np.load(io.BytesIO(data), allow_pickle=False))
 
 
+# ---------------------------------------------------------------------------
+# weight quantization (docs/deploy.md) — bundle export modes
+# ---------------------------------------------------------------------------
+
+_QUANT_MODES = ("bf16", "int8")
+#: scale arrays ride the SAME npz as their quantized array, keyed by suffix
+_SCALE_SUFFIX = "::scale"
+#: int8 only pays for itself on matmul-sized tensors; smaller floats
+#: (biases, gains, BN stats) go bf16 — their error budget is tighter and
+#: their byte share is negligible
+_INT8_MIN_SIZE = 256
+
+
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def quantize_params(params: Dict[str, Any], mode: str):
+    """Quantize a parameter tree for bundle storage.
+
+    ``mode="bf16"`` stores every floating array as bfloat16 raw bits
+    (uint16 in the npz — ``npz_safe`` would widen real bf16 back to f32);
+    ``mode="int8"`` additionally stores matmul-sized floats (ndim>=2,
+    size>=_INT8_MIN_SIZE) as symmetric per-channel int8:
+    ``q = round(w / scale)`` clipped to [-127, 127] with
+    ``scale = maxabs_channel / 127`` over the LAST axis (output features
+    for fc and HWIO conv filters alike), the scale array stored alongside
+    under ``<name>::scale``.  Integer arrays pass through.  Returns
+    ``(stored, qmeta)`` where ``qmeta`` is the manifest's per-array
+    dequantization recipe.
+    """
+    if mode not in _QUANT_MODES:
+        raise ValueError(f"quantize mode must be one of {_QUANT_MODES}, "
+                         f"got {mode!r}")
+    stored: Dict[str, np.ndarray] = {}
+    qmeta: Dict[str, dict] = {}
+    for name, v in params.items():
+        if _SCALE_SUFFIX in name:
+            raise ValueError(f"parameter name {name!r} collides with the "
+                             f"quantization scale suffix")
+        arr = np.asarray(v)
+        orig = str(arr.dtype)
+        if arr.dtype.kind != "f" and orig in np.sctypeDict:
+            stored[name] = arr  # integer / bool arrays pass through
+            continue
+        a = np.asarray(arr, dtype=np.float32)
+        if mode == "int8" and a.ndim >= 2 and a.size >= _INT8_MIN_SIZE:
+            absmax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)),
+                            keepdims=True)
+            scale = (absmax / 127.0).astype(np.float32)
+            scale[scale == 0.0] = 1.0  # all-zero channels: q=0, any scale
+            stored[name] = np.clip(np.round(a / scale), -127, 127
+                                   ).astype(np.int8)
+            stored[name + _SCALE_SUFFIX] = scale
+            qmeta[name] = {"mode": "int8", "orig_dtype": orig}
+        else:
+            stored[name] = a.astype(_bf16_dtype()).view(np.uint16)
+            qmeta[name] = {"mode": "bf16", "orig_dtype": orig}
+    return stored, qmeta
+
+
+def _dequantize_params(raw: Dict[str, np.ndarray], qmeta: Dict[str, dict],
+                       *, path: str = "", member: str = "params.npz",
+                       keep_int8: bool = False):
+    """Stored npz dict -> f32 arrays, validating every quantized array's
+    recipe: a missing/mis-shaped/non-finite scale member raises a typed
+    :class:`BundleCorruptError` NAMING the failing member, exactly like
+    the zip-level CRC attribution.  With ``keep_int8`` the int8 arrays
+    stay quantized and are returned separately as ``{name: (q, scale)}``
+    for in-trace dequantization (the HBM-resident-int8 serving mode)."""
+    out: Dict[str, np.ndarray] = {}
+    int8: Dict[str, tuple] = {}
+    for name, arr in raw.items():
+        if name.endswith(_SCALE_SUFFIX):
+            continue
+        meta = qmeta.get(name)
+        if meta is None:
+            out[name] = arr
+            continue
+        mode = meta.get("mode")
+        if mode == "bf16":
+            if arr.dtype != np.uint16:
+                raise BundleCorruptError(
+                    f"bundle {path!r}: bf16-quantized array {name!r} stored "
+                    f"as {arr.dtype} (expected uint16 raw bits)",
+                    path=path, member=f"{member}:{name}")
+            out[name] = arr.view(_bf16_dtype()).astype(np.float32)
+        elif mode == "int8":
+            sname = name + _SCALE_SUFFIX
+            smember = f"{member}:{sname}"
+            scale = raw.get(sname)
+            if scale is None:
+                raise BundleCorruptError(
+                    f"bundle {path!r}: int8-quantized array {name!r} is "
+                    f"missing its scale member {sname!r}",
+                    path=path, member=smember)
+            if (scale.dtype != np.float32 or scale.ndim != arr.ndim
+                    or scale.shape[-1] != arr.shape[-1]
+                    or any(d != 1 for d in scale.shape[:-1])):
+                raise BundleCorruptError(
+                    f"bundle {path!r}: scale member {sname!r} has "
+                    f"shape {scale.shape} dtype {scale.dtype} — expected "
+                    f"f32 {(1,) * (arr.ndim - 1) + (arr.shape[-1],)}",
+                    path=path, member=smember)
+            if not np.all(np.isfinite(scale)) or np.any(scale <= 0):
+                raise BundleCorruptError(
+                    f"bundle {path!r}: scale member {sname!r} carries "
+                    f"non-finite or non-positive values",
+                    path=path, member=smember)
+            if arr.dtype != np.int8:
+                raise BundleCorruptError(
+                    f"bundle {path!r}: int8-quantized array {name!r} "
+                    f"stored as {arr.dtype}",
+                    path=path, member=f"{member}:{name}")
+            if keep_int8:
+                int8[name] = (arr, scale)
+                out[name] = arr  # placeholder; __init__ places q directly
+            else:
+                out[name] = arr.astype(np.float32) * scale
+        else:
+            raise BundleCorruptError(
+                f"bundle {path!r}: unknown quantize mode {mode!r} for "
+                f"array {name!r}", path=path, member=f"{member}:{name}")
+    return out, int8
+
+
+def _quant_error_gate(topology, params, deq, state, outs: List[str],
+                      tol: float, mode: str) -> float:
+    """Max-abs-error check of the dequantized forward against the f32
+    oracle over a synthetic randomized feed sweep — every quantized
+    export must pass this before the bundle is written (the deploy-time
+    analog of the checkpoint CRC gate: the artifact is proven servable
+    at export, not discovered broken at the first reply)."""
+    from paddle_tpu.nn.feeds import example_feed
+
+    def fwd(p, feed):
+        acts, _ = topology.apply(p, state or {}, feed, train=False,
+                                 outputs=outs)
+        return tuple(acts[n].value for n in outs)
+
+    fwd_j = jax.jit(fwd)
+    worst = 0.0
+    for i in range(3):
+        feed = example_feed(topology, batch=2,
+                            rng=np.random.RandomState(i))
+        ref = fwd_j(params, feed)
+        got = fwd_j(deq, feed)
+        for a, b in zip(ref, got):
+            err = float(np.max(np.abs(np.asarray(a, np.float32)
+                                      - np.asarray(b, np.float32))))
+            worst = max(worst, err)
+    if not np.isfinite(worst) or worst > tol:
+        raise ValueError(
+            f"quantize={mode!r} export rejected: max abs output error "
+            f"{worst:.4g} vs the f32 oracle exceeds tolerance {tol:g} "
+            f"over the synthetic sweep — this model does not survive "
+            f"{mode} weights (raise quantize_tol only if the serving "
+            f"consumer tolerates it)")
+    return worst
+
+
+def feed_signature(feed: Dict[str, Any]) -> tuple:
+    """Canonical (hashable) shape+dtype signature of a feed — the unit
+    the compile cache and the AOT hot path key on.  Tuple feeds keep
+    their arity so ``(values,)`` never aliases a bare array."""
+    sig = []
+    for k in sorted(feed):
+        v = feed[k]
+        parts = v if isinstance(v, tuple) else (v,)
+        sig.append((k, len(parts) if isinstance(v, tuple) else 0,
+                    tuple((tuple(np.shape(p)), str(np.asarray(p).dtype))
+                          for p in parts)))
+    return tuple(sig)
+
+
 def merge_model(
     path: str,
     topology: Topology,
@@ -70,13 +248,31 @@ def merge_model(
     name: str = "model",
     meta: Optional[dict] = None,
     example_feed: Optional[Dict[str, Any]] = None,
+    quantize: Optional[str] = None,
+    quantize_tol: float = 0.05,
 ) -> str:
     """Write config + parameters as one deployable file.
 
     With ``example_feed`` the inference forward is additionally traced
     through the lint auditor (paddle_tpu.analysis) and the findings ride
     the bundle manifest under ``"lint"`` — the deploy-time guardrail
-    analog of the reference's eager config validation."""
+    analog of the reference's eager config validation.
+
+    ``quantize`` selects a weight-compression export mode (docs/deploy.md;
+    ``None`` reads ``--deploy_quantize``): ``"bf16"`` halves the weight
+    payload, ``"int8"`` stores matmul-sized tensors as symmetric
+    per-channel int8 (~4x smaller) with their scales alongside.  Every
+    quantized export is GATED: the dequantized forward must stay within
+    ``quantize_tol`` max-abs output error of the f32 oracle over a
+    synthetic randomized feed sweep, or the export raises instead of
+    writing a bundle that would serve degraded predictions."""
+    if quantize is None:
+        from paddle_tpu.utils.flags import FLAGS
+
+        quantize = FLAGS.deploy_quantize or None
+    if quantize is not None and quantize not in _QUANT_MODES:
+        raise ValueError(f"quantize must be one of {_QUANT_MODES} (or "
+                         f"None/'' for f32), got {quantize!r}")
     mc = dump_model_config(topology, name)
     need = {n for n, s in topology.param_specs.items() if not s.is_state}
     missing = sorted(need - set(params))
@@ -104,20 +300,50 @@ def merge_model(
 
         manifest["lint"] = _audit_export(
             fwd, (params, state or {}, example_feed), f"{name}:forward")
+    stored = params
+    if quantize is not None:
+        stored, qmeta = quantize_params(params, quantize)
+        # gate against the SAME dequantization the loader runs — the
+        # recipe proven here is the recipe served
+        deq, _ = _dequantize_params(stored, qmeta)
+        err = _quant_error_gate(topology, params, deq, state,
+                                list(mc.output_layer_names),
+                                quantize_tol, quantize)
+        manifest["quantize"] = {"mode": quantize, "tol": quantize_tol,
+                                "max_abs_err": round(err, 8),
+                                "arrays": qmeta}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("manifest.json", json.dumps(manifest, indent=1))
         z.writestr("model.pb", mc.SerializeToString())
-        z.writestr("params.npz", _npz_bytes(params))
+        z.writestr("params.npz", _npz_bytes(stored) if quantize is None
+                   else _raw_npz_bytes(stored))
         if state:
             z.writestr("state.npz", _npz_bytes(state))
     return path
 
 
-class InferenceModel:
-    """A rebuilt model serving jitted forward passes from a bundle."""
+def _raw_npz_bytes(tree: Dict[str, np.ndarray]) -> bytes:
+    """Quantized trees are already npz-storable (int8 / uint16 bits /
+    f32) — ``npz_safe`` widening would undo the compression."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **tree)
+    return buf.getvalue()
 
-    def __init__(self, mc: pb.ModelConfig, params, state, manifest: dict):
+
+class InferenceModel:
+    """A rebuilt model serving jitted forward passes from a bundle.
+
+    ``int8`` (``{name: (q, scale)}``) keeps those parameters quantized in
+    HBM and dequantizes them *in-trace* to the compute dtype — the weights
+    never materialize at f32 width on device.  ``fingerprint`` identifies
+    the model for the compile cache; parameters ride every compiled call
+    as ARGUMENTS, so the architecture-level default (config proto + leaf
+    shapes/dtypes) is the correct executable identity."""
+
+    def __init__(self, mc: pb.ModelConfig, params, state, manifest: dict,
+                 *, fingerprint: Optional[str] = None,
+                 int8: Optional[Dict[str, tuple]] = None):
         self.model_config = mc
         self.topology = build_topology(mc)
         self.manifest = manifest
@@ -151,14 +377,40 @@ class InferenceModel:
             raise ValueError(
                 f"model bundle is missing state arrays {missing_state}"
             )
-        self.params = {
-            k: jax.device_put(jnp.asarray(params[k], dtype=v.dtype))
-            for k, v in init_p.items()
-        }
+        int8 = int8 or {}
+        self._int8 = tuple(sorted(int8))
+        self.params = {}
+        for k, v in init_p.items():
+            if k in int8:
+                q, scale = int8[k]
+                # int8 stays int8 in HBM; the scale rides the params tree
+                # (an argument of every compiled call, never a folded
+                # constant) and _make_run dequantizes in-trace
+                self.params[k] = jax.device_put(jnp.asarray(q, jnp.int8))
+                self.params[k + _SCALE_SUFFIX] = jax.device_put(
+                    jnp.asarray(scale, jnp.float32))
+            else:
+                self.params[k] = jax.device_put(
+                    jnp.asarray(params[k], dtype=v.dtype))
         self.state = {
             k: jax.device_put(jnp.asarray(state[k], dtype=v.dtype))
             for k, v in init_s.items()
         }
+        if fingerprint is None:
+            import hashlib
+
+            h = hashlib.sha256(mc.SerializeToString())
+            for k in sorted(self.params):
+                a = self.params[k]
+                h.update(f"{k}:{tuple(a.shape)}:{a.dtype}".encode())
+            fingerprint = h.hexdigest()[:32]
+        self.fingerprint = fingerprint
+        #: XLA compiles this process actually paid (prime misses + cold
+        #: infer signatures) — the cold-start acceptance counter
+        self.compile_events = 0
+        #: exact-signature AOT executables installed by prime(); the
+        #: infer hot path consults this before the jit table
+        self._aot: Dict[tuple, Any] = {}
         self._fns: Dict[tuple, Any] = {}
         #: required-input-slot sets per output tuple — the topology walk
         #: is a pure function of the names, so the serving hot path (one
@@ -201,13 +453,111 @@ class InferenceModel:
                 f"{list(names)} need inputs {sorted(need)}")
 
     def _make_run(self, names: tuple):
+        int8_names = self._int8
+
         def run(params, state, feed):
+            if int8_names:
+                from paddle_tpu.ops.numerics import compute_dtype
+
+                cd = compute_dtype()
+                params = dict(params)
+                for n in int8_names:
+                    scale = params.pop(n + _SCALE_SUFFIX)
+                    params[n] = params[n].astype(cd) * scale.astype(cd)
             outs, _ = self.topology.apply(
                 params, state, feed, train=False, outputs=list(names)
             )
             return {n: outs[n].value for n in names}
 
         return run
+
+    def _int8_gate(self) -> bool:
+        """The in-trace-dequantize admission gate: the compiled forward
+        must audit clean for dtype-promotion and constant-bloat (an int8
+        table accidentally materialized as f32 *constants* is exactly
+        what the constant-bloat check catches), and under ``--amp`` the
+        amp-matmul auditor must find no f32 MXU regression — otherwise
+        the loader falls back to load-time dequantization."""
+        from paddle_tpu.analysis import audit_fn, errors_summary
+        from paddle_tpu.nn.feeds import example_feed
+        from paddle_tpu.ops.numerics import amp_enabled
+        from paddle_tpu.utils import logger
+
+        names = tuple(self.output_names)
+        run = self._make_run(names)
+        feed = example_feed(self.topology)
+        try:
+            findings = audit_fn(run, self.params, self.state, feed,
+                                label="int8_in_trace",
+                                checks=["dtype-promotion", "constant-bloat"])
+            if amp_enabled():
+                from paddle_tpu.analysis.jaxpr_audit import audit_amp_matmuls
+
+                closed = jax.make_jaxpr(run)(self.params, self.state, feed)
+                findings += audit_amp_matmuls(closed, label="int8_in_trace")
+        except Exception as e:  # noqa: BLE001 — an unauditable trace fails
+            logger.warning("int8 in-trace gate could not audit the forward "
+                           "(%s: %s)", type(e).__name__, e)
+            return False
+        bad = errors_summary(findings)
+        if bad:
+            logger.warning("int8 in-trace gate failed: %s", bad)
+            return False
+        return True
+
+    def prime(self, feed: Dict[str, Any],
+              outputs: Optional[Sequence[str]] = None,
+              cache=None) -> str:
+        """Compile-or-load the exact-signature AOT executable for this
+        feed shape — the warmup unit of the serving readiness gate
+        (docs/deploy.md).  With a compile ``cache`` a previously-warmed
+        signature LOADS in milliseconds instead of re-running XLA;
+        the loaded executable is smoke-called once before it is trusted
+        (a stale or wrong entry becomes a fresh compile, never a wrong
+        reply).  Returns ``"warm"`` (already primed), ``"hit"`` (cache
+        load), ``"miss"`` (cache given, compiled + stored) or
+        ``"compiled"`` (no cache)."""
+        names = tuple(outputs) if outputs else tuple(self.output_names)
+        self._check_feed(feed, names)
+        sig = feed_signature(feed)
+        k = (names, sig)
+        if k in self._aot:
+            return "warm"
+        key = None
+        if cache is not None:
+            from paddle_tpu.config.compile_cache import cache_key
+
+            key = cache_key("infer", self.fingerprint, names, sig)
+            fn = cache.load(key)
+            if fn is not None and self._install_aot(k, fn, feed):
+                return "hit"
+        compiled = jax.jit(self._make_run(names)).lower(
+            self.params, self.state, feed).compile()
+        self.compile_events += 1
+        self._aot[k] = compiled
+        if cache is not None:
+            cache.store(key, compiled,
+                        label=f"infer:{self.manifest.get('name', 'model')}")
+            return "miss"
+        return "compiled"
+
+    def _install_aot(self, k: tuple, fn, feed) -> bool:
+        """Smoke-call a cache-loaded executable before trusting it with
+        traffic: a wrong or stale program must degrade to a compile."""
+        from paddle_tpu.utils import logger
+
+        try:
+            out = fn(self.params, self.state, feed)
+            if set(out) != set(k[0]):
+                raise ValueError(f"output names {sorted(out)} != "
+                                 f"{sorted(k[0])}")
+        except Exception as e:  # noqa: BLE001 — fall back to a compile
+            logger.warning("compile cache: loaded executable rejected by "
+                           "its smoke call (%s: %s) — recompiling",
+                           type(e).__name__, e)
+            return False
+        self._aot[k] = fn
+        return True
 
     def infer(
         self, feed: Dict[str, Any], outputs: Optional[Sequence[str]] = None
@@ -249,6 +599,20 @@ class InferenceModel:
                     self._empty_cache.clear()
                 self._empty_cache[key] = res
             return {k: np.asarray(v) for k, v in res.items()}
+        if self._aot:
+            # primed signatures serve from the AOT table (the warmed
+            # executables ARE the serving executables — the compile cache
+            # would be pointless if the hot path re-jitted beside it)
+            afn = self._aot.get((names, feed_signature(feed)))
+            if afn is not None:
+                try:
+                    res = afn(self.params, self.state, feed)
+                    return {k: np.asarray(v) for k, v in res.items()}
+                except TypeError:
+                    # aval/weak-type mismatch with the primed signature:
+                    # fall through to the jit path rather than fail the
+                    # request (jit re-canonicalizes)
+                    pass
         fn = self._fns.get(names)
         if fn is None:
             with self._fns_lock:
@@ -279,7 +643,16 @@ def _read_member(z: zipfile.ZipFile, path: str, name: str) -> bytes:
             path=path, member=name) from e
 
 
-def load_inference_model(path: str) -> InferenceModel:
+def load_inference_model(path: str, *,
+                         int8_in_trace: bool = False) -> InferenceModel:
+    """Load a ``.ptz`` bundle into a servable :class:`InferenceModel`.
+
+    Quantized bundles (``merge_model(quantize=...)``) dequantize on load
+    to the model's parameter dtype; with ``int8_in_trace`` the int8
+    matmul weights instead stay quantized in HBM and dequantize inside
+    the compiled forward (to the compute dtype), gated by the lint
+    auditor — a gate failure logs and falls back to load-time
+    dequantization, never a silently degraded program."""
     try:
         zf = zipfile.ZipFile(path, "r")
     except FileNotFoundError:
@@ -323,7 +696,30 @@ def load_inference_model(path: str) -> InferenceModel:
                 raise BundleCorruptError(
                     f"bundle {path!r} state.npz does not parse: {e}",
                     path=path, member="state.npz") from e
-    return InferenceModel(mc, params, state, manifest)
+        # executable identity for the compile cache: zip-level CRCs of
+        # the config + weights (already verified by _read_member) — two
+        # bundles with identical payloads share warmed executables
+        crcs = {i.filename: i.CRC for i in z.infolist()}
+    fp = "bundle:" + "-".join(
+        f"{crcs.get(m, 0):08x}" for m in ("model.pb", "params.npz"))
+    qinfo = manifest.get("quantize") or {}
+    qmeta = qinfo.get("arrays") or {}
+    if qmeta:
+        if int8_in_trace and any(m.get("mode") == "int8"
+                                 for m in qmeta.values()):
+            deq, int8 = _dequantize_params(params, qmeta, path=path,
+                                           keep_int8=True)
+            model = InferenceModel(mc, deq, state, manifest,
+                                   fingerprint=fp + ":int8t", int8=int8)
+            if model._int8_gate():
+                return model
+            from paddle_tpu.utils import logger
+
+            logger.warning("bundle %r: int8 in-trace dequantize failed "
+                           "the lint gate — dequantizing at load instead",
+                           path)
+        params, _ = _dequantize_params(params, qmeta, path=path)
+    return InferenceModel(mc, params, state, manifest, fingerprint=fp)
 
 
 # ---------------------------------------------------------------------------
@@ -391,13 +787,28 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
          if isinstance(bundle_or_model, str) else bundle_or_model)
     names, spec, flat_example, fn = _flat_signature(m, example_feed, outputs)
 
+    requested = ("cpu", "tpu")
     try:  # portable artifact when this jax supports multi-platform export
-        exporter = jexport.export(jax.jit(fn), platforms=("cpu", "tpu"))
+        exporter = jexport.export(jax.jit(fn), platforms=requested)
     except TypeError:  # older jax.export signature without platforms=
+        from paddle_tpu.utils import logger
+
+        logger.warning(
+            "export_aot: this jax's export() does not support "
+            "platforms=%r — exporting for the CURRENT platform only; the "
+            "artifact will refuse to load on other platforms (see the "
+            "manifest's 'platforms' list)", list(requested))
         exporter = jexport.export(jax.jit(fn))
     exported = exporter(*flat_example)  # trace ONCE, outside the fallback
+    # record what the artifact ACTUALLY targets (not what was asked for):
+    # load_exported fails fast on a platform the artifact never compiled
+    # for instead of dying mysteriously inside the runtime
+    platforms = ([str(p).lower()
+                  for p in getattr(exported, "platforms", ())]
+                 or [jax.default_backend()])
     manifest = {
         "magic": _AOT_MAGIC,
+        "platforms": platforms,
         "inputs": [
             {"name": k, "parts": n} for k, n in spec
         ],
@@ -416,6 +827,34 @@ def export_aot(bundle_or_model, out_path: str, example_feed: Dict[str, Any],
         z.writestr("manifest.json", json.dumps(manifest, indent=1))
         z.writestr("fn.stablehlo", exported.serialize())
     return out_path
+
+
+def load_exported(aot_path: str):
+    """Deserialize an ``export_aot`` artifact WITH the platform gate:
+    the manifest records the platforms the StableHLO was actually
+    lowered for, and an artifact that never targeted this process's
+    backend fails fast with the fix spelled out — instead of a
+    mysterious runtime error deep inside the first call.  Returns
+    ``(exported, manifest)``."""
+    from jax import export as jexport
+
+    with zipfile.ZipFile(aot_path) as z:
+        try:
+            manifest = json.loads(_read_member(z, aot_path, "manifest.json"))
+        except json.JSONDecodeError as e:
+            raise BundleCorruptError(
+                f"AOT artifact {aot_path!r} manifest.json does not parse: "
+                f"{e}", path=aot_path, member="manifest.json") from e
+        blob = _read_member(z, aot_path, "fn.stablehlo")
+    backend = jax.default_backend()
+    platforms = [str(p).lower() for p in manifest.get("platforms") or []]
+    if platforms and backend not in platforms:
+        raise ValueError(
+            f"AOT artifact {aot_path!r} was exported for platforms "
+            f"{platforms} but this process runs on {backend!r} — "
+            f"re-export it on a jax whose export() accepts "
+            f"platforms=(..., {backend!r})")
+    return jexport.deserialize(bytearray(blob)), manifest
 
 
 _HLO_DTYPES = {"float32": "f32", "int32": "i32", "float64": "f64",

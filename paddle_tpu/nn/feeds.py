@@ -30,56 +30,73 @@ __all__ = ["example_feed", "zero_batch_like", "empty_outputs"]
 
 
 def example_feed(topology, *, batch: int = 1, seq_len: int = 8,
-                 nnz: int = 4) -> Dict[str, Any]:
+                 nnz: int = 4, rng=None) -> Dict[str, Any]:
     """A valid all-zeros feed for every data layer of ``topology``.
 
     Token ids are 0 (always in-vocab), lengths are full (no masking edge
-    cases at trace time), sparse bags carry one feature per row."""
+    cases at trace time), sparse bags carry one feature per row.
+
+    With ``rng`` (a ``np.random.RandomState``) float values and token ids
+    randomize (ids stay in-vocab, lengths stay full) — the synthetic feed
+    *sweep* behind the quantized-export error gate (config.deploy), which
+    must exercise real embedding rows and activation ranges; an all-zeros
+    feed would flatter any quantizer."""
     feed: Dict[str, Any] = {}
     B, T = int(batch), int(seq_len)
+
+    def fill_f(shape):
+        if rng is None:
+            return np.zeros(shape, np.float32)
+        return (rng.randn(*shape) * 0.5).astype(np.float32)
+
+    def fill_i(shape, hi):
+        if rng is None:
+            return np.zeros(shape, np.int32)
+        return rng.randint(0, max(2, int(hi)), shape).astype(np.int32)
+
     for layer in topology.data_layers:
         spec = layer.data_spec or {}
         size = max(int(layer.size), 1)
         is_int = spec.get("dtype") == "int32"
         sparse = spec.get("sparse")
         if sparse and spec.get("is_seq"):
-            ids = np.zeros((B, T, nnz), np.int32)
+            ids = fill_i((B, T, nnz), size)
             bag = np.ones((B, T), np.int32)
             lens = np.full((B,), T, np.int32)
             if sparse == "float":
-                feed[layer.name] = (ids, np.zeros((B, T, nnz), np.float32),
+                feed[layer.name] = (ids, fill_f((B, T, nnz)),
                                     bag, lens)
             else:
                 feed[layer.name] = (ids, bag, lens)
         elif sparse:
-            ids = np.zeros((B, nnz), np.int32)
+            ids = fill_i((B, nnz), size)
             bag = np.ones((B,), np.int32)
             if sparse == "float":
-                feed[layer.name] = (ids, np.zeros((B, nnz), np.float32), bag)
+                feed[layer.name] = (ids, fill_f((B, nnz)), bag)
             else:
                 feed[layer.name] = (ids, bag)
         elif spec.get("nested"):
             To = Ti = max(2, min(T, 4))
             if is_int:
-                value = np.zeros((B, To, Ti), np.int32)
+                value = fill_i((B, To, Ti), size)
             else:
-                value = np.zeros((B, To, Ti, size), np.float32)
+                value = fill_f((B, To, Ti, size))
             outer = np.full((B,), To, np.int32)
             sub = np.full((B, To), Ti, np.int32)
             feed[layer.name] = (value, outer, sub)
         elif spec.get("is_seq"):
             if is_int:
-                value = np.zeros((B, T), np.int32)
+                value = fill_i((B, T), size)
             else:
-                value = np.zeros((B, T, size), np.float32)
+                value = fill_f((B, T, size))
             feed[layer.name] = (value, np.full((B,), T, np.int32))
         elif is_int:
-            feed[layer.name] = np.zeros((B, 1), np.int32)
+            feed[layer.name] = fill_i((B, 1), size)
         elif layer.meta.get("hw"):
             h, w = layer.meta["hw"]
-            feed[layer.name] = np.zeros((B, h, w, size), np.float32)
+            feed[layer.name] = fill_f((B, h, w, size))
         else:
-            feed[layer.name] = np.zeros((B, size), np.float32)
+            feed[layer.name] = fill_f((B, size))
     return feed
 
 

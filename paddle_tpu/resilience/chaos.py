@@ -37,7 +37,10 @@ sees, deterministically:
   callable to stall chosen calls (the slow-backend / deadline-blowing
   model), ``crash_calls`` makes chosen calls raise (the breaker-tripping
   model), ``slow_client`` paces a feed stream (the trickle-submitting
-  client admission control must not starve on), and
+  client admission control must not starve on),
+  ``corrupt_compile_cache`` damages a persisted AOT executable (dir
+  entry or bundle ``aot/`` member — the warm-boot path must fall back
+  to a fresh compile, docs/deploy.md), and
   ``straggler_request`` marks a generation request adversarial never-EOS
   (the batch-hostage model continuous batching must contain).
   Poisoned inference batches reuse ``nan_feed`` on the request feed.
@@ -111,6 +114,52 @@ def truncate_file(path: str, *, keep_bytes: Optional[int] = None,
     keep = keep_bytes if keep_bytes is not None else int(size * frac)
     with open(path, "r+b") as f:
         f.truncate(max(0, keep))
+
+
+def corrupt_compile_cache(target: str, *, key: Optional[str] = None,
+                          mode: str = "corrupt") -> Optional[str]:
+    """Damage one cached AOT executable (docs/deploy.md) — the
+    stale-NFS / torn-write / bit-rot model the compile cache must
+    absorb: a load that hits a damaged entry FALLS BACK to a fresh
+    compile (logged miss, counter incremented) and never crashes or
+    serves a wrong executable.
+
+    ``target`` is either a ``--compile_cache_dir`` directory (damages
+    the ``<key>.aotx`` file, or the first one when ``key`` is None) or a
+    ``.ptz`` bundle (rewrites the archive with the matching ``aot/``
+    member's payload bit-flipped/truncated in place).  Returns the
+    damaged file/member name, or None when there was nothing to damage.
+    """
+    if os.path.isdir(target):
+        names = sorted(n for n in os.listdir(target) if n.endswith(".aotx"))
+        if key is not None:
+            names = [n for n in names if n.startswith(key)]
+        if not names:
+            return None
+        path = os.path.join(target, names[0])
+        (corrupt_file if mode == "corrupt" else truncate_file)(path)
+        return path
+    # a bundle: zip members cannot be damaged in place — rewrite the
+    # archive with the target member's payload mangled
+    import zipfile
+
+    with zipfile.ZipFile(target) as z:
+        members = [(i.filename, z.read(i.filename)) for i in z.infolist()]
+    victim = None
+    for name, _ in members:
+        if name.startswith("aot/") and (key is None or key in name):
+            victim = name
+            break
+    if victim is None:
+        return None
+    with zipfile.ZipFile(target, "w", zipfile.ZIP_DEFLATED) as z:
+        for name, data in members:
+            if name == victim:
+                mid = len(data) // 2
+                data = (data[:mid] + bytes(b ^ 0xFF for b in data[mid:])
+                        if mode == "corrupt" else data[:mid])
+            z.writestr(name, data)
+    return victim
 
 
 def corrupt_checkpoint(ckpt_dir: str, *, target: str = "params.npz",

@@ -29,7 +29,8 @@ from paddle_tpu.data.feeder import bucket_length
 from paddle_tpu.serving.errors import ShedError
 
 __all__ = ["ServingFuture", "Request", "BatchQueue", "canonicalize_feed",
-           "merge_feeds", "split_outputs", "batch_bucket"]
+           "merge_feeds", "split_outputs", "batch_bucket",
+           "warmup_bucket_feeds"]
 
 
 class ServingFuture:
@@ -157,6 +158,26 @@ def _pad_rows(arr: np.ndarray, to: int) -> np.ndarray:
     # never introduce a zero-length sequence or out-of-vocab id
     reps = np.repeat(arr[-1:], to - arr.shape[0], axis=0)
     return np.concatenate([arr, reps], axis=0)
+
+
+def warmup_bucket_feeds(feed: Dict[str, Any],
+                        buckets) -> List[Dict[str, Any]]:
+    """One warmup feed per batch bucket: canonicalize, slice to ONE row
+    (a multi-row feed must not leave the small buckets cold), replicate
+    up each bucket.  THE one definition of the warmed shapes — the
+    warmup gates (server bucket + generation modes), ``warm_bundle``,
+    and ``SlotScheduler.prime`` all derive their cache keys from this,
+    and it is built from the same ``_pad_rows``/``canonicalize_feed``
+    primitives ``merge_feeds`` batches with, so warmed signatures can
+    never drift from the hot path's."""
+    canon, _, _ = canonicalize_feed(feed)
+    one = {name: (tuple(p[:1] for p in v) if isinstance(v, tuple)
+                  else v[:1])
+           for name, v in canon.items()}
+    return [{name: (tuple(_pad_rows(p, bucket) for p in v)
+                    if isinstance(v, tuple) else _pad_rows(v, bucket))
+             for name, v in one.items()}
+            for bucket in buckets]
 
 
 def merge_feeds(reqs: List[Request], max_batch: int

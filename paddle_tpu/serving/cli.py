@@ -6,9 +6,13 @@ Usage:
     python -m paddle_tpu serve --serve_bundle=model.ptz --serve_smoke=16
     python -m paddle_tpu serve --serve_continuous --serve_smoke=16
 
-Loads a deploy bundle, builds an :class:`InferenceServer` from the
+Loads a deploy bundle (quantized bundles dequantize on load —
+docs/deploy.md), builds an :class:`InferenceServer` from the
 ``--serve_*`` flags, runs the warmup/readiness gate (plus the
-``--serve_preflight`` lint audit), then either serves until
+``--serve_preflight`` lint audit) — with ``--compile_cache_dir`` or
+bundle-embedded ``aot/`` members, warmup LOADS persisted executables
+instead of compiling, so a warm replica boots ready in seconds — then
+either serves until
 SIGTERM/SIGINT (printing a ``healthz()`` line periodically) or — with
 ``--serve_smoke=N`` — pushes N synthetic requests through the full
 queue/batcher/worker path and exits 0 only if every one got a reply
@@ -62,7 +66,11 @@ def _continuous_smoke() -> int:
         hang_timeout_s=FLAGS.serve_hang_timeout_s,
         nonfinite=FLAGS.serve_nonfinite,
     )
-    server.start(preflight=FLAGS.serve_preflight)
+    from paddle_tpu.config.compile_cache import open_cache
+
+    server.start(preflight=FLAGS.serve_preflight,
+                 compile_cache=open_cache(
+                     cache_dir=FLAGS.compile_cache_dir))
     print(json.dumps({"ready": server.ready, **server.healthz()},
                      default=str))
     rng = np.random.RandomState(0)
@@ -137,7 +145,15 @@ def run(argv: Optional[List[str]] = None) -> int:
     )
     logger.info("serve: warming up %r (batch buckets up to %d)",
                 FLAGS.serve_bundle, FLAGS.serve_max_batch)
-    server.start(preflight=FLAGS.serve_preflight)
+    # persistent compiled executables (docs/deploy.md): bundle-embedded
+    # aot/ members (read-only — the fleet shares the artifact) layered
+    # over a shared --compile_cache_dir; a warm cache turns the whole
+    # readiness gate into deserialization
+    from paddle_tpu.config.compile_cache import open_cache
+
+    cache = open_cache(bundle=FLAGS.serve_bundle,
+                       cache_dir=FLAGS.compile_cache_dir)
+    server.start(preflight=FLAGS.serve_preflight, compile_cache=cache)
     print(json.dumps({"ready": server.ready, **server.healthz()},
                      default=str))
 

@@ -47,6 +47,10 @@ _COUNTERS = (
     "gen_steps",            # fused decode_step calls over the slot table
     "slot_recycled",        # slots freed (harvest or eviction) for reuse
     "slot_evicted",         # slots released by mid-generation deadline expiry
+    # fleet cold-start (docs/deploy.md; config/compile_cache.py)
+    "compile_cache_hits",    # warmup executables LOADED from the cache
+    "compile_cache_misses",  # warmup executables compiled + stored
+    "warmup_compiles",       # XLA compiles paid by the readiness gate
 )
 
 #: distinguishes the registry children of servers sharing one process

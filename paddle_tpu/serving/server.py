@@ -164,6 +164,10 @@ class InferenceServer:
             self.degrade_at = [max(1, (max_queue * (i + 1)) // (n + 1))
                                for i in range(n)]
         self._service_ema: Optional[float] = None  # seconds per batch
+        #: wall-clock of start() -> ready, the fleet cold-start metric
+        #: (docs/deploy.md); None until the readiness gate passes
+        self.cold_start_s: Optional[float] = None
+        self._compile_cache = None
         self._feeder = None   # attach_feeder(): healthz surfaces its drops
         self._gang = None     # healthz(): resolved once, lazily
         self._state = self.RUNNING
@@ -221,7 +225,8 @@ class InferenceServer:
     # ------------------------------------------------------------------
 
     def start(self, *, warmup_feed=None, warmup: bool = True,
-              preflight: bool = False) -> "InferenceServer":
+              preflight: bool = False,
+              compile_cache=None) -> "InferenceServer":
         """Prime the compile caches, optionally run the lint preflight,
         then start the supervised worker.
 
@@ -231,7 +236,17 @@ class InferenceServer:
         request would blow any reasonable deadline.  Coverage follows
         the feeds: a sequence model serves un-warmed sequence buckets
         with one cold compile on first use, so pass a representative
-        feed per expected length bucket (e.g. T=16/64/256)."""
+        feed per expected length bucket (e.g. T=16/64/256).
+
+        ``compile_cache`` (config.compile_cache — a ``--compile_cache_dir``
+        or the bundle's embedded ``aot/`` members) turns the warmup gate
+        into a LOAD path: every bucket executable previously warmed
+        anywhere in the fleet deserializes in milliseconds instead of
+        re-running XLA, covering both the bucket forwards and the
+        continuous-batching slot closures.  Hits/misses and the
+        start->ready wall-clock surface in ``healthz()['cold_start']``."""
+        t_start = self._clock()
+        self._compile_cache = compile_cache
         feeds = (warmup_feed if isinstance(warmup_feed, (list, tuple))
                  else [warmup_feed] if warmup_feed is not None else [])
         if preflight:
@@ -260,6 +275,7 @@ class InferenceServer:
                 self._warmup(feeds)
         self.supervisor.start()
         self._ready = True
+        self.cold_start_s = self._clock() - t_start
         return self
 
     def _warmup(self, feeds: List[Dict[str, Any]]) -> None:
@@ -269,7 +285,8 @@ class InferenceServer:
             feeds = [example_feed(self.model.topology)]
         if not feeds:
             return  # plain callable without an example: nothing to prime
-        from paddle_tpu.serving.batching import _pad_rows, batch_bucket
+        from paddle_tpu.serving.batching import (batch_bucket,
+                                                 warmup_bucket_feeds)
 
         # derived from batch_bucket itself so warmup can never drift from
         # the hot path's bucket ladder: exactly the shapes merge_feeds
@@ -277,26 +294,33 @@ class InferenceServer:
         buckets = sorted({batch_bucket(r, self.max_batch)
                           for r in range(1, self.max_batch + 1)})
         t0 = self._clock()
-        compiled = 0
+        compiled = hits = 0
+        # InferenceModel warms through prime(): the cache can swap the
+        # compile for a deserialize, and the warmed AOT executables ARE
+        # what infer() serves.  Plain callables keep the execute-once path.
+        prime = getattr(self.model, "prime", None)
         for feed in feeds:
-            canon, _, _ = canonicalize_feed(feed)
-            # prime from a ONE-row slice: a multi-row warmup feed must
-            # not leave the small buckets cold
-            canon = {
-                name: (tuple(p[:1] for p in v) if isinstance(v, tuple)
-                       else v[:1])
-                for name, v in canon.items()
-            }
-            for bucket in buckets:
-                padded = {
-                    name: (tuple(_pad_rows(p, bucket) for p in v)
-                           if isinstance(v, tuple) else _pad_rows(v, bucket))
-                    for name, v in canon.items()
-                }
-                self._runner(padded, {})
-                compiled += 1
-        logger.info("serving warmup: %d bucket shape(s) over %d feed(s) "
-                    "compiled in %.2fs", compiled, len(feeds),
+            for padded in warmup_bucket_feeds(feed, buckets):
+                if prime is not None:
+                    r = prime(padded, outputs=self._outputs,
+                              cache=self._compile_cache)
+                    if r == "hit":
+                        hits += 1
+                        self.metrics.inc("compile_cache_hits")
+                    elif r == "warm":
+                        pass  # duplicate signature: no compile was paid
+                    else:
+                        compiled += 1
+                        self.metrics.inc("warmup_compiles")
+                        if r == "miss":
+                            self.metrics.inc("compile_cache_misses")
+                else:
+                    self._runner(padded, {})
+                    compiled += 1
+                    self.metrics.inc("warmup_compiles")
+        logger.info("serving warmup: %d bucket shape(s) over %d feed(s) — "
+                    "%d compiled, %d cache-loaded in %.2fs",
+                    compiled + hits, len(feeds), compiled, hits,
                     self._clock() - t0)
 
     def _warmup_generation(self, feeds: List[Dict[str, Any]]) -> None:
@@ -313,6 +337,22 @@ class InferenceServer:
         buckets = sorted({batch_bucket(r, self.max_batch)
                           for r in range(1, self.max_batch + 1)})
         t0 = self._clock()
+        counts = None
+        if self._compile_cache is not None:
+            # load-or-compile every slot closure (prefill per admission
+            # bucket + step/write/release/finalize) from the persistent
+            # cache FIRST: the synthetic admission cycle below then
+            # exercises the loaded executables instead of compiling
+            counts = sched.prime(self._compile_cache, feeds,
+                                 buckets=buckets)
+        if counts and not counts.get("skipped"):
+            self.metrics.inc("compile_cache_hits", counts["hits"])
+            self.metrics.inc("compile_cache_misses", counts["misses"])
+            self.metrics.inc("warmup_compiles", counts["misses"])
+        # DELTA, not absolute: jit caches are per-closure but this
+        # process may have run earlier servers whose compiles must not
+        # bleed into this boot's count
+        jit_before = sched.compiled_programs()
         for feed in feeds:
             canon, _, sig = canonicalize_feed(feed)
             one = {
@@ -337,6 +377,13 @@ class InferenceServer:
         sched.reset()
         # the synthetic traffic must not read as served traffic on healthz
         sched.admitted = sched.recycled = sched.steps_run = 0
+        # report the compiles the jit closures ACTUALLY paid during the
+        # cycle, not an estimate — warmup_compiles is the cold-start
+        # acceptance surface.  On a fully-primed boot this is zero (the
+        # cycle ran the AOT executables); any signature that slipped past
+        # prime and fell back to a jit is counted honestly either way.
+        self.metrics.inc("warmup_compiles",
+                         max(0, sched.compiled_programs() - jit_before))
         logger.info("generation warmup: %d admission bucket(s) over %d "
                     "feed(s) + 1 step cycle compiled in %.2fs",
                     len(buckets), len(feeds), self._clock() - t0)
@@ -851,6 +898,19 @@ class InferenceServer:
                        "max_restarts": self.supervisor.max_restarts},
             "service_ema_ms": (round(self._service_ema * 1e3, 3)
                                if self._service_ema is not None else None),
+            # fleet cold-start surface (docs/deploy.md): how long this
+            # replica took to reach ready, and whether the warmup gate
+            # compiled (cache misses) or loaded (hits) its executables —
+            # a warm fleet rollout is pinned by compile_cache_misses == 0
+            "cold_start": {
+                "cold_start_s": (round(self.cold_start_s, 3)
+                                 if self.cold_start_s is not None else None),
+                "compile_cache_hits": self.metrics.count(
+                    "compile_cache_hits"),
+                "compile_cache_misses": self.metrics.count(
+                    "compile_cache_misses"),
+                "warmup_compiles": self.metrics.count("warmup_compiles"),
+            },
             **snap,
         }
         if self._feeder is not None:

@@ -86,6 +86,15 @@ class SlotBackend:
     def example_feed(self, rows: int = 1) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def fingerprint(self) -> Optional[str]:
+        """Identity of the compiled slot closures for the persistent
+        compile cache (docs/deploy.md).  The closures CLOSE OVER the
+        weights (they ride the executable as constants), so a correct
+        fingerprint must cover the parameter VALUES — backends that
+        cannot provide one return None and the scheduler skips caching
+        rather than risk serving another model's executable."""
+        return None
+
 
 class Seq2SeqSlotBackend(SlotBackend):
     """The flagship backend: :class:`~paddle_tpu.models.seq2seq
@@ -160,6 +169,27 @@ class Seq2SeqSlotBackend(SlotBackend):
         lens = np.full((rows,), self.src_len, np.int32)
         return {self.feed_name: (ids, lens)}
 
+    def fingerprint(self) -> str:
+        # memoized: the value-level hash walks every weight's bytes, and
+        # the params are immutable for the backend's lifetime — repeated
+        # prime() calls must not re-pay a full-model hash inside the
+        # cold-start path this cache exists to shrink
+        fp = getattr(self, "_fingerprint", None)
+        if fp is not None:
+            return fp
+        import hashlib
+
+        h = hashlib.sha256()
+        for name in sorted(self.params):
+            a = np.asarray(self.params[name])
+            h.update(f"{name}:{a.shape}:{a.dtype}".encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        h.update(f"{self.src_len}:{self.beam_size}:{self.max_len}:"
+                 f"{self.length_penalty}:{self.use_kernel}:"
+                 f"{self.feed_name}".encode())
+        self._fingerprint = "seq2seq:" + h.hexdigest()[:32]
+        return self._fingerprint
+
 
 # ---------------------------------------------------------------------------
 # the host-side slot table driver
@@ -229,10 +259,24 @@ class SlotScheduler:
             lambda c, slot, s0, row: write_slot(
                 c, slot, s0, bos=backend.bos, eos=backend.eos, row=row),
             donate_argnums=donate)
-        self._release_jit = jax.jit(release_slot, donate_argnums=donate)
+        # a fresh lambda, NOT the bare module function: jax.jit over the
+        # same function identity shares the C++ call cache across
+        # wrappers, which would make this table's _cache_size() (the
+        # warmup_compiles measurement) count compiles other schedulers
+        # in the process paid
+        self._release_jit = jax.jit(lambda c, slot: release_slot(c, slot),
+                                    donate_argnums=donate)
         self._final_jit = jax.jit(lambda c: finalize_slots(
             c, eos=backend.eos, length_penalty=backend.length_penalty))
         self._prefill_jit = jax.jit(backend.prefill)
+        #: the ORIGINAL jit closures, kept for (re-)priming: prime()
+        #: swaps the working attributes for AOT executables, and a later
+        #: prime against a fresh cache must lower from the real jits
+        #: again (a Compiled object has no .lower)
+        self._jit_src = {"step": self._step_jit, "write": self._write_jit,
+                         "release": self._release_jit,
+                         "final": self._final_jit,
+                         "prefill": self._prefill_jit}
 
         tpl = jax.eval_shape(backend.prefill, backend.example_feed(1))
         self._init_carry = lambda: init_slot_carry(
@@ -245,6 +289,148 @@ class SlotScheduler:
         self.steps_run = 0
         self.recycled = 0       # slots freed (harvest + eviction)
         self.admitted = 0       # slots filled
+        #: prime(): per-signature AOT prefill executables and per-rows
+        #: write executables (step/release/finalize have one fixed carry
+        #: shape for the table's lifetime and swap in place)
+        self._prefill_aot: Dict[tuple, Any] = {}
+        self._write_aot: Dict[int, Any] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def _prefill(self, feed):
+        """The admit-side prefill: primed AOT executable when this exact
+        feed signature was warmed, the jit closure otherwise."""
+        if self._prefill_aot:
+            from paddle_tpu.config.deploy import feed_signature
+
+            fn = self._prefill_aot.get(feed_signature(feed))
+            if fn is not None:
+                try:
+                    return fn(feed)
+                except TypeError:
+                    pass  # aval drift: the jit path re-canonicalizes
+        return self._prefill_jit(feed)
+
+    def _write(self, c, slot, s0, row):
+        """write_slot dispatch: the s0 batch state's row count varies by
+        admission bucket, so write executables are primed per-rows."""
+        if self._write_aot:
+            import jax
+
+            rows = int(np.shape(jax.tree_util.tree_leaves(s0)[0])[0])
+            fn = self._write_aot.get(rows)
+            if fn is not None:
+                try:
+                    return fn(c, slot, s0, row)
+                except TypeError:
+                    pass
+        return self._write_jit(c, slot, s0, row)
+
+    def prime(self, cache, feeds: List[Dict[str, Any]], *,
+              buckets: Optional[List[int]] = None) -> Dict[str, Any]:
+        """Load-or-compile every compiled closure of the table from the
+        persistent compile cache (docs/deploy.md): prefill at every
+        admission bucket of every warmup feed shape, plus the four table
+        closures (step / write / release / finalize).  The slot closures
+        close over the weights, so entries are keyed by the backend's
+        value-level :meth:`SlotBackend.fingerprint`; a backend without
+        one skips caching (``{"skipped": True}``) and the server falls
+        back to the synthetic-admission compile warmup."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.config.compile_cache import cache_key
+        from paddle_tpu.config.deploy import feed_signature
+        from paddle_tpu.serving.batching import (batch_bucket,
+                                                 warmup_bucket_feeds)
+        from paddle_tpu.utils.log import logger
+
+        counts = {"hits": 0, "misses": 0, "skipped": False}
+        fp = self.backend.fingerprint()
+        if cache is None or fp is None:
+            if fp is None:
+                logger.info("slot compile cache skipped: %s provides no "
+                            "fingerprint()", type(self.backend).__name__)
+            counts["skipped"] = True
+            return counts
+        b = self.backend
+        table_sig = (self.slots, b.beam_size, b.max_len, b.vocab_size,
+                     b.bos, b.eos, b.length_penalty, b.use_kernel)
+        carry_sig = jax.tree_util.tree_map(
+            lambda a: (tuple(np.shape(a)), str(np.asarray(a).dtype)),
+            self.carry)
+
+        def load_or_compile(kind, jit_fn, args, extra_sig=""):
+            key = cache_key("slot_" + kind, fp, table_sig, carry_sig,
+                            extra_sig)
+            fn = cache.load(key)
+            if fn is not None:
+                try:
+                    fn(*args)  # smoke-call before trusting the entry
+                except Exception as e:  # noqa: BLE001 — degrade to compile
+                    logger.warning("compile cache: slot %s executable "
+                                   "rejected by its smoke call (%s: %s) — "
+                                   "recompiling", kind, type(e).__name__, e)
+                else:
+                    counts["hits"] += 1
+                    return fn
+            compiled = jit_fn.lower(*args).compile()
+            counts["misses"] += 1
+            cache.store(key, compiled, label=f"slot_{kind}")
+            return compiled
+
+        # throwaway carries: write/release DONATE their carry on TPU, and
+        # the smoke call must never consume the live table.  Lowering
+        # always starts from _jit_src — the working attributes may
+        # already hold AOT executables from an earlier prime
+        self._step_jit = load_or_compile(
+            "step", self._jit_src["step"], (self._init_carry(),))
+        self._release_jit = load_or_compile(
+            "release", self._jit_src["release"], (self._init_carry(), 0))
+        self._final_jit = load_or_compile(
+            "final", self._jit_src["final"], (self._init_carry(),))
+        if buckets is None:
+            buckets = sorted({batch_bucket(r, self.slots)
+                              for r in range(1, self.slots + 1)})
+        # dedup WITHIN this call only: a re-prime (e.g. against a fresh
+        # cache dir) must re-process every signature so the new cache
+        # gets populated, overwriting the instance tables as it goes
+        for bucket in sorted(set(buckets)):
+            # the s0 batch state scales with the admission bucket's rows
+            s0 = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(b.prefill, b.example_feed(bucket)))
+            self._write_aot[bucket] = load_or_compile(
+                "write", self._jit_src["write"],
+                (self._init_carry(), 0, s0, 0), extra_sig=f"rows={bucket}")
+        seen = set()
+        for feed in feeds:
+            for padded in warmup_bucket_feeds(feed, buckets):
+                sig = feed_signature(padded)
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                self._prefill_aot[sig] = load_or_compile(
+                    "prefill", self._jit_src["prefill"], (padded,),
+                    extra_sig=str(sig))
+        self.cache_hits += counts["hits"]
+        self.cache_misses += counts["misses"]
+        return counts
+
+    def compiled_programs(self) -> int:
+        """Distinct programs the ORIGINAL jit closures actually compiled
+        in this process — the honest ``warmup_compiles`` count for an
+        uncached boot (prime()'s AOT loads/compiles never enter these
+        caches and are counted by its own hit/miss return)."""
+        n = 0
+        for fn in self._jit_src.values():
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                try:
+                    n += int(size())
+                except Exception:  # noqa: BLE001 — jax-internal surface
+                    pass
+        return n
 
     # -- occupancy ---------------------------------------------------------
 
@@ -294,7 +480,7 @@ class SlotScheduler:
         if not reqs:
             return 0
         merged, slices, rows = merge_feeds(reqs, self.slots)
-        state0 = self._prefill_jit(merged)
+        state0 = self._prefill(merged)
         now = self._clock()
         n = 0
         with self._lock:
@@ -314,8 +500,8 @@ class SlotScheduler:
                     results=[None] * (b - a))
                 for row in range(a, b):
                     slot = self._free.pop()
-                    self.carry = self._write_jit(self.carry, slot, state0,
-                                                 row)
+                    self.carry = self._write(self.carry, slot, state0,
+                                             row)
                     self._entries[slot] = _SlotEntry(req, row - a, limit,
                                                      now)
                     n += 1

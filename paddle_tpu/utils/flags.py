@@ -371,6 +371,21 @@ define_flag("deploy_lint", True,
             "run the jaxpr auditor on every AOT/bundle export and attach "
             "findings to the artifact manifest")
 
+# Deploy bundles + fleet cold-start (docs/deploy.md)
+define_flag("deploy_quantize", "", "bundle export weight quantization: "
+            "'' keeps f32; 'bf16' halves the weight payload; 'int8' "
+            "stores matmul-sized tensors as symmetric per-channel int8 "
+            "(~4x smaller) with scales alongside — every quantized "
+            "export is gated by a max-abs-error check against the f32 "
+            "oracle (merge_model quantize_tol)",
+            validator=lambda v: v in ("", "bf16", "int8"))
+define_flag("compile_cache_dir", "", "persistent compiled-executable "
+            "cache directory shared across serving replicas: warmup "
+            "bucket executables serialize here on first boot and LOAD "
+            "(not compile) on every later boot — seconds-not-minutes "
+            "fleet cold-start; bundles can also carry executables as "
+            "aot/ members (config.warm_bundle); '' = off")
+
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")
 define_flag("profile_dir", "", "write a jax.profiler trace here during train() "

@@ -46,6 +46,7 @@ _UNITS = [
     ("amp_ab", "ms (amp step; vs = ×f32)"),
     ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
     ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
+    ("cold_start_ab", "s (warm boot; vs = ×cold)"),
 ]
 
 

@@ -25,7 +25,8 @@ def small_mnist(monkeypatch):
             ("job", "config", "num_passes", "save_dir", "start_pass",
              "test_pass", "time_batches", "log_period", "serve_bundle",
              "serve_smoke", "serve_max_batch", "serve_deadline_ms",
-             "serve_preflight", "serve_continuous", "serve_slots")}
+             "serve_preflight", "serve_continuous", "serve_slots",
+             "compile_cache_dir", "deploy_quantize")}
     yield
     for k, v in keep.items():
         setattr(FLAGS, k, v)
@@ -71,23 +72,27 @@ def test_cli_help_lists_flags(capsys):
     assert "lint" in out and "--gang_max_restarts" not in out
 
 
-def _serve_bundle(tmp_path):
-    """Train one batch of a tiny net and write a deploy bundle."""
+def _serve_bundle(tmp_path, quantize=None):
+    """Train one batch of a tiny net and write a deploy bundle (sized so
+    int8 mode actually quantizes a matmul when requested)."""
     import paddle_tpu.nn as nn
     from paddle_tpu.config import merge_model
     from paddle_tpu.param.optimizers import Adam
     from paddle_tpu.trainer import SGDTrainer
 
     nn.reset_naming()
-    x = nn.data("x", size=4)
-    out = nn.fc(x, 3, act="softmax", name="out")
+    size = 4 if quantize is None else 32
+    x = nn.data("x", size=size)
+    out = nn.fc(x, 3 if quantize is None else 16, act="softmax", name="out")
     label = nn.data("label", size=1, dtype="int32")
     cost = nn.classification_cost(out, label, name="cost")
     tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
-    tr.train_batch({"x": np.zeros((4, 4), np.float32),
+    rng = np.random.RandomState(0)
+    tr.train_batch({"x": rng.randn(4, size).astype(np.float32),
                     "label": np.zeros((4, 1), np.int32)})
     path = str(tmp_path / "m.ptz")
-    merge_model(path, tr.topology, tr.params, tr.state, name="cli")
+    merge_model(path, tr.topology, tr.params, tr.state, name="cli",
+                quantize=quantize)
     return path
 
 
@@ -129,6 +134,55 @@ def test_cli_serve_continuous_smoke_zero_silent_drops(capsys):
     assert last["slots"]["capacity"] == 3
     assert last["slots"]["recycled"] == 11
     assert last["mean_slot_occupancy"] is not None
+
+
+def test_cli_serve_smoke_int8_bundle_warm_cache(tmp_path, capsys):
+    """CI acceptance (docs/deploy.md): `serve --serve_smoke` over an
+    int8-QUANTIZED bundle with a shared --compile_cache_dir.  First boot
+    populates the cache (misses); the SECOND boot must be pure cache-hit
+    — ready with `compile_cache_misses == 0` in healthz() — and still
+    answer every smoke request."""
+    import json
+
+    bundle = _serve_bundle(tmp_path, quantize="int8")
+    cache = str(tmp_path / "cache")
+    argv = ["serve", f"--serve_bundle={bundle}", "--serve_smoke=2",
+            f"--compile_cache_dir={cache}", "--serve_deadline_ms=60000"]
+    assert main(list(argv)) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    first = json.loads(out[0])
+    assert first["ready"] is True
+    assert first["cold_start"]["compile_cache_misses"] > 0
+
+    assert main(list(argv)) == 0  # second replica boot: warm fleet
+    out = capsys.readouterr().out.strip().splitlines()
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["ready"] is True
+    assert first["cold_start"]["compile_cache_misses"] == 0
+    assert first["cold_start"]["warmup_compiles"] == 0
+    assert first["cold_start"]["compile_cache_hits"] > 0
+    assert last["counters"]["completed"] == 2
+
+
+def test_cli_lint_deploy_quantized_bundle(tmp_path, capsys):
+    """`lint --deploy BUNDLE` audits the dequantized (and int8 in-trace)
+    forward of a QUANTIZED bundle — exit 0 on a clean export, 1 with a
+    deploy-build finding on a corrupt artifact."""
+    bundle = _serve_bundle(tmp_path, quantize="int8")
+    assert main(["lint", "--deploy", bundle]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "bad.ptz"
+    bad.write_bytes(b"garbage")
+    assert main(["lint", "--deploy", str(bad)]) == 1
+    assert "deploy-build" in capsys.readouterr().out
+
+
+def test_cli_help_lists_deploy_flags(capsys):
+    """The deploy/cold-start knobs ride the auto-generated flag table."""
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    for flag in ("--deploy_quantize", "--compile_cache_dir"):
+        assert flag in out, flag
 
 
 def test_cli_serve_continuous_requires_smoke():

@@ -634,6 +634,7 @@ def test_healthz_counter_key_set_pinned_for_dashboards():
         "breaker_trips", "inference_failed", "worker_crashed",
         "server_closed", "worker_restarts", "degraded", "batches",
         "gen_steps", "slot_recycled", "slot_evicted",
+        "compile_cache_hits", "compile_cache_misses", "warmup_compiles",
     }
     m = ServerMetrics()
     snap = m.snapshot()

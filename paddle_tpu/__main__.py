@@ -182,7 +182,7 @@ _USAGE = """\
 usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [--flag=value ...]
        python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE|--obs] ...
        python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
-       python -m paddle_tpu obs {merge|dump} DIR_OR_FILE... [--format text|json]
+       python -m paddle_tpu obs {merge|dump|trace} DIR_OR_FILE... [--format text|json|perfetto]
 
 The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
 module docstring of paddle_tpu/__main__.py).  `serve` runs the

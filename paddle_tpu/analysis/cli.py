@@ -46,6 +46,10 @@ enabled, audited for host transfers and constant bloat (the
 ``audit_decode`` contract), and diffed equation-for-equation against the
 telemetry-disabled trace — instrumentation must live in host-side Python
 around the existing per-batch sync, never inside the compiled program.
+The same gate covers request tracing (obs/trace.py): the train step AND
+the continuous-batching ``decode_step`` are re-traced with tracing armed
+(``--obs_journal`` + ``--trace_sample``) and must be equation-identical
+to tracing-off — spans add ZERO compiled equations.
 
 ``--decode [B,S,K,L]`` audits the compiled decode closure of the flagship
 generation path (Seq2SeqAttention.beam_search over the fused decode
@@ -268,7 +272,9 @@ def run(argv: Optional[List[str]] = None) -> int:
                    help="audit the telemetry contract: the compiled train "
                         "step with the timeline/MFU plumbing enabled must "
                         "be host-transfer-free AND identical to the "
-                        "telemetry-off trace")
+                        "telemetry-off trace; also pins the train step "
+                        "and decode_step identical with request tracing "
+                        "armed (spans add zero compiled equations)")
     p.add_argument("--amp", action="store_true",
                    help="audit the mixed-precision contract: the compiled "
                         "--amp train step (forward + backward + loss "

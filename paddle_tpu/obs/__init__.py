@@ -5,7 +5,10 @@ process-wide metrics registry (counters/gauges/histograms, Prometheus +
 JSON exposition, ``--metrics_port`` HTTP endpoint), the trainer's
 step-time breakdown with a live MFU gauge (same analytic-FLOPs walker as
 ``bench.py`` — ``analysis.flops``), the rank-tagged structured event
-journal (``--obs_journal`` + ``python -m paddle_tpu obs merge``), and
+journal (``--obs_journal`` + ``python -m paddle_tpu obs merge``),
+request-level distributed tracing (``obs/trace.py``: span-based
+tail-latency attribution across serving, the decode slot table, and the
+gang — ``python -m paddle_tpu obs trace`` / ``--format=perfetto``), and
 on-demand ``jax.profiler`` capture windows (``--profile_steps`` /
 SIGUSR2).
 
@@ -25,6 +28,10 @@ from paddle_tpu.obs.registry import (Counter, Gauge, Histogram,
                                      get_registry, reset_registry,
                                      start_metrics_server)
 from paddle_tpu.obs.timeline import PHASES, StepTimeline
+from paddle_tpu.obs.trace import (Span, Tracer, collect_traces,
+                                  format_trace_tree, get_tracer,
+                                  perfetto_trace, reset_tracer,
+                                  trace_summaries)
 
 __all__ = [
     "MetricsRegistry",
@@ -47,4 +54,12 @@ __all__ = [
     "set_journal_context",
     "close_journal",
     "ProfilerCapture",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "reset_tracer",
+    "collect_traces",
+    "trace_summaries",
+    "format_trace_tree",
+    "perfetto_trace",
 ]

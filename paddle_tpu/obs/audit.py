@@ -11,6 +11,13 @@ enabled vs disabled — and
    means no host round-trip per step), and
 2. asserts the two traced programs are equation-for-equation IDENTICAL —
    zero *added* anything, not merely zero transfers.
+
+Request tracing (obs/trace.py) extends the same contract to BOTH hot
+lifecycles: the train step AND the continuous-batching ``decode_step``
+are traced with tracing armed (``--obs_journal`` + ``--trace_sample``)
+vs off and must be equation-identical — spans are host-side bookkeeping
+around calls the loop already makes; tracing adds ZERO compiled
+equations.
 """
 
 from __future__ import annotations
@@ -44,10 +51,40 @@ def _tiny_trainer():
     return tr, feed
 
 
+def _tiny_decode_step():
+    """A minimal slot-table ``decode_step`` closure + carry — enough to
+    pin the compiled fused step's identity under tracing flags without
+    building the full flagship backend."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.decode import (LogitsReadout, decode_step,
+                                       init_slot_carry)
+
+    w = jnp.ones((4, 8), jnp.float32) * 0.1
+
+    def step_fn(tokens, state):
+        logits = state["h"] @ w
+        return logits, {"h": state["h"] * 0.9}
+
+    tpl = {"h": jax.ShapeDtypeStruct((1, 4), jnp.float32)}
+    carry = init_slot_carry(tpl, slots=2, beam_size=2, max_len=4, eos=1)
+
+    def fn(c):
+        return decode_step(step_fn, LogitsReadout(), c, vocab_size=8,
+                           eos=1)
+
+    return fn, carry
+
+
 def audit_telemetry_step() -> List[Finding]:
     """Trace the trainer step with telemetry ON, audit it, and diff the
-    jaxpr against the telemetry-OFF trace; returns findings (ERROR on any
-    host transfer or any added equation)."""
+    jaxpr against the telemetry-OFF trace; then diff the train step AND
+    the slot-table ``decode_step`` with request tracing armed vs off.
+    Returns findings (ERROR on any host transfer or any added
+    equation)."""
+    import tempfile
+
     import jax
 
     from paddle_tpu.utils.flags import FLAGS
@@ -80,6 +117,33 @@ def audit_telemetry_step() -> List[Finding]:
                         "enabled — instrumentation must stay host-side "
                         f"({len(on.jaxpr.eqns)} vs {len(off.jaxpr.eqns)} "
                         "top-level eqns)"))
+
+        # request tracing (obs/trace.py): arm the tracer flags and re-pin
+        # BOTH hot programs — the train step and the fused decode_step —
+        # equation-identical to tracing-off (spans never enter the trace)
+        dec_fn, dec_carry = _tiny_decode_step()
+        keep_trace = (FLAGS.obs_journal, FLAGS.trace_sample)
+        with tempfile.TemporaryDirectory() as td:
+            try:
+                FLAGS.obs_journal = td
+                FLAGS.trace_sample = 1.0
+                step_on = jax.make_jaxpr(tr._step_fn)(*args)
+                dec_on = jax.make_jaxpr(dec_fn)(dec_carry)
+                FLAGS.obs_journal = ""
+                step_off = jax.make_jaxpr(tr._step_fn)(*args)
+                dec_off = jax.make_jaxpr(dec_fn)(dec_carry)
+            finally:
+                FLAGS.obs_journal, FLAGS.trace_sample = keep_trace
+        for tag, a, b in (("train_step", step_on, step_off),
+                          ("decode_step", dec_on, dec_off)):
+            if str(a) != str(b):
+                findings.append(Finding(
+                    check="obs-trace-drift", severity="ERROR",
+                    where=f"obs:{tag}",
+                    message=f"the compiled {tag} DIFFERS with request "
+                            "tracing armed — spans must stay host-side "
+                            f"({len(a.jaxpr.eqns)} vs "
+                            f"{len(b.jaxpr.eqns)} top-level eqns)"))
     except Exception as e:  # a step that fails to trace is itself a finding
         findings.append(Finding(
             check="obs-build", severity="ERROR", where="obs:train_step",

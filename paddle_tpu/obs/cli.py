@@ -2,18 +2,27 @@
 
 Subcommands:
 
-- ``obs merge DIR_OR_FILE... [--format text|json] [--kind K]`` —
-  interleave per-rank journals (``events-r*.jsonl``) into one causal
-  timeline (sorted by wall-clock, then rank, then per-writer seq) and
-  print it; torn final lines (a rank SIGKILLed mid-write) are tolerated
-  and counted on stderr.  ``--kind`` filters to one record kind
-  (e.g. ``gang_resize``).
-- ``obs dump FILE_OR_DIR [--format text|json]`` — parse journals and
-  print per-kind counts plus the records (the quick "what happened on
-  this rank" view).
+- ``obs merge DIR_OR_FILE... [--format text|json] [--kind K]
+  [--trace ID] [--request ID]`` — interleave per-rank journals
+  (``events-r*.jsonl``) into one causal timeline (sorted by wall-clock,
+  then rank, then per-writer seq) and print it; torn final lines (a rank
+  SIGKILLed mid-write) are tolerated and counted on stderr.  ``--kind``
+  filters to one record kind (e.g. ``gang_resize``); ``--trace`` /
+  ``--request`` filter to one trace's / one request's span records
+  (obs/trace.py).
+- ``obs dump FILE_OR_DIR [--format text|json] [--trace ID]
+  [--request ID]`` — parse journals and print per-kind counts plus the
+  records (the quick "what happened on this rank" view).
+- ``obs trace DIR_OR_FILE... [--trace ID | --request ID]
+  [--format text|json|perfetto]`` — reconstruct request/step traces
+  end-to-end across ranks: without a selector, an index of traces
+  (slowest first); with one (or when exactly one trace exists), the
+  span-by-span latency tree.  ``--format=perfetto`` emits Chrome-trace
+  JSON loadable in Perfetto / ``chrome://tracing`` for flame-style
+  inspection.
 
 Exit status: 0 on success (even with torn lines — they are expected
-after a crash — and when ``--kind`` simply matches nothing), 2 when no
+after a crash — and when a filter simply matches nothing), 2 when no
 journal records were found at all.
 """
 
@@ -56,12 +65,30 @@ def _emit(records: List[dict], fmt: str) -> None:
             print(_fmt_text(rec))
 
 
+def _apply_span_filters(records: List[dict], ns) -> Optional[List[dict]]:
+    """The ``--trace`` / ``--request`` plumbing shared by merge and dump
+    (same contract as ``--kind``: zero matches is SUCCESS with an honest
+    message, returned as None)."""
+    for field, want in (("trace", getattr(ns, "trace", None)),
+                        ("request", getattr(ns, "request", None))):
+        if not want:
+            continue
+        total = len(records)
+        records = [r for r in records if r.get(field) == want]
+        if not records:
+            print(f"obs: no records with {field}={want!r} among {total}",
+                  file=sys.stderr)
+            return None
+    return records
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu obs",
         description="Event-journal tooling (docs/observability.md): merge "
-                    "per-rank journals into one causal timeline, or dump "
-                    "one journal with per-kind counts")
+                    "per-rank journals into one causal timeline, dump one "
+                    "journal with per-kind counts, or reconstruct "
+                    "request/step traces (obs trace)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pm = sub.add_parser("merge", help="interleave per-rank journals")
@@ -69,10 +96,31 @@ def run(argv: Optional[List[str]] = None) -> int:
     pm.add_argument("--format", choices=("text", "json"), default="text")
     pm.add_argument("--kind", default=None,
                     help="only records of this kind (e.g. gang_resize)")
+    pm.add_argument("--trace", default=None, metavar="ID",
+                    help="only span records of this trace id")
+    pm.add_argument("--request", default=None, metavar="ID",
+                    help="only span records of this request id")
 
     pd = sub.add_parser("dump", help="parse + summarize journal(s)")
     pd.add_argument("targets", nargs="+", metavar="DIR_OR_FILE")
     pd.add_argument("--format", choices=("text", "json"), default="text")
+    pd.add_argument("--trace", default=None, metavar="ID",
+                    help="only span records of this trace id")
+    pd.add_argument("--request", default=None, metavar="ID",
+                    help="only span records of this request id")
+
+    pt = sub.add_parser(
+        "trace", help="reconstruct request/step traces across ranks")
+    pt.add_argument("targets", nargs="+", metavar="DIR_OR_FILE")
+    pt.add_argument("--trace", default=None, metavar="ID",
+                    help="the trace to reconstruct (default: an index of "
+                         "all traces, or the tree when only one exists)")
+    pt.add_argument("--request", default=None, metavar="ID",
+                    help="reconstruct the trace(s) of this request id")
+    pt.add_argument("--format", choices=("text", "json", "perfetto"),
+                    default="text",
+                    help="perfetto = Chrome-trace JSON (open in "
+                         "ui.perfetto.dev / chrome://tracing)")
 
     ns = p.parse_args(argv)
 
@@ -85,6 +133,14 @@ def run(argv: Optional[List[str]] = None) -> int:
         print(f"obs: no journal records in {paths or ns.targets}",
               file=sys.stderr)
         return 2
+
+    if ns.cmd == "trace":
+        return _run_trace(records, ns)
+
+    filtered = _apply_span_filters(records, ns)
+    if filtered is None:
+        return 0
+    records = filtered
     if ns.cmd == "merge" and ns.kind:
         total = len(records)
         records = [r for r in records if r.get("kind") == ns.kind]
@@ -108,6 +164,65 @@ def run(argv: Optional[List[str]] = None) -> int:
         # `obs merge DIR | head` is the normal postmortem gesture: a
         # closed pipe ends the page, it is not an error.  Detach stdout
         # so the interpreter's shutdown flush doesn't re-raise.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return 0
+
+
+def _run_trace(records: List[dict], ns) -> int:
+    """``obs trace``: span records -> index / tree / Perfetto export."""
+    from paddle_tpu.obs.trace import (collect_traces, format_trace_tree,
+                                      perfetto_trace, trace_summaries)
+
+    traces = collect_traces(records)
+    if not traces:
+        print(f"obs: no span records among {len(records)} — tracing is "
+              f"armed by --obs_journal (docs/observability.md)",
+              file=sys.stderr)
+        return 0
+    if ns.request:
+        traces = {tid: spans for tid, spans in traces.items()
+                  if any(s.get("request") == ns.request for s in spans)}
+        if not traces:
+            print(f"obs: no trace with request={ns.request!r}",
+                  file=sys.stderr)
+            return 0
+    if ns.trace:
+        if ns.trace not in traces:
+            print(f"obs: no trace {ns.trace!r} among {len(traces)}",
+                  file=sys.stderr)
+            return 0
+        traces = {ns.trace: traces[ns.trace]}
+
+    try:
+        if ns.format == "perfetto":
+            spans = [s for sp in traces.values() for s in sp]
+            print(json.dumps(perfetto_trace(spans)))
+        elif len(traces) == 1 or ns.format == "json":
+            for tid, spans in traces.items():
+                if ns.format == "json":
+                    root = next((s for s in spans if not s.get("parent")),
+                                spans[0])
+                    print(json.dumps({"trace": tid,
+                                      "name": root.get("name"),
+                                      "request": root.get("request"),
+                                      "spans": spans},
+                                     separators=(",", ":")))
+                else:
+                    print(format_trace_tree(spans))
+        else:
+            # the index view: slowest first, one line per trace — pick an
+            # id and re-run with --trace=ID for the span-by-span tree
+            print(f"# {len(traces)} trace(s), slowest first "
+                  f"(reconstruct one with --trace=ID)", file=sys.stderr)
+            for s in trace_summaries(traces):
+                req = f" request={s['request']}" if s["request"] else ""
+                kept = f" retained={s['retained']}" if s["retained"] else ""
+                status = f" [{s['status']}]" if s["status"] else ""
+                print(f"{s['dur_ms']:10.2f}ms {s['name']:<12} "
+                      f"trace={s['trace']}{req}{status}{kept} "
+                      f"spans={s['spans']} ranks={s['ranks']}")
+    except BrokenPipeError:
         devnull = os.open(os.devnull, os.O_WRONLY)
         os.dup2(devnull, sys.stdout.fileno())
     return 0

@@ -101,6 +101,32 @@ class EventJournal:
                 pass  # a full disk / closed fd must never kill training
             return rec
 
+    def record_batch(self, kind: str, payloads: Iterable[Dict[str, Any]],
+                     *, fsync: bool = False) -> None:
+        """Append many records of one kind in ONE write (a kept trace
+        flushes its whole span tree at once — obs/trace.py): each payload
+        is still its own line/record with its own envelope and ``seq``,
+        the single write just amortizes the per-line syscall.  The crash
+        contract is unchanged: whole lines or one torn tail."""
+        with self._lock:
+            lines = []
+            for fields in payloads:
+                rec = {**self._ctx, **fields}
+                rec.update(t=time.time(), rank=self.rank, seq=self._seq,
+                           kind=kind)
+                self._seq += 1
+                lines.append(json.dumps(rec, default=str,
+                                        separators=(",", ":")))
+            if not lines:
+                return
+            try:
+                self._f.write("\n".join(lines) + "\n")
+                if fsync:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+            except (OSError, ValueError):
+                pass  # a full disk / closed fd must never kill the caller
+
     def close(self) -> None:
         with self._lock:
             try:

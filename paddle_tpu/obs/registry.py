@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -85,7 +86,8 @@ class Gauge(_Child):
 
 
 class Histogram(_Child):
-    __slots__ = ("buckets", "counts", "sum", "count", "min", "max")
+    __slots__ = ("buckets", "counts", "sum", "count", "min", "max",
+                 "exemplars")
 
     def __init__(self, lock, buckets: Sequence[float]) -> None:
         super().__init__(lock)
@@ -95,8 +97,12 @@ class Histogram(_Child):
         self.count = 0
         self.min = float("inf")
         self.max = 0.0
+        #: bucket index -> most recent (exemplar id, value, t): a
+        #: dashboard spike in one bucket links to a CONCRETE trace
+        #: (docs/observability.md "Request tracing" — exemplars)
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             i = 0
             for i, b in enumerate(self.buckets):
@@ -109,6 +115,12 @@ class Histogram(_Child):
             self.count += 1
             self.min = min(self.min, v)
             self.max = max(self.max, v)
+            if exemplar is not None:
+                self.exemplars[i] = (str(exemplar), v, time.time())
+
+    def _bucket_label(self, i: int) -> str:
+        return ("+Inf" if i >= len(self.buckets)
+                else repr(float(self.buckets[i])))
 
     @property
     def mean(self) -> Optional[float]:
@@ -235,11 +247,25 @@ class MetricsRegistry:
                     with child._lock:
                         count, total = child.count, child.sum
                         lo, hi = child.min, child.max
+                        exemplars = dict(child.exemplars)
                     entry.update(count=count,
                                  sum=round(total, 9),
                                  mean=(total / count if count else None),
                                  min=(None if count == 0 else lo),
                                  max=(hi if count else None))
+                    if exemplars:
+                        # JSON exposition only: the classic Prometheus
+                        # text format has no exemplar syntax (that is
+                        # OpenMetrics), and a suffix would corrupt
+                        # strict v0.0.4 parsers
+                        entry["exemplars"] = {
+                            child._bucket_label(i): {
+                                "trace": ex[0],
+                                "value": round(ex[1], 6),
+                                "t": round(ex[2], 3),
+                            }
+                            for i, ex in sorted(exemplars.items())
+                        }
                 else:
                     entry["value"] = child.value
                 series.append(entry)

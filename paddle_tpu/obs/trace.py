@@ -1,0 +1,662 @@
+"""Request-level distributed tracing — span-based tail-latency attribution.
+
+PR 9's telemetry answers *aggregate* questions (how many requests shed,
+where a pass's wall-clock goes); this module answers the question that
+drives p99 work: **why was *this* request slow?**  A Dapper-style span
+tracer with near-zero cost when disabled:
+
+- a **trace** is one request's (or one training step's) whole story:
+  a root :class:`Span` plus children, all sharing a ``trace_id``;
+- a **span** is one timed segment (``span_id``/``parent_id``, name,
+  ``t_start``/``t_end`` wall-clock, attributes, point-in-time events);
+- spans buffer in memory until the ROOT span ends, then the whole trace
+  is kept or dropped in one **tail-based sampling** decision:
+
+  1. any span called :meth:`Span.retain` (deadline-exceeded, shed,
+     evicted, bad-step — the incidents a postmortem needs) -> KEPT,
+     always;
+  2. else, with ``--trace_tail_p99``, a root duration at/above the
+     rolling p99 of its kind (per-root-name reservoir) -> KEPT — the
+     tail is exactly what aggregate histograms cannot explain;
+  3. else head-sampled at ``--trace_sample`` (deterministic on the
+     trace id, so co-operating ranks agree without coordination).
+
+Kept traces persist as ``kind="span"`` records through the PR 9 event
+journal — rank-tagged, append-only, crash-safe (torn final lines
+tolerated), and ordered by ``merge_journals`` — so a trace that crossed
+ranks reassembles with ``python -m paddle_tpu obs trace DIR`` and
+exports as Chrome-trace/Perfetto JSON (``--format=perfetto``).
+
+Context propagation is per-thread (``with tracer.span(...)`` pushes a
+thread-local stack) *and* explicit (``span.child(...)`` — serving hands
+a request's root span across the submit->worker thread boundary).
+
+Arming: a tracer needs a sink, so `get_tracer()` is live exactly when
+``--obs_journal`` is set; everywhere else it returns the singleton
+null tracer whose spans are inert no-ops (one attribute check per call
+site — the compiled step/decode programs are untouched either way,
+gated by ``lint --obs``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Span", "Tracer", "get_tracer", "reset_tracer", "null_tracer",
+           "collect_traces", "trace_summaries", "format_trace_tree",
+           "perfetto_trace", "RETAINED_HEAD", "RETAINED_P99"]
+
+#: sampling reasons stamped on kept roots (next to incident reasons like
+#: "deadline_expired"/"shed"/"bad_step" passed to Span.retain)
+RETAINED_HEAD = "head_sample"
+RETAINED_P99 = "p99_tail"
+
+
+#: id generator: a PRNG seeded once from the OS — ids need uniqueness
+#: and uniformity (the head-sampling hash), not secrecy, and an
+#: os.urandom syscall per span id dominated the traced submit path
+_ID_RNG = __import__("random").Random(os.urandom(16))
+
+
+def _new_id(nbytes: int = 8) -> str:
+    # getrandbits on one shared Random is GIL-atomic (C implementation)
+    return f"{_ID_RNG.getrandbits(8 * nbytes):0{2 * nbytes}x}"
+
+
+class Span:
+    """One timed segment of a trace.  End exactly once (``end`` is
+    idempotent); attributes and events may be added while open.  Usable
+    as a context manager — entering pushes it onto the owning tracer's
+    per-thread context stack so nested ``tracer.span(...)`` calls parent
+    automatically."""
+
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "t_start", "t_end", "attrs", "events", "status")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, parent_id: Optional[str],
+                 name: str, attrs: Dict[str, Any],
+                 t_start: Optional[float] = None) -> None:
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = _new_id(4)
+        self.parent_id = parent_id
+        self.name = name
+        self.t_start = time.time() if t_start is None else t_start
+        self.t_end: Optional[float] = None
+        self.attrs = dict(attrs)
+        self.events: List[Dict[str, Any]] = []
+        self.status: Optional[str] = None
+
+    # -- while open ------------------------------------------------------
+
+    def set(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **fields: Any) -> None:
+        """Attach a point-in-time event (a gang resize, an eviction) to
+        this span — it rides the span record and the Perfetto export."""
+        self.events.append({"name": name, "t": round(time.time(), 6),
+                            **fields})
+
+    def retain(self, reason: str) -> None:
+        """Mark the WHOLE trace must-keep: tail sampling never drops it.
+        The first reason wins (it names the triggering incident)."""
+        self.tracer._retain(self.trace_id, reason)
+
+    # -- children --------------------------------------------------------
+
+    def child(self, name: str, **attrs: Any) -> Any:
+        """Open a child span (explicit parenting — the cross-thread path
+        serving uses to continue a request's trace on the worker).
+        Returns the inert null span when the trace was already flushed
+        or cancelled — a late child never crashes the caller."""
+        sp = self.tracer._span(self.trace_id, self.span_id, name, attrs)
+        return sp if sp is not None else _NULL_SPAN
+
+    def child_at(self, name: str, t0: float, t1: float,
+                 **attrs: Any) -> None:
+        """Record an already-measured child segment (t0/t1 wall-clock):
+        the one-call form for hot paths that already hold both stamps —
+        one buffer append under one lock, no Span object (this is the
+        per-resident per-decode-step path)."""
+        self.tracer._record_child(self.trace_id, self.span_id, name,
+                                  t0, t1, attrs)
+
+    # -- closing ---------------------------------------------------------
+
+    def end(self, status: Optional[str] = None,
+            t_end: Optional[float] = None, **attrs: Any) -> bool:
+        """Close the span.  Ending a ROOT span returns whether tail
+        sampling KEPT the trace — callers attaching the trace id
+        elsewhere (histogram exemplars) must only link traces that
+        actually reached the journal.  Child ends return False."""
+        if self.t_end is not None:
+            return False  # set-once: a double-failing handler is a no-op
+        self.t_end = time.time() if t_end is None else t_end
+        if status is not None:
+            self.status = status
+        if attrs:
+            self.attrs.update(attrs)
+        return self.tracer._end_span(self)
+
+    def cancel(self) -> None:
+        """Abandon the trace this span roots (loop bookkeeping: a step
+        span opened before the reader reported end-of-pass)."""
+        self.t_end = self.t_start  # closed, but never recorded
+        self.tracer._cancel(self.trace_id)
+
+    # -- thread-context protocol ----------------------------------------
+
+    def __enter__(self) -> "Span":
+        self.tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.tracer._pop(self)
+        self.end(status="error" if exc_type is not None else None)
+        return False
+
+
+class _NullSpan:
+    """The disabled-path span: every operation is an inert no-op so call
+    sites need no ``if enabled`` guards once they hold a span."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    name = ""
+    t_start = 0.0
+    t_end = 0.0
+    attrs: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+    status = None
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+    def event(self, name, **fields) -> None:
+        pass
+
+    def retain(self, reason) -> None:
+        pass
+
+    def child(self, name, **attrs) -> "_NullSpan":
+        return self
+
+    def child_at(self, name, t0, t1, **attrs) -> None:
+        pass
+
+    def end(self, status=None, t_end=None, **attrs) -> bool:
+        return False
+
+    def cancel(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _TraceBuf:
+    __slots__ = ("root", "spans", "retained", "request", "dropped")
+
+    def __init__(self, root: Span, request: Optional[str]) -> None:
+        self.root = root
+        self.spans: List[Dict[str, Any]] = []
+        self.retained: Optional[str] = None
+        self.request = request
+        self.dropped = 0
+
+
+class Tracer:
+    """Buffer-then-decide span recorder over one journal sink.
+
+    ``journal`` is a :class:`~paddle_tpu.obs.journal.EventJournal` (kept
+    traces become its ``kind="span"`` records); ``None`` collects kept
+    records in ``self.records`` instead (unit tests).  One tracer may be
+    shared by many threads; the short buffer sections are lock-protected
+    and the journal writer is itself thread-safe."""
+
+    #: memory bounds — a leaked root or a pathological span storm must
+    #: degrade to dropped spans, never to unbounded growth
+    MAX_SPANS_PER_TRACE = 4096
+    MAX_OPEN_TRACES = 1024
+
+    enabled = True
+
+    def __init__(self, journal=None, *, sample: float = 1.0,
+                 tail_p99: bool = True, reservoir: int = 512,
+                 min_reservoir: int = 32) -> None:
+        self._journal = journal
+        self.sample = float(sample)
+        self.tail_p99 = bool(tail_p99)
+        self._min_reservoir = int(min_reservoir)
+        self._lock = threading.Lock()
+        self._traces: Dict[str, _TraceBuf] = {}
+        self._lat: Dict[str, deque] = {}   # root name -> recent durations
+        self._reservoir = int(reservoir)
+        self._tls = threading.local()
+        self.records: List[Dict[str, Any]] = []  # sink when journal=None
+        self.kept = 0
+        self.dropped = 0
+
+    # -- opening ---------------------------------------------------------
+
+    def start_trace(self, name: str, *, request: Optional[str] = None,
+                    **attrs: Any) -> Span:
+        """Open a new trace and return its root span.  ``request`` (a
+        request id) is stamped onto every record of the trace so
+        ``obs merge --request=ID`` finds it without knowing the trace id."""
+        root = Span(self, _new_id(8), None, name, attrs)
+        with self._lock:
+            if len(self._traces) >= self.MAX_OPEN_TRACES:
+                # evict the oldest open trace: a leaked root must not
+                # pin every later trace's memory
+                oldest = next(iter(self._traces))
+                del self._traces[oldest]
+            self._traces[root.trace_id] = _TraceBuf(root, request)
+        return root
+
+    def span(self, name: str, *, parent: Optional[Span] = None,
+             **attrs: Any) -> Any:
+        """Open a child of ``parent`` or, with no parent given, of the
+        calling thread's current span (context propagation).  Without
+        either there is no trace to join: returns the inert null span."""
+        if parent is None:
+            parent = self.current()
+        if parent is None or isinstance(parent, _NullSpan):
+            return _NULL_SPAN
+        return parent.child(name, **attrs)
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def trace_at(self, name: str, t0: float, t1: float, *,
+                 retain: Optional[str] = None, request: Optional[str] = None,
+                 **attrs: Any) -> str:
+        """Record a complete single-span trace in one call (supervisor
+        incidents: a resize measured start->complete).  Returns the
+        trace id."""
+        root = self.start_trace(name, request=request, **attrs)
+        root.t_start = t0
+        if retain:
+            root.retain(retain)
+        root.end(t_end=t1)
+        return root.trace_id
+
+    # -- internals -------------------------------------------------------
+
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+
+    def _span(self, trace_id: str, parent_id: str, name: str,
+              attrs: Dict[str, Any],
+              t_start: Optional[float] = None) -> Optional[Span]:
+        with self._lock:
+            buf = self._traces.get(trace_id)
+            if buf is None:
+                return None  # trace already flushed/cancelled
+            if len(buf.spans) >= self.MAX_SPANS_PER_TRACE:
+                buf.dropped += 1
+                return None
+        return Span(self, trace_id, parent_id, name, attrs, t_start=t_start)
+
+    def _record_child(self, trace_id: str, parent_id: str, name: str,
+                      t0: float, t1: float,
+                      attrs: Dict[str, Any]) -> None:
+        rec: Dict[str, Any] = {
+            "trace": trace_id, "span": _new_id(4), "parent": parent_id,
+            "name": name, "t0": round(t0, 6),
+            "dur": round(max(0.0, t1 - t0), 6),
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            buf = self._traces.get(trace_id)
+            if buf is None:
+                return
+            if len(buf.spans) < self.MAX_SPANS_PER_TRACE:
+                buf.spans.append(rec)
+            else:
+                buf.dropped += 1
+
+    def _retain(self, trace_id: str, reason: str) -> None:
+        with self._lock:
+            buf = self._traces.get(trace_id)
+            if buf is not None and buf.retained is None:
+                buf.retained = reason
+
+    def _cancel(self, trace_id: str) -> None:
+        with self._lock:
+            self._traces.pop(trace_id, None)
+
+    def _record_of(self, span: Span) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "trace": span.trace_id,
+            "span": span.span_id,
+            "name": span.name,
+            "t0": round(span.t_start, 6),
+            "dur": round(max(0.0, (span.t_end or span.t_start)
+                             - span.t_start), 6),
+        }
+        if span.parent_id:
+            rec["parent"] = span.parent_id
+        if span.status:
+            rec["status"] = span.status
+        if span.attrs:
+            rec["attrs"] = span.attrs
+        if span.events:
+            rec["events"] = span.events
+        return rec
+
+    def _end_span(self, span: Span) -> bool:
+        rec = self._record_of(span)
+        with self._lock:
+            buf = self._traces.get(span.trace_id)
+            if buf is None:
+                return False
+            if span is not buf.root:
+                if len(buf.spans) < self.MAX_SPANS_PER_TRACE:
+                    buf.spans.append(rec)
+                else:
+                    buf.dropped += 1
+                return False
+            # the root closed: one tail-based keep/drop decision for the
+            # whole buffered trace
+            del self._traces[span.trace_id]
+            keep, reason = self._decide(span.trace_id, span.name,
+                                        rec["dur"], buf.retained)
+        if not keep:
+            self.dropped += 1
+            return False
+        self.kept += 1
+        rec["retained"] = reason
+        if buf.dropped:
+            rec["spans_dropped"] = buf.dropped  # no silent truncation
+        recs = buf.spans + [rec]
+        if buf.request is not None:
+            for r in recs:
+                r["request"] = buf.request
+        self._write_trace(recs)
+        return True
+
+    def _decide(self, trace_id: str, name: str, dur: float,
+                retained: Optional[str]) -> Tuple[bool, Optional[str]]:
+        # callers hold _lock
+        lat = self._lat.get(name)
+        if lat is None:
+            lat = self._lat[name] = deque(maxlen=self._reservoir)
+        keep, reason = False, None
+        if retained is not None:
+            keep, reason = True, retained
+        elif self.tail_p99 and len(lat) >= self._min_reservoir:
+            xs = sorted(lat)
+            p99 = xs[min(len(xs) - 1,
+                         max(0, int(round(0.99 * len(xs))) - 1))]
+            if dur >= p99:
+                keep, reason = True, RETAINED_P99
+        if not keep and not reason:
+            keep, reason = self._head_sampled(trace_id), RETAINED_HEAD
+        # the reservoir learns from EVERY trace, kept or dropped — the
+        # p99 estimate must track the real latency distribution
+        lat.append(dur)
+        return keep, (reason if keep else None)
+
+    def _head_sampled(self, trace_id: str) -> bool:
+        if self.sample >= 1.0:
+            return True
+        if self.sample <= 0.0:
+            return False
+        # deterministic on the trace id: a rerun of the same decision
+        # (or another holder of the same id) agrees without coordination.
+        # Trace ids are uniform random, so the keep rate converges to
+        # `sample`.
+        h = int(trace_id[:8] or "0", 16)
+        return (h / 0xFFFFFFFF) < self.sample
+
+    def _write_trace(self, recs: List[Dict[str, Any]]) -> None:
+        if self._journal is not None:
+            # one buffered write for the whole trace (journal.record_batch):
+            # per-record writes made the journal syscall the dominant cost
+            # of a fully-sampled serving loop
+            self._journal.record_batch("span", recs)
+        else:
+            self.records.extend(recs)
+
+    def close(self) -> None:
+        """Drop any still-open traces (shutdown: a half-told story is
+        worse than none — incidents flush at root end, not here)."""
+        with self._lock:
+            self._traces.clear()
+
+
+class _NullTracer:
+    """The disabled singleton: every opening call returns the null span,
+    and `enabled` is the one attribute hot paths check."""
+
+    enabled = False
+    sample = 0.0
+    kept = 0
+    dropped = 0
+
+    def start_trace(self, name, *, request=None, **attrs):
+        return _NULL_SPAN
+
+    def span(self, name, *, parent=None, **attrs):
+        return _NULL_SPAN
+
+    def current(self):
+        return None
+
+    def trace_at(self, name, t0, t1, **kw):
+        return ""
+
+    def close(self):
+        pass
+
+
+_NULL_TRACER = _NullTracer()
+
+_tracer: Optional[Tracer] = None
+_tracer_key: Optional[Tuple] = None
+_tracer_lock = threading.Lock()
+
+
+def null_tracer() -> _NullTracer:
+    return _NULL_TRACER
+
+
+def get_tracer():
+    """The process tracer, live exactly when ``--obs_journal`` arms the
+    journal sink (same laziness contract as ``get_journal``); otherwise
+    the inert null tracer.  Rebuilt when the journal or the sampling
+    flags change."""
+    global _tracer, _tracer_key
+    from paddle_tpu.obs.journal import get_journal
+    from paddle_tpu.utils.flags import FLAGS
+
+    if not (getattr(FLAGS, "obs_journal", "") or ""):
+        return _NULL_TRACER
+    j = get_journal()
+    if j is None:
+        return _NULL_TRACER
+    key = (id(j), float(getattr(FLAGS, "trace_sample", 1.0)),
+           bool(getattr(FLAGS, "trace_tail_p99", True)))
+    with _tracer_lock:
+        if _tracer is None or _tracer_key != key:
+            if _tracer is not None:
+                _tracer.close()
+            _tracer = Tracer(journal=j, sample=key[1], tail_p99=key[2])
+            _tracer_key = key
+        return _tracer
+
+
+def reset_tracer() -> None:
+    global _tracer, _tracer_key
+    with _tracer_lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = None
+        _tracer_key = None
+
+
+# ---------------------------------------------------------------------------
+# reconstruction: journal records -> trace trees / Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def collect_traces(records) -> Dict[str, List[Dict[str, Any]]]:
+    """Group a merged journal's ``kind="span"`` records by trace id,
+    each trace's spans sorted by start time."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if r.get("kind") == "span" and r.get("trace"):
+            out.setdefault(r["trace"], []).append(r)
+    for spans in out.values():
+        spans.sort(key=lambda s: (s.get("t0", 0.0), s.get("seq", 0)))
+    return out
+
+
+def _root_of(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    for s in spans:
+        if not s.get("parent"):
+            return s
+    return None
+
+
+def trace_summaries(traces: Dict[str, List[Dict[str, Any]]]
+                    ) -> List[Dict[str, Any]]:
+    """One line per trace, slowest first — the index view of
+    ``obs trace DIR``."""
+    out = []
+    for tid, spans in traces.items():
+        root = _root_of(spans) or spans[0]
+        out.append({
+            "trace": tid,
+            "name": root.get("name", "?"),
+            "request": root.get("request"),
+            "dur_ms": round(1e3 * root.get("dur", 0.0), 3),
+            "status": root.get("status"),
+            "retained": root.get("retained"),
+            "spans": len(spans),
+            "ranks": sorted({s.get("rank", 0) for s in spans}),
+            "t0": root.get("t0", 0.0),
+        })
+    out.sort(key=lambda s: -s["dur_ms"])
+    return out
+
+
+def format_trace_tree(spans: List[Dict[str, Any]]) -> str:
+    """Indented end-to-end rendering of one trace — the span-by-span
+    latency attribution a p99 postmortem reads."""
+    children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for s in spans:
+        children.setdefault(s.get("parent"), []).append(s)
+    for v in children.values():
+        v.sort(key=lambda s: s.get("t0", 0.0))
+
+    lines: List[str] = []
+
+    def fmt(s: Dict[str, Any]) -> str:
+        bits = [f"{1e3 * s.get('dur', 0.0):9.2f}ms",
+                f"r{s.get('rank', 0)}", s.get("name", "?")]
+        if s.get("status"):
+            bits.append(f"[{s['status']}]")
+        attrs = s.get("attrs") or {}
+        if attrs:
+            bits.append(" ".join(f"{k}={v}" for k, v in sorted(
+                attrs.items())))
+        return " ".join(str(b) for b in bits)
+
+    def walk(parent: Optional[str], depth: int) -> None:
+        for s in children.get(parent, []):
+            lines.append("  " * depth + fmt(s))
+            for ev in s.get("events") or []:
+                lines.append("  " * (depth + 1)
+                             + f"* {ev.get('name', '?')} "
+                             + " ".join(f"{k}={v}" for k, v in ev.items()
+                                        if k not in ("name", "t")))
+            walk(s["span"], depth + 1)
+
+    root = _root_of(spans)
+    if root is not None:
+        head = [f"trace {root.get('trace')}"]
+        if root.get("request"):
+            head.append(f"request {root['request']}")
+        if root.get("retained"):
+            head.append(f"retained={root['retained']}")
+        lines.append("# " + "  ".join(head))
+    walk(None, 0)
+    # orphans (parent span record lost to a crash) still render, flagged
+    known = {s["span"] for s in spans}
+    for s in spans:
+        p = s.get("parent")
+        if p and p not in known:
+            lines.append(f"? (orphan of {p}) " + fmt(s))
+    return "\n".join(lines)
+
+
+def perfetto_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome-trace JSON (the Perfetto/`chrome://tracing` format): one
+    complete ``"ph": "X"`` event per span (ts/dur in microseconds), span
+    events as instants, ranks as processes.  ``json.dumps`` of the
+    returned dict is a loadable trace file."""
+    events: List[Dict[str, Any]] = []
+    ranks = set()
+    for s in spans:
+        rank = int(s.get("rank", 0))
+        ranks.add(rank)
+        tid = int(s.get("trace", "0")[:6] or "0", 16) % 100000
+        args = dict(s.get("attrs") or {})
+        if s.get("status"):
+            args["status"] = s["status"]
+        if s.get("request"):
+            args["request"] = s["request"]
+        args["trace_id"] = s.get("trace")
+        events.append({
+            "name": s.get("name", "?"),
+            "cat": "span",
+            "ph": "X",
+            "ts": int(round(1e6 * s.get("t0", 0.0))),
+            "dur": max(1, int(round(1e6 * s.get("dur", 0.0)))),
+            "pid": rank,
+            "tid": tid,
+            "args": args,
+        })
+        for ev in s.get("events") or []:
+            events.append({
+                "name": ev.get("name", "?"),
+                "cat": "event",
+                "ph": "i",
+                "ts": int(round(1e6 * ev.get("t", s.get("t0", 0.0)))),
+                "pid": rank,
+                "tid": tid,
+                "s": "t",
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("name", "t")},
+            })
+    for rank in sorted(ranks):
+        events.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": ("supervisor" if rank < 0
+                                         else f"rank {rank}")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -205,11 +205,21 @@ _JOURNAL_VICTIM = """\
 import json, os, sys, time
 from paddle_tpu.obs.journal import EventJournal, journal_path
 
-journal_dir, rank, whole, marker = sys.argv[1:5]
+journal_dir, rank, whole, marker, kind = sys.argv[1:6]
 j = EventJournal(journal_path(journal_dir, int(rank)), rank=int(rank))
 j.set_context(pass_id=1, world_size=2)
 for i in range(int(whole)):
-    j.record("victim_step", fsync=(i == 0), batch_id=i)
+    if kind == "span":
+        # span-shaped records (obs/trace.py): the crash-safety contract
+        # must hold for trace persistence too — a rank dying mid-flush
+        # leaves whole spans plus at most one torn tail
+        j.record("span", fsync=(i == 0), trace="deadbeefdeadbeef",
+                 span=f"{i:08x}", parent=(None if i == 0 else "00000000"),
+                 name=("victim_root" if i == 0 else "victim_child"),
+                 t0=round(time.time(), 6), dur=0.001,
+                 attrs={"batch": i})
+    else:
+        j.record(kind, fsync=(i == 0), batch_id=i)
 # mid-write: half a record is on disk, the rest never arrives
 frag = json.dumps({"t": time.time(), "rank": int(rank), "seq": int(whole),
                    "kind": "torn_by_sigkill", "payload": "x" * 256})
@@ -225,13 +235,16 @@ time.sleep(600)
 
 def kill_mid_journal_write(journal_dir: str, *, rank: int = 1,
                            whole_records: int = 5,
+                           record_kind: str = "victim_step",
                            timeout_s: float = 30.0) -> int:
     """SIGKILL a REAL journal writer mid-record: a child process appends
     ``whole_records`` complete records to ``journal_dir``'s rank file,
     then writes HALF of one more (flushed, no newline) and is SIGKILLed —
     exactly the torn final line a host loss leaves behind.  Returns the
     number of whole records written; the caller asserts ``read_journal``
-    / ``merge_journals`` survive the tear (tests/test_obs.py)."""
+    / ``merge_journals`` survive the tear (tests/test_obs.py).
+    ``record_kind="span"`` writes span-shaped records instead, proving
+    the same contract for trace persistence (tests/test_trace.py)."""
     import subprocess
     import sys
 
@@ -244,7 +257,7 @@ def kill_mid_journal_write(journal_dir: str, *, rank: int = 1,
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.Popen(
         [sys.executable, "-c", _JOURNAL_VICTIM, journal_dir, str(rank),
-         str(whole_records), marker],
+         str(whole_records), marker, record_kind],
         stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env)
     deadline = _time.monotonic() + timeout_s
     while not os.path.exists(marker):

@@ -552,12 +552,19 @@ class GangSupervisor:
         # half of the merged postmortem timeline (events-rsup.jsonl,
         # merged with worker journals by `python -m paddle_tpu obs merge`)
         self._journal = None
+        self._tracer = None
         if getattr(FLAGS, "obs_journal", ""):
             from paddle_tpu.obs import EventJournal, journal_path
+            from paddle_tpu.obs.trace import Tracer
 
             self._journal = EventJournal(
                 journal_path(FLAGS.obs_journal, -1), rank=-1,
                 world_size=len(self.hosts))
+            # the supervisor's incident tracer rides ITS journal (rank
+            # -1): gang incidents — a resize start->complete/fallback, a
+            # whole-gang relaunch — become retained single-span traces
+            # next to the workers' step spans in the merged timeline
+            self._tracer = Tracer(journal=self._journal, sample=1.0)
         self.shrinks = 0
         self.grows = 0
         self.resize_fallbacks = 0
@@ -687,6 +694,11 @@ class GangSupervisor:
                                    "; ".join(f.describe() for f in failed))
                     self._jrec("resize_fallback", fsync=True, during=kind,
                                epoch=self.world_epoch)
+                    if self._tracer is not None:
+                        self._tracer.trace_at(
+                            f"gang_{kind}", self._pending.get("t0", wall),
+                            time.time(), retain="resize_fallback",
+                            epoch=self.world_epoch, fallback=True)
                     return failed
                 survivors = self.active - {f.rank for f in failed}
                 if self.elastic and len(survivors) >= self.min_ranks:
@@ -715,10 +727,19 @@ class GangSupervisor:
             if self._pending is not None:
                 if self._acks_done(self._pending):
                     kind = self._pending["kind"]
+                    t0_resize = self._pending.get("t0", wall)
                     self._pending = None
                     self._jrec("resize_complete", resize=kind,
                                epoch=self.world_epoch,
                                world=len(self.active))
+                    if self._tracer is not None:
+                        # the whole resize — expel -> publish -> drain ->
+                        # commit -> acks — as one retained incident span
+                        # in the merged trace timeline
+                        self._tracer.trace_at(
+                            f"gang_{kind}", t0_resize, time.time(),
+                            retain="gang_resize", epoch=self.world_epoch,
+                            world=len(self.active))
                     if kind == "shrink":
                         self.shrinks += 1
                         logger.info("gang shrink complete (epoch %d, %d "
@@ -763,6 +784,12 @@ class GangSupervisor:
                     self._jrec("resize_fallback", fsync=True, during=kind,
                                epoch=self._pending["epoch"],
                                reason="ack timeout")
+                    if self._tracer is not None:
+                        self._tracer.trace_at(
+                            f"gang_{kind}", self._pending.get("t0", wall),
+                            time.time(), retain="resize_fallback",
+                            epoch=self._pending["epoch"], fallback=True,
+                            reason="ack timeout")
                     missing = [r for r in self._pending["acks"]
                                if not self._acked(self._pending["epoch"], r)]
                     return [RankReport(
@@ -825,7 +852,8 @@ class GangSupervisor:
         budget = self.resize_timeout_s or max(2 * self.watchdog_s, 30.0)
         self._pending = {"kind": "shrink", "epoch": self.world_epoch,
                          "acks": set(self.active), "budget": budget,
-                         "deadline": time.monotonic() + budget}
+                         "deadline": time.monotonic() + budget,
+                         "t0": time.time()}
         logger.warning("gang elastic shrink to %d rank(s) (epoch %d): %s",
                        len(self.active), self.world_epoch, reason)
 
@@ -852,6 +880,7 @@ class GangSupervisor:
         self._pending = {"kind": "grow", "epoch": self.world_epoch,
                          "acks": set(self.active), "budget": budget,
                          "deadline": now + budget,
+                         "t0": time.time(),
                          "joiners": set(missing),
                          "survivors": set(self.active) - set(missing)}
         logger.info("gang grow-back launched (epoch %d): ranks %s "
@@ -928,7 +957,18 @@ class GangSupervisor:
             self._jrec("gang_relaunch", fsync=True, attempt=attempt + 1,
                        backoff_s=round(delay, 3),
                        reasons=[f.describe() for f in failed])
-            self._sleep(delay)
+            if self._tracer is not None:
+                # the relaunch gap — gang killed until the next attempt
+                # starts — as a retained incident span: training latency
+                # spanning it is attributable to the whole-gang restart
+                t_relaunch = time.time()
+                self._sleep(delay)
+                self._tracer.trace_at(
+                    "gang_relaunch", t_relaunch, time.time(),
+                    retain="gang_relaunch", attempt=attempt + 1,
+                    reasons=[f.describe() for f in failed])
+            else:
+                self._sleep(delay)
             attempt += 1
 
     def _scrub_attempt_dirs(self) -> None:

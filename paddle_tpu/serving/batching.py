@@ -83,6 +83,10 @@ class Request:
     tier: int = 0                 # degradation tier chosen at execution
     max_len: Optional[int] = None  # generation mode: per-request decode
     #                                budget (None = the backend's max_len)
+    # request tracing (obs/trace.py; all None/"" when tracing is off):
+    req_id: str = ""              # user-facing id (`obs merge --request=`)
+    span: Any = None              # the request trace's root Span
+    qspan: Any = None             # open "queue" child span, ended at pop
 
 
 # ---------------------------------------------------------------------------

@@ -9,10 +9,12 @@ Usage:
 Loads a deploy bundle (quantized bundles dequantize on load —
 docs/deploy.md), builds an :class:`InferenceServer` from the
 ``--serve_*`` flags, runs the warmup/readiness gate (plus the
-``--serve_preflight`` lint audit) — with ``--compile_cache_dir`` or
-bundle-embedded ``aot/`` members, warmup LOADS persisted executables
-instead of compiling, so a warm replica boots ready in seconds — then
-either serves until
+``--serve_preflight`` lint audit) — ``--compile_cache_dir`` defaults to
+``auto``, a per-bundle cache next to the artifact (``<bundle>.ccache``),
+so every boot after the first LOADS persisted executables instead of
+compiling and a warm replica is ready in seconds (opt out with an
+explicit ``--compile_cache_dir=``; bundle-embedded ``aot/`` members
+layer underneath either way) — then either serves until
 SIGTERM/SIGINT (printing a ``healthz()`` line periodically) or — with
 ``--serve_smoke=N`` — pushes N synthetic requests through the full
 queue/batcher/worker path and exits 0 only if every one got a reply
@@ -36,6 +38,41 @@ import threading
 from typing import List, Optional
 
 __all__ = ["run"]
+
+
+def _resolve_cache_dir(bundle: Optional[str]) -> str:
+    """The serve CLI's ``--compile_cache_dir`` resolution (ROADMAP item 5
+    follow-up): the default ``auto`` derives a per-bundle cache NEXT TO
+    the artifact (``<bundle>.ccache``) so a replica's second boot is warm
+    by default; an explicit empty value (``--compile_cache_dir=``) opts
+    out, and any other value is the shared fleet cache as before.  The
+    bundle-less continuous smoke has no artifact to key a default cache
+    on, so ``auto`` resolves to off there.
+
+    The derived default DEGRADES to off when the bundle's directory is
+    not writable (a read-only artifact mount): a cache the operator
+    never asked for must not turn a boot that worked yesterday into a
+    startup crash.  An EXPLICIT cache dir keeps failing loudly — the
+    operator asked for it."""
+    import os
+
+    from paddle_tpu.utils import FLAGS, logger
+
+    d = FLAGS.compile_cache_dir
+    if d != "auto":
+        return d
+    if not bundle:
+        return ""
+    derived = bundle + ".ccache"
+    try:
+        os.makedirs(derived, exist_ok=True)
+    except OSError as e:
+        logger.warning(
+            "serve: per-bundle compile cache %r unavailable (%s) — "
+            "booting without a cache (pass --compile_cache_dir=DIR for "
+            "a writable location)", derived, e)
+        return ""
+    return derived
 
 
 def _continuous_smoke() -> int:
@@ -70,7 +107,7 @@ def _continuous_smoke() -> int:
 
     server.start(preflight=FLAGS.serve_preflight,
                  compile_cache=open_cache(
-                     cache_dir=FLAGS.compile_cache_dir))
+                     cache_dir=_resolve_cache_dir(None)))
     print(json.dumps({"ready": server.ready, **server.healthz()},
                      default=str))
     rng = np.random.RandomState(0)
@@ -152,7 +189,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     from paddle_tpu.config.compile_cache import open_cache
 
     cache = open_cache(bundle=FLAGS.serve_bundle,
-                       cache_dir=FLAGS.compile_cache_dir)
+                       cache_dir=_resolve_cache_dir(FLAGS.serve_bundle))
     server.start(preflight=FLAGS.serve_preflight, compile_cache=cache)
     print(json.dumps({"ready": server.ready, **server.healthz()},
                      default=str))

@@ -40,6 +40,8 @@ Two execution modes share that contract:
 from __future__ import annotations
 
 import inspect
+import itertools
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -55,6 +57,7 @@ from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
                                        WorkerCrashed)
 from paddle_tpu.serving.metrics import ServerMetrics
 from paddle_tpu.serving.worker import WorkerSupervisor
+from paddle_tpu.obs.trace import get_tracer
 from paddle_tpu.resilience.cluster import current_gang as _current_gang
 from paddle_tpu.resilience.errors import GangError
 from paddle_tpu.utils.log import logger
@@ -64,6 +67,28 @@ __all__ = ["InferenceServer"]
 
 class _WorkerKilled(Exception):
     """Chaos-injected worker death (resilience.chaos.kill_worker)."""
+
+
+#: request-trace ids (obs/trace.py): process-unique, allocated only when
+#: tracing is armed — `obs merge --request=ID` / `future.req_id`
+_REQ_SEQ = itertools.count(1)
+
+#: failure statuses whose traces tail sampling must ALWAYS keep — the
+#: incidents a p99 postmortem is about.  invalid_request is a client bug
+#: (head-sampled like successes); everything else is the server's story.
+_RETAIN_STATUSES = frozenset({
+    "shed", "deadline_infeasible", "deadline_expired", "breaker_rejected",
+    "inference_failed", "worker_crashed", "server_closed",
+})
+
+#: admission rejection -> (counter/status name, retained?)
+_REJECT_STATUS = {
+    "ShedError": "shed",
+    "DeadlineExceeded": "deadline_infeasible",
+    "CircuitOpenError": "breaker_rejected",
+    "InvalidRequestError": "invalid_request",
+    "ServerClosed": "server_closed",
+}
 
 
 def _has_nonfinite(outputs: Dict[str, Any]) -> bool:
@@ -426,7 +451,43 @@ class InferenceServer:
         :class:`ServingFuture` that is *guaranteed* to resolve.
 
         ``max_len`` (generation mode) is the request's own decode budget;
-        it must fit the slot table's depth (the backend's ``max_len``)."""
+        it must fit the slot table's depth (the backend's ``max_len``).
+
+        With request tracing armed (``--obs_journal``; obs/trace.py) the
+        call opens a request trace whose child spans decompose the whole
+        lifecycle — admission, queue wait, merge/prefill, every fused
+        decode step the request participated in, harvest, reply — and the
+        returned future carries ``req_id`` for ``obs merge --request=``.
+        Typed rejections end the trace with their status; shed and
+        deadline rejections are retained by tail sampling."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return self._submit(feed, deadline_ms, max_len, None, "", 0.0)
+        rid = f"req-{os.getpid()}-{next(_REQ_SEQ):06d}"
+        t0 = time.time()
+        root = tracer.start_trace("request", request=rid, mode=self.mode)
+        try:
+            fut = self._submit(feed, deadline_ms, max_len, root, rid, t0)
+        except ServingError as e:
+            status = _REJECT_STATUS.get(type(e).__name__,
+                                        type(e).__name__)
+            if "rows" not in root.attrs:
+                # rejected before the accepted-path recording; a shed AT
+                # offer() already carries its outcome=accepted admission
+                # span — the root status says what happened next
+                root.child_at("admission", t0, time.time(),
+                              outcome=status)
+            if status in _RETAIN_STATUSES:
+                root.retain(status)
+            root.end(status=status, error=str(e))
+            raise
+        fut.req_id = rid
+        return fut
+
+    def _submit(self, feed: Dict[str, Any],
+                deadline_ms: Optional[float],
+                max_len: Optional[int],
+                root, rid: str, t_trace: float) -> ServingFuture:
         self.metrics.inc("submitted")
         if self._state != self.RUNNING:
             self.metrics.inc("server_closed")
@@ -487,9 +548,18 @@ class InferenceServer:
                     f"empty-request shape inference failed: "
                     f"{type(e).__name__}: {e}"))
                 self.metrics.inc("inference_failed")
+                if root is not None:
+                    root.retain("inference_failed")
+                    root.end(status="inference_failed")
                 return fut
             self.metrics.inc("accepted")
             self.metrics.inc("completed")
+            if root is not None:
+                # replied inline (shape-inferred empty outputs): the
+                # whole lifecycle is the admission segment
+                root.child_at("admission", t_trace, time.time(),
+                              outcome="empty_inline")
+                root.end(status="completed", rows=0)
             return fut
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -513,11 +583,27 @@ class InferenceServer:
         req = Request(feed=canon, rows=rows, signature=sig,
                       future=ServingFuture(), deadline=deadline,
                       t_submit=now, deadline_ms=deadline_ms,
-                      max_len=max_len)
+                      max_len=max_len, req_id=rid, span=root)
+        if root is not None:
+            # every root mutation happens BEFORE offer(): the worker may
+            # pop, serve, and FLUSH the trace the instant the request is
+            # queued — attrs or spans attached after that land on a
+            # flushed buffer and silently vanish
+            root.set(rows=rows, deadline_ms=deadline_ms,
+                     max_len=max_len)
+            root.child_at("admission", t_trace, time.time(),
+                          outcome="accepted",
+                          queue_depth=self.queue.depth())
+            # the queue span stays OPEN across the submit->worker thread
+            # boundary; the worker ends it at pop (or expiry sweep), so
+            # its duration IS the measured queue wait
+            req.qspan = root.child("queue")
         try:
             self.queue.offer(req)
         except ShedError:
             self.metrics.inc("shed")
+            if req.qspan is not None:   # never queued: close the segment
+                req.qspan.end(status="shed", t_end=req.qspan.t_start)
             raise
         self.metrics.inc("accepted")
         return req.future
@@ -551,6 +637,15 @@ class InferenceServer:
         for r in reqs:
             if r.future._complete(error=exc_factory()):
                 n += 1
+                if r.span is not None:
+                    # every typed-failure path funnels here: end the
+                    # request trace with the counter as its status, and
+                    # retain the incidents tail sampling must keep
+                    if r.qspan is not None:
+                        r.qspan.end(status=counter)
+                    if counter in _RETAIN_STATUSES:
+                        r.span.retain(counter)
+                    r.span.end(status=counter)
         if n:
             self.metrics.inc(counter, n)
 
@@ -579,6 +674,11 @@ class InferenceServer:
                 r.tier = tier
             self.metrics.inc("degraded", len(batch))
         rows = sum(r.rows for r in batch)
+        now_w = time.time()
+        for r in batch:
+            if r.qspan is not None:     # the measured queue wait ends here
+                r.qspan.end(status="popped", t_end=now_w,
+                            batch_mates=len(batch) - 1)
         # the batch is in flight from the moment it leaves the queue: a
         # failure ANYWHERE past this point (merge included) must reach
         # the crash handler with these futures still attributed
@@ -642,6 +742,9 @@ class InferenceServer:
                     "decode produced non-finite scores (poisoned "
                     "request?)")):
                 self.metrics.inc("inference_failed")
+                if req.span is not None:
+                    req.span.retain("inference_failed")
+                    req.span.end(status="inference_failed")
             return
         if self.supervisor.current(gen):
             self.breaker.record_success()
@@ -650,10 +753,18 @@ class InferenceServer:
                     f"completed {1e3 * (now - req.deadline):.1f}ms past "
                     f"the {req.deadline_ms:.1f}ms deadline")):
                 self.metrics.inc("deadline_expired")
+                if req.span is not None:
+                    req.span.retain("deadline_expired")
+                    req.span.end(status="deadline_expired", steps=steps)
         elif req.future._complete(result=outputs):
             self.metrics.inc("completed")
             dt = now - req.t_submit
-            self.metrics.observe_latency(dt)
+            # root end decides keep/drop FIRST: only a kept trace may be
+            # the bucket's exemplar (see _execute)
+            kept = (req.span.end(status="completed", steps=steps)
+                    if req.span is not None else False)
+            self.metrics.observe_latency(
+                dt, trace_id=(req.span.trace_id if kept else None))
             self.metrics.observe_request_steps(steps)
             if self.supervisor.current(gen):
                 self._service_ema = (dt if self._service_ema is None
@@ -670,6 +781,14 @@ class InferenceServer:
         # time, and its slot is capacity short requests are waiting on
         evicted = sched.evict_expired(self._clock(), commit=live)
         if evicted:
+            for r, n in evicted:
+                if r.span is not None:
+                    # eviction is mid-generation deadline death: mark the
+                    # trace before _fail_requests ends+retains it, so a
+                    # postmortem can split "expired queued" from "evicted
+                    # while decoding"
+                    r.span.event("evicted", slots_freed=n)
+                    r.span.set(evicted=True)
             self._fail_requests(
                 [r for r, _ in evicted],
                 lambda: DeadlineExceeded("deadline expired mid-generation "
@@ -682,14 +801,18 @@ class InferenceServer:
         # dispatch materializes here, not in step()) — it must sit inside
         # the busy window or a wedged device never trips hang detection
         self.supervisor.note_busy(gen)
+        hw0 = time.time()
         try:
             harvested = sched.harvest(commit=live)
         finally:
             self.supervisor.note_idle(gen)
+        hw1 = time.time()
         for req, outputs, steps in harvested:
             if not live():
                 return  # abandoned worker: its results are unwanted
             self.metrics.inc("slot_recycled", req.rows)
+            if req.span is not None:
+                req.span.child_at("harvest", hw0, hw1, steps=steps)
             self._complete_harvested(gen, req, outputs, steps)
         # admit into freed slots (the PR 5 queue/deadline/shed machinery,
         # at slot granularity): with residents decoding, the pop must not
@@ -723,6 +846,11 @@ class InferenceServer:
                 for r in batch:
                     r.tier = tier
                 self.metrics.inc("degraded", len(batch))
+            aw0 = time.time()
+            for r in batch:
+                if r.qspan is not None:    # queue wait ends at admission
+                    r.qspan.end(status="popped", t_end=aw0,
+                                batch_mates=len(batch) - 1)
             # the popped batch joins the in-flight set BEFORE the
             # device-bound prefill: a crash or hang inside admit must
             # fail these futures too, never silently drop them
@@ -732,6 +860,17 @@ class InferenceServer:
                 sched.admit(batch,
                             limit_cap=tier_opts.get("max_len"),
                             commit=live)
+                if any(r.span is not None for r in batch):
+                    aw1 = time.time()
+                    slots_of = {id(req): s for req, s, _
+                                in sched.resident_view()}
+                    for r in batch:
+                        if r.span is not None:
+                            r.span.child_at(
+                                "prefill", aw0, aw1,
+                                slots=slots_of.get(id(r), []),
+                                tier=r.tier,
+                                limit_cap=tier_opts.get("max_len"))
             except _WorkerKilled:
                 raise
             except ValueError as e:
@@ -766,6 +905,7 @@ class InferenceServer:
             self._kill_worker = False
             raise _WorkerKilled("chaos: worker killed mid-step")
         self.supervisor.note_busy(gen)
+        sw0 = time.time()
         try:
             ran = sched.step(commit=live)
         except _WorkerKilled:
@@ -793,7 +933,21 @@ class InferenceServer:
         self.supervisor.note_idle(gen)
         if ran:
             self.metrics.inc("gen_steps")
-            self.metrics.observe_slots(sched.occupied(), sched.slots)
+            occupied = sched.occupied()
+            self.metrics.observe_slots(occupied, sched.slots)
+            if any(r.span is not None for r in self._in_flight):
+                # every resident request's trace gets this fused step as a
+                # child span — slot ids, its own step index, and the
+                # co-residency it shared the table at.  This is the
+                # attribution that turns "slow request" into "60 steps
+                # sharing the table at 0.9 occupancy behind a straggler".
+                sw1 = time.time()
+                occ = round(occupied / sched.slots, 3)
+                for req, slots_, nsteps in sched.resident_view():
+                    if req.span is not None:
+                        req.span.child_at("decode_step", sw0, sw1,
+                                          slots=slots_, step=nsteps,
+                                          occupancy=occ)
 
     def _execute(self, gen: int, batch: List[Request], merged, slices,
                  rows: int, tier_opts: dict) -> None:
@@ -801,6 +955,7 @@ class InferenceServer:
             self._kill_worker = False
             raise _WorkerKilled("chaos: worker killed mid-batch")
         t0 = self._clock()
+        tw0 = time.time()
         try:
             outputs = self._runner(merged, tier_opts)
         except _WorkerKilled:
@@ -817,6 +972,14 @@ class InferenceServer:
             self._fail_requests(batch, _mk, "inference_failed")
             return
         dt = self._clock() - t0
+        tw1 = time.time()
+        for r in batch:
+            if r.span is not None:
+                # one compiled forward served the whole merged batch: each
+                # co-batched request gets the segment with its sharing
+                # context (who it paid the batch with)
+                r.span.child_at("execute", tw0, tw1, rows=r.rows,
+                                batch_rows=rows, tier=r.tier)
         if self.supervisor.current(gen):
             self._service_ema = (dt if self._service_ema is None
                                  else 0.8 * self._service_ema + 0.2 * dt)
@@ -841,9 +1004,20 @@ class InferenceServer:
                         f"completed {1e3 * (now - r.deadline):.1f}ms past "
                         f"the {r.deadline_ms:.1f}ms deadline")):
                     self.metrics.inc("deadline_expired")
+                    if r.span is not None:
+                        r.span.retain("deadline_expired")
+                        r.span.end(status="deadline_expired")
             elif r.future._complete(result=out):
                 self.metrics.inc("completed")
-                self.metrics.observe_latency(now - r.t_submit)
+                # the root ends BEFORE the latency observation: only a
+                # trace tail sampling actually KEPT may ride the
+                # histogram bucket as an exemplar — a dashboard must
+                # never link to a trace the journal doesn't have
+                kept = (r.span.end(status="completed")
+                        if r.span is not None else False)
+                self.metrics.observe_latency(
+                    now - r.t_submit,
+                    trace_id=(r.span.trace_id if kept else None))
 
     # ------------------------------------------------------------------
     # supervision callbacks + chaos hooks
@@ -860,9 +1034,9 @@ class InferenceServer:
         self._state = self.FAILED
         self._fail_reason = (f"worker restart budget exhausted "
                              f"({self.supervisor.max_restarts}): {exc}")
-        for r in self.queue.close():
-            r.future._complete(error=WorkerCrashed(self._fail_reason))
-            self.metrics.inc("worker_crashed")
+        self._fail_requests(
+            self.queue.close(),
+            lambda: WorkerCrashed(self._fail_reason), "worker_crashed")
 
     def chaos_kill_worker(self) -> None:
         """Chaos hook (``resilience.chaos.kill_worker``): the worker dies

@@ -202,6 +202,8 @@ class _SlotEntry:
     row: int          # which row of its (possibly multi-row) request
     limit: int        # per-request max_len, <= the table depth
     t_admit: float
+    admit_step: int = 0   # steps_run at admission: per-request step
+    #                       participation stays host-side (no device sync)
 
 
 @dataclass
@@ -448,6 +450,22 @@ class SlotScheduler:
         with self._lock:
             return [p.request for p in self._pending.values()]
 
+    def resident_view(self) -> List[Tuple[Request, List[int], int]]:
+        """Per-resident ``(request, slots, steps_since_admit)`` — the
+        attribution surface request tracing stamps onto each fused-step
+        span (slot ids, per-request step participation).  Purely host-side
+        bookkeeping: reading the device carry here would add one d2h sync
+        per step."""
+        with self._lock:
+            by_req: Dict[int, List[Any]] = {}
+            for slot, e in enumerate(self._entries):
+                if e is None:
+                    continue
+                ent = by_req.setdefault(id(e.request), [e.request, [], 0])
+                ent[1].append(slot)
+                ent[2] = max(ent[2], self.steps_run - e.admit_step)
+            return [(r, s, n) for r, s, n in by_req.values()]
+
     def reset(self) -> List[Request]:
         """Fresh table (worker relaunch): drops every resident request's
         state and returns those requests so the caller can fail them typed
@@ -503,7 +521,7 @@ class SlotScheduler:
                     self.carry = self._write(self.carry, slot, state0,
                                              row)
                     self._entries[slot] = _SlotEntry(req, row - a, limit,
-                                                     now)
+                                                     now, self.steps_run)
                     n += 1
             self.admitted += n
         return n

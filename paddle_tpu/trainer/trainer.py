@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -242,6 +243,11 @@ class SGDTrainer:
         self._journal = None
         self._profiler = None
         self._prefetcher = None
+        # request-level tracing (obs/trace.py): each batch becomes a
+        # step-span trace with the timeline phases as children; bound per
+        # train() call like the journal
+        self._tracer = None
+        self._step_span = None
         self._step = self._build_step()
         self._eval_fns: Dict[str, Callable] = {}
 
@@ -468,13 +474,40 @@ class SGDTrainer:
 
     def _ph(self, name: str, sync: Any = None):
         """Timeline phase context (nullcontext when the timeline is off —
-        the uninstrumented loop pays one attribute check per phase)."""
+        the uninstrumented loop pays one attribute check per phase).
+        With a step-span open (request tracing armed), the phase is ALSO
+        recorded as a child span of the current batch's trace."""
         from contextlib import nullcontext
 
         tl = self.timeline
-        if tl is None:
-            return nullcontext()
-        return tl.phase(name, sync=sync)
+        sp = self._step_span
+        if sp is None:
+            return tl.phase(name, sync=sync) if tl is not None \
+                else nullcontext()
+        return self._ph_traced(name, tl, sp, sync)
+
+    @contextmanager
+    def _ph_traced(self, name: str, tl, sp, sync: Any):
+        span = sp.child(name)
+        try:
+            if tl is not None:
+                with tl.phase(name, sync=sync):
+                    yield
+            else:
+                try:
+                    yield
+                finally:
+                    # the timeline normally owns the device sync; with it
+                    # off the span must still charge dispatched work to
+                    # the phase that launched it
+                    if sync is not None:
+                        obj = sync() if callable(sync) else sync
+                        try:
+                            jax.block_until_ready(obj)
+                        except Exception:
+                            pass
+        finally:
+            span.end()
 
     @property
     def _h2d_measurable(self) -> bool:
@@ -628,6 +661,12 @@ class SGDTrainer:
                 self.bad_steps_total += 1
                 self._bad_streak += 1
                 self._obs_counters["bad_steps"].inc()
+                if self._step_span is not None:
+                    # bad steps are incidents: their step traces are
+                    # ALWAYS retained by tail sampling
+                    self._step_span.retain("bad_step")
+                    self._step_span.set(bad_step=True,
+                                        bad_streak=self._bad_streak)
                 if self._journal is not None:
                     # a skipped step is an incident, not a log line: it
                     # lands in the causal timeline with pass/batch context
@@ -693,6 +732,7 @@ class SGDTrainer:
         end analog (hl_cuda.h:338-343), viewable in TensorBoard/XProf."""
         from paddle_tpu.obs import (ProfilerCapture, StepTimeline,
                                     ensure_metrics_server, get_journal)
+        from paddle_tpu.obs.trace import get_tracer
         from paddle_tpu.utils.stat import print_stats, timer
 
         handler = event_handler or (lambda e: None)
@@ -715,6 +755,11 @@ class SGDTrainer:
                 jr.set_context(epoch=gang.epoch)
             jr.record("train_start", num_passes=num_passes,
                       resume=resume or FLAGS.resume or "")
+        # step-span tracing (docs/observability.md "Request tracing"):
+        # armed with the journal; each batch becomes a trace whose
+        # children are the timeline phases, with gang events attached
+        tracer = self._tracer = get_tracer()
+        self._step_span = None
         profiler = self._profiler = (
             ProfilerCapture(FLAGS.profile_dir, FLAGS.profile_steps)
             if FLAGS.profile_dir and FLAGS.profile_steps else None)
@@ -805,6 +850,13 @@ class SGDTrainer:
                     _wrap_prefetch()
                 batch_id = 0
                 while True:
+                    if tracer.enabled and not skip \
+                            and self._step_span is None:
+                        # the step-span opens BEFORE the gang poll so a
+                        # resize adopted at this boundary lands inside the
+                        # very trace whose latency it explains
+                        self._step_span = tracer.start_trace(
+                            "train_step", batch=batch_id)
                     if gang is not None:
                         # liveness signal from the MAIN thread: a rank
                         # stuck in a collective stops heartbeating here
@@ -823,6 +875,11 @@ class SGDTrainer:
                             self._gang_resize(gang, world, pass_id,
                                               batch_id + skip, handler)
                     if preemption is not None and preemption.poll():
+                        if self._step_span is not None:
+                            # a preempted step is an incident: keep it
+                            self._step_span.retain("preempt")
+                            self._step_span.end(status="preempt")
+                            self._step_span = None
                         # the prefetcher's read-ahead is abandoned HERE, at
                         # the drain point: the checkpoint records the
                         # batches the STEP consumed, so resume re-reads
@@ -844,6 +901,11 @@ class SGDTrainer:
                         except Exception as e:
                             raise _reader_failed(e) from e
                     if data_batch is None:
+                        if self._step_span is not None:
+                            # no batch behind this span: not a step, not
+                            # a story — never reaches the journal
+                            self._step_span.cancel()
+                            self._step_span = None
                         break
                     if skip:
                         # fast-forward a deterministic reader to the batch
@@ -883,6 +945,10 @@ class SGDTrainer:
                                 self._ph("step", sync=lambda: loss):
                             loss = self.train_batch(feed)
                     except TooManyBadSteps:
+                        if self._step_span is not None:
+                            self._step_span.retain("train_abort")
+                            self._step_span.end(status="train_abort")
+                            self._step_span = None
                         handler(ev.EndPass(pass_id))
                         if jr is not None:
                             jr.record("train_abort",
@@ -914,6 +980,13 @@ class SGDTrainer:
                         }
                     with self._ph("callback"):
                         handler(ev.EndIteration(pass_id, batch_id, cost))
+                    if self._step_span is not None:
+                        # the root closes here: tail sampling decides —
+                        # bad-step/resize/preempt marks always keep, the
+                        # p99 reservoir keeps outlier-slow steps, the
+                        # rest head-sample at --trace_sample
+                        sp, self._step_span = self._step_span, None
+                        sp.end(status="ok", cost=round(cost, 6))
                     if log_period and (batch_id + 1) % log_period == 0:
                         logger.info(
                             "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
@@ -985,6 +1058,11 @@ class SGDTrainer:
                     gang.heartbeat()
                     time.sleep(0.05)
         finally:
+            if self._step_span is not None:
+                # an exception mid-batch: the half-told step never
+                # reaches the journal (incidents retain+end explicitly)
+                self._step_span.cancel()
+                self._step_span = None
             self._close_prefetcher()  # exception paths: join the producer
             if profiling:
                 jax.profiler.stop_trace()
@@ -1088,6 +1166,14 @@ class SGDTrainer:
                 new_world=len(new_ranks), grew=grew,
                 reason=world.get("reason", ""),
                 next_batch=-1 if next_batch is None else next_batch)
+        if self._step_span is not None:
+            # the resize rides the step-span it interrupted as an EVENT,
+            # and that trace is retained: a latency spike at this batch
+            # is attributable to the resize that caused it
+            self._step_span.event("gang_resize", epoch=epoch,
+                                  new_world=len(new_ranks), grew=grew,
+                                  reason=world.get("reason", ""))
+            self._step_span.retain("gang_resize")
         logger.warning(
             "elastic resize: %s to %d rank(s) (epoch %d) at pass %d%s — %s",
             "grew" if grew else "shrank", len(new_ranks), epoch, pass_id,

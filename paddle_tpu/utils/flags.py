@@ -379,12 +379,15 @@ define_flag("deploy_quantize", "", "bundle export weight quantization: "
             "export is gated by a max-abs-error check against the f32 "
             "oracle (merge_model quantize_tol)",
             validator=lambda v: v in ("", "bf16", "int8"))
-define_flag("compile_cache_dir", "", "persistent compiled-executable "
+define_flag("compile_cache_dir", "auto", "persistent compiled-executable "
             "cache directory shared across serving replicas: warmup "
             "bucket executables serialize here on first boot and LOAD "
             "(not compile) on every later boot — seconds-not-minutes "
             "fleet cold-start; bundles can also carry executables as "
-            "aot/ members (config.warm_bundle); '' = off")
+            "aot/ members (config.warm_bundle).  'auto' (the default) "
+            "lets the serve CLI derive a per-bundle cache next to the "
+            "artifact (<bundle>.ccache — warm boots by default); pass "
+            "an explicit empty value (--compile_cache_dir=) to opt out")
 
 # Profiling / timers (replaces WITH_TIMER + log_barrier_* ...)
 define_flag("enable_timers", False, "collect Stat timer registry stats")
@@ -421,3 +424,14 @@ define_flag("obs_peak_flops", 0.0, "override the TOTAL peak FLOP/s the "
             "the device kind; off-TPU there is no peak, so the gauge "
             "stays dark unless this is set)",
             validator=lambda v: v >= 0.0)
+# Request-level distributed tracing (obs/trace.py; armed by --obs_journal)
+define_flag("trace_sample", 1.0, "head-sample rate for request/step "
+            "traces that no tail rule kept: 1 = keep every trace, 0 = "
+            "keep only retained incidents (deadline-exceeded / shed / "
+            "evicted / bad-step are ALWAYS kept — tail-based sampling; "
+            "docs/observability.md 'Request tracing')",
+            validator=lambda v: 0.0 <= v <= 1.0)
+define_flag("trace_tail_p99", True, "tail sampling keeps any trace whose "
+            "root latency reaches the rolling p99 of its kind (a "
+            "per-root-name reservoir) even when --trace_sample would "
+            "drop it — the outliers a latency histogram cannot explain")

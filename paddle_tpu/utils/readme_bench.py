@@ -47,6 +47,7 @@ _UNITS = [
     ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
     ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
     ("cold_start_ab", "s (warm boot; vs = ×cold)"),
+    ("trace_overhead_ab", "tok/s (tracing armed; vs = ×off)"),
 ]
 
 

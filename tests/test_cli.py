@@ -164,6 +164,64 @@ def test_cli_serve_smoke_int8_bundle_warm_cache(tmp_path, capsys):
     assert last["counters"]["completed"] == 2
 
 
+def test_cli_serve_default_compile_cache_warms_second_boot(tmp_path, capsys):
+    """ROADMAP item 5 follow-up: with --compile_cache_dir UNSET (the
+    'auto' default) the serve CLI derives a per-bundle cache next to the
+    artifact, so a replica's SECOND boot is warm by default; an explicit
+    empty value (--compile_cache_dir=) opts out and compiles."""
+    import json
+
+    bundle = _serve_bundle(tmp_path)
+    argv = ["serve", f"--serve_bundle={bundle}", "--serve_smoke=2",
+            "--serve_deadline_ms=60000"]
+    assert main(list(argv)) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    first = json.loads(out[0])
+    assert os.path.isdir(bundle + ".ccache")     # the derived location
+    assert first["cold_start"]["compile_cache_misses"] > 0
+
+    assert main(list(argv)) == 0                 # warm boot by default
+    out = capsys.readouterr().out.strip().splitlines()
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["cold_start"]["compile_cache_misses"] == 0
+    assert first["cold_start"]["warmup_compiles"] == 0
+    assert first["cold_start"]["compile_cache_hits"] > 0
+    assert last["counters"]["completed"] == 2
+
+    # explicit opt-out: no cache consulted even though one exists
+    assert main(list(argv) + ["--compile_cache_dir="]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    first = json.loads(out[0])
+    assert first["cold_start"]["compile_cache_hits"] == 0
+    assert first["cold_start"]["compile_cache_misses"] == 0
+    assert first["cold_start"]["warmup_compiles"] > 0
+
+
+def test_serve_auto_cache_resolution_and_unwritable_fallback(
+        tmp_path, monkeypatch):
+    """_resolve_cache_dir: 'auto' derives <bundle>.ccache; an unwritable
+    bundle directory (read-only artifact mount) degrades to NO cache
+    instead of crashing the boot; explicit values pass through."""
+    import os as _os
+
+    from paddle_tpu.serving.cli import _resolve_cache_dir
+
+    bundle = str(tmp_path / "m.ptz")
+    FLAGS.compile_cache_dir = "auto"
+    assert _resolve_cache_dir(bundle) == bundle + ".ccache"
+    assert _resolve_cache_dir(None) == ""    # bundle-less: nothing to key
+
+    def deny(path, exist_ok=False):
+        raise OSError(30, "Read-only file system", path)
+
+    monkeypatch.setattr(_os, "makedirs", deny)
+    assert _resolve_cache_dir(bundle) == ""  # degrade, never crash
+    FLAGS.compile_cache_dir = "/explicit/dir"
+    assert _resolve_cache_dir(bundle) == "/explicit/dir"  # untouched
+    FLAGS.compile_cache_dir = ""
+    assert _resolve_cache_dir(bundle) == ""
+
+
 def test_cli_lint_deploy_quantized_bundle(tmp_path, capsys):
     """`lint --deploy BUNDLE` audits the dequantized (and int8 in-trace)
     forward of a QUANTIZED bundle — exit 0 on a clean export, 1 with a
@@ -239,7 +297,8 @@ def test_cli_help_lists_obs_flags(capsys):
     out = capsys.readouterr().out
     assert "python -m paddle_tpu obs" in out
     for flag in ("--metrics_port", "--obs_journal", "--obs_timeline",
-                 "--obs_peak_flops", "--profile_steps"):
+                 "--obs_peak_flops", "--profile_steps", "--trace_sample",
+                 "--trace_tail_p99"):
         assert flag in out, flag
 
 

@@ -1192,6 +1192,85 @@ def bench_cold_start_ab(rtt, peak):
     }
 
 
+def bench_seq_packing_ab(rtt, peak):
+    """A/B sequence packing (docs/data.md, --data_pack) on a PAD-HEAVY
+    textclf trace: the lstm_benchmark_net config fed a skewed IMDB-style
+    length distribution (most sequences far below the bucket), bucketed
+    one-sample-per-row vs packed rows (segment ids + RNN carry resets +
+    per-segment pooling).  Both arms step the SAME sample distribution;
+    ``value`` is packed samples/s, ``vs_baseline`` the packed/bucketed
+    throughput ratio, and the row carries each arm's measured pad waste
+    (the ``data_pad_waste`` gauge quantity — the packed arm must crush
+    it).  NOTE the packed arm runs the RNN scan path (the fused/Pallas
+    time loop has no carry-reset port), so the CPU capture undersells
+    packing wherever the fused loop wins — judge the winner from a TPU
+    capture.  ``default_flag`` mirrors ``--data_pack``."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.datapipe import PackedDataFeeder, pack_samples
+    from paddle_tpu.models import lstm_benchmark_net
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.utils.flags import FLAGS
+
+    VOCAB, B, HID, EMB = 30000, 64, 256, 128
+    MAX_LEN, MAX_SEGS = 128, 16
+    rs = np.random.RandomState(0)
+    # IMDB-style skew: median ~20 tokens under a 128 bucket
+    lengths = np.clip((rs.exponential(24, 4096) + 4).astype(int), 4, MAX_LEN)
+    samples = [(rs.randint(3, VOCAB, L).tolist(), int(rs.randint(0, 2)))
+               for L in lengths]
+
+    def build(feed):
+        nn.reset_naming()
+        cost, _ = lstm_benchmark_net(VOCAB, emb_dim=EMB, hid_dim=HID,
+                                     num_layers=2)
+        jfeed = {k: (tuple(jnp.asarray(v) for v in val) if isinstance(
+            val, tuple) else jnp.asarray(val)) for k, val in feed.items()}
+        return _topology_step(cost, Adam(learning_rate=1e-3), jfeed)
+
+    # bucketed arm: one sample per row
+    feeder = DataFeeder({"words": "ids_seq", "label": "int"},
+                        max_len=MAX_LEN)
+    feed_u = feeder(samples[:B])
+    step_u, carry_u = build(feed_u)
+    sec_u, _, _ = _time_chain(step_u, carry_u, iters=20, rtt=rtt)
+
+    # packed arm: B rows of packed segments over the same distribution
+    rows = pack_samples(samples, max_len=MAX_LEN, max_segments=MAX_SEGS)[:B]
+    n_packed = sum(len(r[0]) for r in rows)
+    pfeeder = PackedDataFeeder({"words": "ids_seq", "label": "int"},
+                               max_segments=MAX_SEGS)
+    feed_p = pfeeder(rows)
+    step_p, carry_p = build(feed_p)
+    sec_p, _, _ = _time_chain(step_p, carry_p, iters=20, rtt=rtt)
+
+    tput_u = B / sec_u
+    tput_p = n_packed / sec_p
+    if tput_p > 1.05 * tput_u:
+        winner = "packed"
+    elif tput_u > 1.05 * tput_p:
+        winner = "bucketed"
+    else:
+        winner = "tie"
+    return {
+        "metric": f"seq_packing_ab_samples_per_sec(b{B},h{HID},"
+                  f"len~exp24<={MAX_LEN},S{MAX_SEGS})",
+        "short": "seq_packing_ab",
+        "value": round(tput_p, 1),
+        "unit": "samples/s",
+        "mfu": None,
+        "vs_baseline": round(tput_p / tput_u, 3),
+        "bucketed_samples_s": round(tput_u, 1),
+        "packed_samples_per_batch": n_packed,
+        "pad_waste_bucketed": round(feeder.pad_waste, 4),
+        "pad_waste_packed": round(pfeeder.pad_waste, 4),
+        "winner": winner,
+        "default_flag": bool(FLAGS.data_pack),
+    }
+
+
 def bench_sharded_embedding_ab(rtt, peak):
     """A/B the pserver all-to-all sharded-embedding lookup
     (paddle_tpu/pserver/lookup.py) vs the previous psum-of-zeros broadcast
@@ -1321,6 +1400,7 @@ def main() -> None:
         safe(bench_pallas_lstm_ab),
         safe(bench_pallas_decode_ab),
         safe(bench_amp_ab),
+        safe(bench_seq_packing_ab),
         safe(bench_serving_continuous_ab),
         safe(bench_sharded_embedding_ab),
         safe(bench_cold_start_ab),

@@ -68,6 +68,7 @@ def job_train(conf) -> int:
     from paddle_tpu.trainer import events as ev
     from paddle_tpu.trainer.checkpoint import latest_pass
     from paddle_tpu.utils import FLAGS, logger
+    from paddle_tpu.utils.error import ConfigError
 
     trainer = _build_trainer(conf)
     # --resume=auto self-locates inside train(); --start_pass remains the
@@ -83,13 +84,43 @@ def job_train(conf) -> int:
             logger.info("pass %d done: %s", e.pass_id, e.evaluator)
 
     reader = conf["reader"]
+    feeder = conf.get("feeder")
+    test_reader = conf.get("test_reader")
+    if FLAGS.data_pack:
+        # sequence packing (docs/data.md): re-plumb the batch reader +
+        # DataFeeder pair into packed rows; requires a feeder with
+        # exactly one ids_seq slot (typed ConfigError otherwise).  The
+        # test reader packs the same way — train() feeds eval batches
+        # through the SAME (now packed) feeder
+        from paddle_tpu.data.feeder import DataFeeder
+        from paddle_tpu.datapipe import auto_pack
+
+        if not isinstance(feeder, DataFeeder):
+            raise ConfigError(
+                "--data_pack needs the config's feeder to be a "
+                "DataFeeder (the packer re-plumbs its slots)")
+        if test_reader is not None:
+            test_reader, _ = auto_pack(test_reader, feeder)
+        reader, feeder = auto_pack(reader, feeder)
+        logger.info("--data_pack: sequence packing enabled "
+                    "(note: packed readers resume via fast-forward)")
     if FLAGS.reader_retries > 0:
-        reader = resilient_reader(reader, max_retries=FLAGS.reader_retries)
+        from paddle_tpu.datapipe import is_checkpointable_source
+
+        if is_checkpointable_source(reader):
+            # a datapipe source carries its own retry/skip policy
+            # (skip_corrupt) — wrapping would hide the cursor protocol
+            # and silently demote resume to the fast-forward fallback
+            logger.warning("--reader_retries ignored for a checkpointable "
+                           "datapipe source (use skip_corrupt=True)")
+        else:
+            reader = resilient_reader(reader,
+                                      max_retries=FLAGS.reader_retries)
     trainer.train(
         reader,
         num_passes=FLAGS.num_passes,
-        feeder=conf.get("feeder"),
-        test_reader=conf.get("test_reader"),
+        feeder=feeder,
+        test_reader=test_reader,
         event_handler=handler,
         resume="auto" if FLAGS.resume == "auto" else None,
     )
@@ -183,6 +214,7 @@ usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [
        python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE|--obs] ...
        python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
        python -m paddle_tpu obs {merge|dump|trace} DIR_OR_FILE... [--format text|json|perfetto]
+       python -m paddle_tpu data {pack|verify} ... (indexed record shards, docs/data.md)
 
 The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
 module docstring of paddle_tpu/__main__.py).  `serve` runs the
@@ -213,6 +245,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.obs.cli import run as obs_run
 
         return obs_run(argv[1:])
+    if argv and argv[0] == "data":
+        # shard-set tooling (docs/data.md): pack any reader into indexed
+        # record shards, or CRC-verify an existing set — its own argparse
+        # surface like lint/obs
+        from paddle_tpu.datapipe.cli import run as data_run
+
+        return data_run(argv[1:])
     if "-h" in argv or "--help" in argv:
         # also covers `serve --help`: the serve knobs are registered
         # --serve_* flags, so the global table IS its help surface (only

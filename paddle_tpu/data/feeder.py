@@ -23,7 +23,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, \
 import numpy as np
 
 __all__ = ["DataFeeder", "bucket_length", "feeder_kind_for_layer",
-           "BatchPrefetcher", "PreparedFeed", "PrepareError"]
+           "BatchPrefetcher", "PreparedFeed", "PrepareError",
+           "note_padding"]
 
 _DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 
@@ -33,6 +34,38 @@ def bucket_length(n: int, buckets: Sequence[int] = _DEFAULT_BUCKETS) -> int:
         if n <= b:
             return b
     return n
+
+
+# per-bucket cumulative (real, padded) token totals behind the
+# ``data_bucket_occupancy`` gauge — process-wide like the registry
+# itself; lock-guarded because a ``BatchPrefetcher`` runs the feeder on
+# its background thread
+_BUCKET_TOTALS: Dict[int, List[int]] = {}
+_BUCKET_LOCK = threading.Lock()
+
+
+def note_padding(real: int, padded: int, bucket: int, *,
+                 waste: float) -> None:
+    """Record one padded batch on the pad-waste instruments
+    (docs/observability.md): ``data_pad_waste`` (the cumulative
+    padded-but-dead token fraction — the quantity ``--data_pack`` exists
+    to crush) and per-bucket ``data_bucket_occupancy`` (how full the
+    rows landing in each T-bucket actually are).  Host-side only; called
+    by ``DataFeeder`` and ``datapipe.PackedDataFeeder`` so bucketed and
+    packed pipelines report on the SAME series."""
+    from paddle_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.gauge("data_pad_waste",
+              "cumulative padded-but-dead token fraction").set(waste)
+    with _BUCKET_LOCK:
+        tot = _BUCKET_TOTALS.setdefault(int(bucket), [0, 0])
+        tot[0] += int(real)
+        tot[1] += int(padded)
+        occ = tot[0] / max(tot[1], 1)
+    reg.gauge("data_bucket_occupancy",
+              "real-token fraction of batches padded to this T bucket",
+              labels=("bucket",), bucket=int(bucket)).set(occ)
 
 
 def feeder_kind_for_layer(layer) -> str:
@@ -194,6 +227,18 @@ class DataFeeder:
         #: ``_last_extras['dropped_features']`` each batch, and a serving
         #: process that ``attach_feeder()``s reports it in ``healthz()``.
         self.dropped_features = 0
+        #: cumulative real/padded token totals over every padded seq slot
+        #: — behind the ``data_pad_waste`` gauge (see ``note_padding``)
+        self.tokens_real = 0
+        self.tokens_padded = 0
+
+    @property
+    def pad_waste(self) -> float:
+        """Cumulative padded-but-dead token fraction across every padded
+        sequence slot this feeder has produced."""
+        if not self.tokens_padded:
+            return 0.0
+        return 1.0 - self.tokens_real / self.tokens_padded
 
     def __call__(self, batch_rows: List[Tuple]) -> Dict[str, Any]:
         feed: Dict[str, Any] = {}
@@ -357,6 +402,10 @@ class DataFeeder:
             T = min(max(T, 1), self.max_len)
             lengths = np.minimum(lengths, self.max_len)
         T = bucket_length(T, self.buckets)
+        self.tokens_real += int(lengths.sum())
+        self.tokens_padded += len(col) * T
+        note_padding(int(lengths.sum()), len(col) * T, T,
+                     waste=self.pad_waste)
         if kind == "ids_seq":
             from paddle_tpu.data import native
 

@@ -472,6 +472,16 @@ def _coerce_feed(layer: LayerOutput, feed: Dict[str, Any]) -> Act:
         value, lengths, sub_lengths = v
         act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths),
                   sub_lengths=jnp.asarray(sub_lengths))
+    elif isinstance(v, tuple) and len(v) == 5:
+        # PACKED sequence slot (datapipe/packing.py, --data_pack): several
+        # whole sequences share the row; seg_ids/positions/seg_lengths ride
+        # Act.state and every packing-aware layer (RNN carry resets,
+        # per-segment pooling, fenced context windows) reads them there
+        value, lengths, seg_ids, positions, seg_lengths = v
+        act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths),
+                  state={"seg_ids": jnp.asarray(seg_ids),
+                         "positions": jnp.asarray(positions),
+                         "seg_lengths": jnp.asarray(seg_lengths)})
     elif isinstance(v, tuple):
         value, lengths = v
         act = Act(value=jnp.asarray(value), lengths=jnp.asarray(lengths))

@@ -91,9 +91,32 @@ def _bias_attr(bias: AttrLike, default_name: str) -> Optional[ParamAttr]:
     return _pa(bias, default_name) if bias.init else replace(_pa(bias, default_name), init="zeros")
 
 
+def _pack_state(a: Act) -> dict:
+    """The sequence-packing keys riding an Act (docs/data.md): seg_ids /
+    positions / seg_lengths.  Empty for unpacked activations."""
+    return {k: a.state[k] for k in O.PACK_KEYS if k in a.state}
+
+
+def _refuse_packed(a: Act, name: str, kind: str) -> None:
+    """Loud guard for layers with NO per-segment semantics: computing a
+    cross-time op over a packed row would mix neighboring samples'
+    tokens — a silently wrong loss, never an error.  Every layer that
+    consumes the time axis whole and has no packed variant calls this
+    (the ConfigError names the layer, so a --data_pack run on an
+    unsupported topology fails at the first batch, not in the metrics)."""
+    if _pack_state(a):
+        raise ConfigError(
+            f"{kind} {name!r} does not support packed sequences "
+            f"(--data_pack): it computes across the time axis and would "
+            f"mix packed neighbors' tokens — feed this topology "
+            f"unpacked, or use a pack-aware layer")
+
+
 def _seq_like(parent: Act, value) -> Act:
+    # pack state rides every elementwise/seq-shaped layer unchanged, so a
+    # downstream segment-aware layer (pooling, RNN reset) still sees it
     return Act(value=value, lengths=parent.lengths, mask=parent.mask,
-               sub_lengths=parent.sub_lengths)
+               sub_lengths=parent.sub_lengths, state=_pack_state(parent))
 
 
 def _inherit_meta(node: LayerOutput, src: LayerOutput) -> LayerOutput:
@@ -621,13 +644,16 @@ def lstmemory(input: LayerOutput, size: Optional[int] = None, *,
         if use_peepholes:
             pk = dict(peep_i=params[peeps[0].name], peep_f=params[peeps[1].name],
                       peep_o=params[peeps[2].name])
+        packst = _pack_state(a)
+        reset = (O.segment_starts(packst["seg_ids"], a.mask, reverse=reverse)
+                 if packst else None)
         h_seq, (h_f, c_f) = O.lstm_layer(
             a.value, a.mask, params[wx.name] if wx else None, params[wh.name],
             b, reverse=reverse, act=act, gate_act=gate_act,
-            state_act=state_act, **pk,
+            state_act=state_act, reset=reset, **pk,
         )
         return Act(value=h_seq, lengths=a.lengths, mask=a.mask,
-                   state={"final_h": h_f, "final_c": c_f})
+                   state={"final_h": h_f, "final_c": c_f, **packst})
 
     return LayerOutput(name, "lstmemory", H, [input], forward, specs)
 
@@ -665,11 +691,15 @@ def grumemory(input: LayerOutput, size: Optional[int] = None, *,
 
     def forward(ctx, params, a: Act) -> Act:
         b = params[ba.name] if ba else jnp.zeros((3 * H,), a.value.dtype)
+        packst = _pack_state(a)
+        reset = (O.segment_starts(packst["seg_ids"], a.mask, reverse=reverse)
+                 if packst else None)
         h_seq, h_f = O.gru_layer(
             a.value, a.mask, params[wx.name] if wx else None, params[wh.name],
-            b, reverse=reverse, act=act, gate_act=gate_act,
+            b, reverse=reverse, act=act, gate_act=gate_act, reset=reset,
         )
-        return Act(value=h_seq, lengths=a.lengths, mask=a.mask, state={"final_h": h_f})
+        return Act(value=h_seq, lengths=a.lengths, mask=a.mask,
+                   state={"final_h": h_f, **packst})
 
     return LayerOutput(name, "grumemory", H, [input], forward, specs)
 
@@ -700,8 +730,13 @@ def recurrent(input: LayerOutput, *, act: str = "tanh", reverse: bool = False,
 
         B = x.shape[0]
         h0 = jnp.zeros((B, H), x.dtype)
-        h_f, h_seq = O.scan_rnn(step, h0, x, a.mask, reverse=reverse)
-        return Act(value=h_seq, lengths=a.lengths, mask=a.mask, state={"final_h": h_f})
+        packst = _pack_state(a)
+        reset = (O.segment_starts(packst["seg_ids"], a.mask, reverse=reverse)
+                 if packst else None)
+        h_f, h_seq = O.scan_rnn(step, h0, x, a.mask, reverse=reverse,
+                                reset_bt=reset)
+        return Act(value=h_seq, lengths=a.lengths, mask=a.mask,
+                   state={"final_h": h_f, **packst})
 
     return LayerOutput(name, "recurrent", H, [input], forward, specs)
 
@@ -725,13 +760,26 @@ def bidirectional_rnn(input: LayerOutput, size: int, *, cell: str = "lstm",
 def pooling(input: LayerOutput, *, pooling_type: str = "max",
             name: Optional[str] = None) -> LayerOutput:
     """Sequence pooling [B,T,D]->[B,D] — analog of pooling_layer
-    (SequencePoolLayer.cpp; types max/avg/sum/sqrt)."""
+    (SequencePoolLayer.cpp; types max/avg/sum/sqrt).
+
+    PACKED input (docs/data.md): pooling reduces each SEGMENT separately
+    — the output is a sequence over the segment axis ([B,S,D] with the
+    segment-validity mask), so the per-sample heads downstream (fc,
+    classification_cost's masked token mean) treat every packed sample
+    exactly like a row of its own."""
     name = name or next_name("seq_pool")
     fns = {"max": O.seq_pool_max, "avg": O.seq_pool_avg,
            "sum": O.seq_pool_sum, "sqrt": O.seq_pool_sqrt}
     fn = fns[pooling_type]
 
     def forward(ctx, params, a: Act) -> Act:
+        segl = a.state.get("seg_lengths")
+        if segl is not None:
+            out = O.segment_pool(a.value, a.mask, a.state["seg_ids"],
+                                 segl, pooling_type)
+            sv = O.segment_valid(segl)
+            return Act(value=out, mask=sv,
+                       lengths=jnp.sum(sv, axis=1).astype(jnp.int32))
         return Act(value=fn(a.value, a.mask))
 
     return LayerOutput(name, "seq_pool", input.size, [input], forward, [])
@@ -742,6 +790,11 @@ def last_seq(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
     name = name or next_name("last_seq")
 
     def forward(ctx, params, a: Act) -> Act:
+        segl = a.state.get("seg_lengths")
+        if segl is not None:  # packed: last token of every segment
+            sv = O.segment_valid(segl)
+            return Act(value=O.segment_last(a.value, segl), mask=sv,
+                       lengths=jnp.sum(sv, axis=1).astype(jnp.int32))
         return Act(value=O.seq_last(a.value, a.lengths))
 
     return LayerOutput(name, "last_seq", input.size, [input], forward, [])
@@ -751,6 +804,11 @@ def first_seq(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
     name = name or next_name("first_seq")
 
     def forward(ctx, params, a: Act) -> Act:
+        segl = a.state.get("seg_lengths")
+        if segl is not None:  # packed: first token of every segment
+            sv = O.segment_valid(segl)
+            return Act(value=O.segment_first(a.value, segl), mask=sv,
+                       lengths=jnp.sum(sv, axis=1).astype(jnp.int32))
         return Act(value=O.seq_first(a.value))
 
     return LayerOutput(name, "first_seq", input.size, [input], forward, [])
@@ -763,8 +821,15 @@ def expand(input: LayerOutput, expand_as: LayerOutput, *,
     name = name or next_name("expand")
 
     def forward(ctx, params, vec: Act, seq: Act) -> Act:
+        packst = _pack_state(seq)
+        if packst and vec.value.ndim == 3:
+            # packed: a per-SEGMENT vector ([B,S,D], e.g. from pooling)
+            # broadcasts back over its own segment's tokens only
+            return Act(value=O.segment_expand(vec.value,
+                                              packst["seg_ids"], seq.mask),
+                       lengths=seq.lengths, mask=seq.mask, state=packst)
         return Act(value=O.seq_expand(vec.value, seq.mask),
-                   lengths=seq.lengths, mask=seq.mask)
+                   lengths=seq.lengths, mask=seq.mask, state=packst)
 
     return LayerOutput(name, "expand", input.size, [input, expand_as], forward, [])
 
@@ -773,6 +838,7 @@ def seq_reverse(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutpu
     name = name or next_name("seq_reverse")
 
     def forward(ctx, params, a: Act) -> Act:
+        _refuse_packed(a, name, "seq_reverse")
         return Act(value=O.seq_reverse(a.value, a.lengths),
                    lengths=a.lengths, mask=a.mask)
 
@@ -784,6 +850,8 @@ def seq_concat(a: LayerOutput, b: LayerOutput, *, name: Optional[str] = None) ->
     name = name or next_name("seq_concat")
 
     def forward(ctx, params, x: Act, y: Act) -> Act:
+        _refuse_packed(x, name, "seq_concat")
+        _refuse_packed(y, name, "seq_concat")
         v, l = O.seq_concat(x.value, x.lengths, y.value, y.lengths)
         T = v.shape[1]
         return Act(value=v, lengths=l, mask=O.mask_from_lengths(l, T))
@@ -799,8 +867,10 @@ def context_projection(input: LayerOutput, *, context_len: int,
     start = -(context_len // 2) if context_start is None else context_start
 
     def forward(ctx, params, a: Act) -> Act:
-        out = O.context_projection(a.value, a.mask, context_len, start)
-        return Act(value=out, lengths=a.lengths, mask=a.mask)
+        packst = _pack_state(a)
+        out = O.context_projection(a.value, a.mask, context_len, start,
+                                   seg_ids=packst.get("seg_ids"))
+        return Act(value=out, lengths=a.lengths, mask=a.mask, state=packst)
 
     return LayerOutput(name, "context_projection", input.size * context_len,
                        [input], forward, [])
@@ -816,7 +886,9 @@ def maxid(input: LayerOutput, *, name: Optional[str] = None) -> LayerOutput:
 
     def forward(ctx, params, a: Act) -> Act:
         out = O.max_id(a.value)
-        return Act(value=out, lengths=a.lengths, mask=a.mask) if a.is_seq else Act(value=out)
+        # per-position argmax is pack-agnostic: _seq_like keeps the pack
+        # state flowing to any downstream segment-aware layer
+        return _seq_like(a, out) if a.is_seq else Act(value=out)
 
     return LayerOutput(name, "maxid", 1, [input], forward, [])
 

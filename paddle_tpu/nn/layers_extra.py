@@ -13,7 +13,7 @@ import paddle_tpu.ops as O
 from paddle_tpu.ops.crf import crf_decode, crf_nll
 from paddle_tpu.ops.ctc import ctc_loss
 from paddle_tpu.nn.graph import Act, LayerOutput, ParamAttr, ParamSpec, next_name
-from paddle_tpu.nn.layers import _inherit_meta
+from paddle_tpu.nn.layers import _inherit_meta, _refuse_packed
 from paddle_tpu.utils.error import ConfigError
 
 __all__ = [
@@ -58,6 +58,7 @@ def crf_cost(input: LayerOutput, label: LayerOutput, *, size: Optional[int] = No
     s_start, s_end, s_trans = _crf_specs(name, C)
 
     def forward(ctx, params, emis: Act, lab: Act) -> Act:
+        _refuse_packed(emis, name, "crf_cost")
         nll = crf_nll(emis.value, lab.value, emis.mask,
                       params[s_start.name], params[s_end.name], params[s_trans.name])
         return Act(value=nll)
@@ -76,6 +77,7 @@ def crf_decoding(input: LayerOutput, *, size: Optional[int] = None,
     s_start, s_end, s_trans = _crf_specs(base, C)
 
     def forward(ctx, params, emis: Act) -> Act:
+        _refuse_packed(emis, name, "crf_decoding")
         tags, score = crf_decode(emis.value, emis.mask,
                                  params[s_start.name], params[s_end.name],
                                  params[s_trans.name])
@@ -118,6 +120,7 @@ def ctc_cost(input: LayerOutput, label: LayerOutput, *,
             f"the logits as num_classes + 1, or pass blank= explicitly")
 
     def forward(ctx, params, logits: Act, lab: Act) -> Act:
+        _refuse_packed(logits, name, "ctc_cost")
         lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)
         in_len = logits.lengths
         lab_len = lab.lengths
@@ -139,6 +142,7 @@ def warp_ctc(input: LayerOutput, label: LayerOutput, *, blank: int = 0,
     name = name or next_name("warp_ctc")
 
     def forward(ctx, params, logits: Act, lab: Act) -> Act:
+        _refuse_packed(logits, name, "warp_ctc")
         lp = jax.nn.log_softmax(logits.value.astype(jnp.float32), axis=-1)
         losses = ctc_loss(lp, lab.value, logits.lengths, lab.lengths,
                           blank=blank, norm_by_times=norm_by_times)
@@ -334,6 +338,7 @@ def sub_seq(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput, *,
     name = name or next_name("sub_seq")
 
     def forward(ctx, params, a: Act, off: Act, sz: Act) -> Act:
+        _refuse_packed(a, name, "sub_seq")
         T = a.value.shape[1]
         o = off.value.reshape(-1).astype(jnp.int32)
         s = sz.value.reshape(-1).astype(jnp.int32)
@@ -356,6 +361,7 @@ def seq_reshape(input: LayerOutput, reshape_size: int, *,
     name = name or next_name("seq_reshape")
 
     def forward(ctx, params, a: Act) -> Act:
+        _refuse_packed(a, name, "seq_reshape")
         B, T, D = a.value.shape
         T2 = T * D // reshape_size
         v = a.value.reshape(B, T2, reshape_size)
@@ -373,6 +379,7 @@ def eos_trim(input: LayerOutput, *, eos_id: int = 1,
     name = name or next_name("eos_trim")
 
     def forward(ctx, params, a: Act) -> Act:
+        _refuse_packed(a, name, "eos_trim")
         ids = a.value
         T = ids.shape[1]
         is_eos = (ids == eos_id)

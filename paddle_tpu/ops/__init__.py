@@ -23,6 +23,13 @@ from paddle_tpu.ops.losses import (
     sequence_softmax_ce_readout,
 )
 from paddle_tpu.ops.sequence import (
+    PACK_KEYS,
+    segment_starts,
+    segment_valid,
+    segment_pool,
+    segment_last,
+    segment_first,
+    segment_expand,
     mask_from_lengths,
     seq_pool_sum,
     seq_pool_avg,

@@ -104,18 +104,36 @@ def gru_step(xp, h, w_h, *, act="tanh", gate_act="sigmoid"):
     return h_new
 
 
-def scan_rnn(step_fn, carry_init, xs_btd, mask_bt, *, reverse=False):
+def scan_rnn(step_fn, carry_init, xs_btd, mask_bt, *, reverse=False,
+             reset_bt=None):
     """Scan ``step_fn(carry, x_t) -> (carry, out_t)`` over time with length
     masking: where mask==0 the carry is held, out is zeroed.
 
     xs may be a pytree of [B, T, ...] arrays; outputs are [B, T, ...].
+
+    ``reset_bt`` ([B,T], optional) marks SEQUENCE-PACKING boundaries
+    (ops/sequence.segment_starts): where it is 1 the incoming carry is
+    replaced by ``carry_init`` before the step, so recurrent state never
+    flows from one packed segment into the next — each segment computes
+    exactly what it would alone in its own row (docs/data.md).
     """
     T = mask_bt.shape[1]
     xs_tb = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), xs_btd)
     mask_tb = jnp.moveaxis(mask_bt, 1, 0)
+    reset_tb = (None if reset_bt is None
+                else jnp.moveaxis(reset_bt, 1, 0))
 
     def masked_step(carry, inp):
-        x_t, m_t = inp
+        if reset_tb is None:
+            x_t, m_t = inp
+        else:
+            x_t, m_t, r_t = inp
+
+            def re(init, c):
+                r = r_t.reshape(r_t.shape + (1,) * (c.ndim - 1))
+                return jnp.where(r.astype(c.dtype) > 0, init, c)
+
+            carry = jax.tree_util.tree_map(re, carry_init, carry)
         new_carry, out = step_fn(carry, x_t)
 
         def bmask(a):  # [B] mask broadcast against [B, ...] of any rank
@@ -128,14 +146,16 @@ def scan_rnn(step_fn, carry_init, xs_btd, mask_bt, *, reverse=False):
         out = jax.tree_util.tree_map(lambda o: o * bmask(o), out)
         return carry_out, out
 
-    final, outs_tb = lax.scan(masked_step, carry_init, (xs_tb, mask_tb), reverse=reverse)
+    ins = (xs_tb, mask_tb) if reset_tb is None else (xs_tb, mask_tb, reset_tb)
+    final, outs_tb = lax.scan(masked_step, carry_init, ins, reverse=reverse)
     outs = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 0, 1), outs_tb)
     return final, outs
 
 
 def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
                peep_i=None, peep_f=None, peep_o=None,
-               act="tanh", gate_act="sigmoid", state_act="tanh"):
+               act="tanh", gate_act="sigmoid", state_act="tanh",
+               reset=None):
     """Full LSTM over a padded batch. x: [B,T,D] -> h_seq [B,T,H], (h,c) final.
 
     Equivalent capability to the reference's lstmemory layer
@@ -143,11 +163,17 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
     projection is one big MXU matmul over all timesteps.  ``w_x=None``
     means x IS the [B,T,4H] pre-projection (the reference's convention,
     where a preceding mixed layer owns the input matrix).
+
+    ``reset`` ([B,T], sequence packing — docs/data.md) zeroes the (h,c)
+    carry at segment-entry positions; it routes through the lax.scan
+    path (the fused/Pallas time loop has no reset port), which is the
+    documented packing trade: denser rows for the scan-path step.
     """
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = (x + b.astype(x.dtype)) if w_x is None else linear(x, w_x, b)
-    if (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh"):
+    if reset is None and \
+            (act, gate_act, state_act) == ("tanh", "sigmoid", "tanh"):
         # default cell (peepholes included — zeros degenerate exactly):
         # fused-backward sequence op (hand-written VJP batches d_w_h after
         # the reverse loop; Pallas fwd+bwd kernels when the gate allows —
@@ -185,22 +211,24 @@ def lstm_layer(x, mask, w_x, w_h, b, *, h0=None, c0=None, reverse=False,
         )
         return (h2, c2), h2
 
-    (h_fin, c_fin), h_seq = scan_rnn(step, (h0, c0), xp, mask, reverse=reverse)
+    (h_fin, c_fin), h_seq = scan_rnn(step, (h0, c0), xp, mask,
+                                     reverse=reverse, reset_bt=reset)
     return h_seq, (h_fin, c_fin)
 
 
 def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
-              act="tanh", gate_act="sigmoid"):
+              act="tanh", gate_act="sigmoid", reset=None):
     """Full GRU over a padded batch. x: [B,T,D] -> h_seq [B,T,H], h final.
 
     Capability analog of grumemory (trainer_config_helpers/layers.py:1228 +
     GatedRecurrentLayer.cpp).  ``w_x=None``: x is the [B,T,3H]
-    pre-projection (see lstm_layer).
+    pre-projection (see lstm_layer).  ``reset`` as in ``lstm_layer``
+    (sequence packing: carry zeroed at segment entries, scan path).
     """
     B, T, _ = x.shape
     H = w_h.shape[0]
     xp = (x + b.astype(x.dtype)) if w_x is None else linear(x, w_x, b)
-    if (act, gate_act) == ("tanh", "sigmoid"):
+    if reset is None and (act, gate_act) == ("tanh", "sigmoid"):
         # default cell: fused-backward sequence op (see lstm_layer above)
         from paddle_tpu.ops.rnn_fused import gru_sequence_fused
 
@@ -218,7 +246,8 @@ def gru_layer(x, mask, w_x, w_h, b, *, h0=None, reverse=False,
         h2 = gru_step(xp_t, h, w_h, act=act, gate_act=gate_act)
         return h2, h2
 
-    h_fin, h_seq = scan_rnn(step, h0, xp, mask, reverse=reverse)
+    h_fin, h_seq = scan_rnn(step, h0, xp, mask, reverse=reverse,
+                            reset_bt=reset)
     return h_seq, h_fin
 
 

@@ -20,6 +20,13 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "PACK_KEYS",
+    "segment_starts",
+    "segment_valid",
+    "segment_pool",
+    "segment_last",
+    "segment_first",
+    "segment_expand",
     "mask_from_lengths",
     "seq_pool_sum",
     "seq_pool_avg",
@@ -123,7 +130,8 @@ def seq_concat(a, a_len, b, b_len):
     return _masked(out, mask), out_len
 
 
-def context_projection(value, mask, context_len, context_start):
+def context_projection(value, mask, context_len, context_start,
+                       seg_ids=None):
     """Sliding window over time: output[t] = concat(value[t+start .. t+start+len-1]).
 
     Analog of the reference's context projection kernels
@@ -131,18 +139,31 @@ def context_projection(value, mask, context_len, context_start):
     gserver/layers/ContextProjection.cpp).  Out-of-range positions are zero
     (trainable start padding is handled at the layer tier).  [B,T,D] ->
     [B,T,D*context_len].
+
+    ``seg_ids`` (packed rows — docs/data.md) fences the window at segment
+    boundaries: a shifted position belonging to a DIFFERENT segment reads
+    as zero, exactly as if the neighbor were row padding — so packed and
+    unpacked convolutions compute the same per-sample features.
     """
     B, T, D = value.shape
     v = _masked(value, mask)
+
+    def shift(a, off, fill=0):
+        if off < 0:
+            return jnp.pad(a, ((0, 0), (-off, 0)) + ((0, 0),) * (a.ndim - 2),
+                           constant_values=fill)[:, :T]
+        if off > 0:
+            return jnp.pad(a, ((0, 0), (0, off)) + ((0, 0),) * (a.ndim - 2),
+                           constant_values=fill)[:, off: off + T]
+        return a
+
     cols = []
     for k in range(context_len):
         off = context_start + k
-        if off < 0:
-            shifted = jnp.pad(v, ((0, 0), (-off, 0), (0, 0)))[:, :T]
-        elif off > 0:
-            shifted = jnp.pad(v, ((0, 0), (0, off), (0, 0)))[:, off : off + T]
-        else:
-            shifted = v
+        shifted = shift(v, off)
+        if seg_ids is not None and off != 0:
+            same = (shift(seg_ids, off, fill=-2) == seg_ids)
+            shifted = shifted * same[..., None].astype(shifted.dtype)
         cols.append(shifted)
     out = jnp.concatenate(cols, axis=-1)
     return _masked(out, mask)
@@ -181,6 +202,112 @@ def context_projection_trainable(value, lengths, mask, context_len, context_star
         col = jnp.where(use_pad[..., None], pad_vals, shifted)
         cols.append(col)
     out = jnp.concatenate(cols, axis=-1)
+    return _masked(out, mask)
+
+
+# ---------------------------------------------------------------------------
+# sequence packing (docs/data.md "Sequence packing", --data_pack)
+#
+# A packed row holds several whole sequences back-to-back: seg_ids [B,T]
+# gives each token its 0-based segment index (-1 on padding), positions
+# [B,T] its within-segment offset, seg_lengths [B,S] the token count per
+# segment (0 = unused slot; S is the static max_segments).  These ops are
+# the packed analogs of the padded-batch reductions above — the layer
+# tier dispatches to them whenever the Act carries the pack state.
+# ---------------------------------------------------------------------------
+
+#: the Act.state keys that mark (and plumb) a packed sequence
+PACK_KEYS = ("seg_ids", "positions", "seg_lengths")
+
+
+def segment_valid(seg_lengths):
+    """[B,S] per-segment token counts -> [B,S] float validity mask."""
+    return (seg_lengths > 0).astype(jnp.float32)
+
+
+def segment_starts(seg_ids, mask, *, reverse=False):
+    """[B,T] mask of segment ENTRY positions for a scan direction: where
+    the recurrent carry must reset so state never flows across packed
+    neighbors.  Forward entry = first token of each segment; reverse
+    entry = last token (a reverse scan meets segments tail-first)."""
+    pad = jnp.full_like(seg_ids[:, :1], -1)
+    if reverse:
+        neighbor = jnp.concatenate([seg_ids[:, 1:], pad], axis=1)
+    else:
+        neighbor = jnp.concatenate([pad, seg_ids[:, :-1]], axis=1)
+    return ((seg_ids != neighbor) & (mask > 0)).astype(jnp.float32)
+
+
+def _flat_segments(seg_ids, mask, S):
+    """Flatten [B,T] segment addressing to [B*T] global segment ids with
+    invalid positions routed to a drop bucket (index B*S)."""
+    B, T = seg_ids.shape
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    flat = rows * S + jnp.clip(seg_ids, 0, S - 1)
+    valid = (mask > 0) & (seg_ids >= 0) & (seg_ids < S)
+    return jnp.where(valid, flat, B * S).reshape(-1), valid
+
+
+def segment_pool(value, mask, seg_ids, seg_lengths, pooling_type="max"):
+    """Per-SEGMENT pooling over a packed row: [B,T,D] -> [B,S,D] (the
+    packed analog of seq_pool_*).  Empty segment slots come out zero."""
+    B, T, D = value.shape
+    S = seg_lengths.shape[1]
+    flat, valid = _flat_segments(seg_ids, mask, S)
+    vmask = valid[..., None]
+    counts = seg_lengths.astype(value.dtype)[..., None]
+    if pooling_type == "max":
+        neg = jnp.finfo(value.dtype).min
+        data = jnp.where(vmask, value, neg).reshape(B * T, D)
+        out = jax.ops.segment_max(data, flat,
+                                  num_segments=B * S + 1)[: B * S]
+        out = out.reshape(B, S, D)
+        return jnp.where(counts > 0, out, jnp.zeros_like(out))
+    data = (value * vmask.astype(value.dtype)).reshape(B * T, D)
+    out = jax.ops.segment_sum(data, flat,
+                              num_segments=B * S + 1)[: B * S]
+    out = out.reshape(B, S, D)
+    if pooling_type == "sum":
+        return out
+    n = jnp.maximum(counts, 1.0)
+    if pooling_type == "avg":
+        return out / n
+    if pooling_type == "sqrt":
+        return out / jnp.sqrt(n)
+    raise ValueError(f"unknown segment pooling type {pooling_type!r}")
+
+
+def _segment_starts_idx(seg_lengths):
+    """[B,S] exclusive prefix sum — each segment's first token index
+    (packing lays segments out contiguously, in order)."""
+    return jnp.cumsum(seg_lengths, axis=1) - seg_lengths
+
+
+def segment_last(value, seg_lengths):
+    """Last real token of every segment: [B,T,D] -> [B,S,D] (packed
+    seq_last).  Empty slots zero."""
+    T = value.shape[1]
+    starts = _segment_starts_idx(seg_lengths)
+    idx = jnp.clip(starts + jnp.maximum(seg_lengths, 1) - 1, 0, T - 1)
+    out = jnp.take_along_axis(value, idx[..., None], axis=1)
+    return out * segment_valid(seg_lengths)[..., None].astype(out.dtype)
+
+
+def segment_first(value, seg_lengths):
+    """First token of every segment: [B,T,D] -> [B,S,D] (packed
+    seq_first)."""
+    T = value.shape[1]
+    idx = jnp.clip(_segment_starts_idx(seg_lengths), 0, T - 1)
+    out = jnp.take_along_axis(value, idx[..., None], axis=1)
+    return out * segment_valid(seg_lengths)[..., None].astype(out.dtype)
+
+
+def segment_expand(vec, seg_ids, mask):
+    """Broadcast a per-SEGMENT [B,S,D] vector back over the packed token
+    axis: -> [B,T,D], padding zeroed (packed seq_expand)."""
+    S = vec.shape[1]
+    idx = jnp.clip(seg_ids, 0, S - 1)[..., None]
+    out = jnp.take_along_axis(vec, idx, axis=1)
     return _masked(out, mask)
 
 

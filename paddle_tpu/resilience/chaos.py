@@ -65,6 +65,9 @@ __all__ = [
     "truncate_file",
     "corrupt_checkpoint",
     "corrupt_latest_checkpoint",
+    "corrupt_shard",
+    "truncate_shard",
+    "slow_shard",
     "kill_mid_journal_write",
     "nan_feed",
     "inject_nan_batches",
@@ -192,6 +195,64 @@ def corrupt_latest_checkpoint(save_dir: str, *, target: str = "params.npz",
     d = pass_dir(save_dir, p)
     corrupt_checkpoint(d, target=target, mode=mode)
     return d
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline faults (indexed record shards, paddle_tpu/datapipe —
+# docs/data.md).  The corruption model CRC-per-record + footer-index
+# validation must catch: a read of a damaged record raises a typed
+# ShardCorruptError naming the shard file and record index, and a
+# ShardSource(skip_corrupt=True) skips-and-counts it (dropped_records).
+# ---------------------------------------------------------------------------
+
+
+def _shard_files(root: str):
+    names = sorted(n for n in os.listdir(root) if n.endswith(".ptshard"))
+    if not names:
+        raise ValueError(f"no .ptshard files under {root!r}")
+    return [os.path.join(root, n) for n in names]
+
+
+def corrupt_shard(root: str, *, shard: int = 0,
+                  record: Optional[int] = None) -> str:
+    """Bit-flip one RECORD's payload in place (``record=None`` flips the
+    middle of the file — which still lands inside some record's bytes).
+    The next CRC-validated read of that record must raise a typed
+    ``ShardCorruptError`` naming the file and record index.  Returns the
+    damaged path."""
+    path = _shard_files(root)[shard]
+    if record is None:
+        corrupt_file(path)
+        return path
+    from paddle_tpu.datapipe.shards import ShardReader
+
+    r = ShardReader(path)
+    try:
+        off = int(r._offsets[record])
+    finally:
+        r.close()
+    # skip the 8-byte record header so the LENGTH stays sane and the
+    # failure is a clean payload-CRC mismatch at exactly this record
+    corrupt_file(path, offset=off + 8, nbytes=8)
+    return path
+
+
+def truncate_shard(root: str, *, shard: int = 0, frac: float = 0.5) -> str:
+    """Cut a shard file (torn-write / full-disk model): the footer and
+    index are gone, so OPENING the shard must fail with a typed
+    ShardCorruptError — never a silent short read."""
+    path = _shard_files(root)[shard]
+    truncate_file(path, frac=frac)
+    return path
+
+
+def slow_shard(source, *, delay_s: float = 0.05) -> None:
+    """Pace every record read of a ShardSource/ShardDataset by
+    ``delay_s`` — the cold-NFS / throttled-object-store model: the
+    timeline's ``data_wait`` share must inflate (and ``--prefetch_depth``
+    must hide it), never a hang."""
+    ds = getattr(source, "dataset", source)
+    ds._read_delay = float(delay_s)
 
 
 # ---------------------------------------------------------------------------

@@ -243,6 +243,17 @@ class SGDTrainer:
         self._journal = None
         self._profiler = None
         self._prefetcher = None
+        # checkpointable data source (paddle_tpu/datapipe; docs/data.md):
+        # bound per train() call when the reader carries the cursor
+        # protocol — its cursor rides checkpoint manifests so resume
+        # restores it instead of replaying the pass
+        self._data_source = None
+        self._pending_cursor = None
+        self._source_resharded = False
+        #: batches re-read-and-discarded by the fast-forward fallback —
+        #: ZERO whenever the source is a datapipe iterator (pinned by
+        #: tests/test_datapipe.py)
+        self.resume_replayed_batches = 0
         # request-level tracing (obs/trace.py): each batch becomes a
         # step-span trace with the timeline phases as children; bound per
         # train() call like the journal
@@ -766,6 +777,18 @@ class SGDTrainer:
         if profiler is not None:
             profiler.install_signal()
         resume = resume or FLAGS.resume or None
+        # checkpointable data source (docs/data.md): a reader carrying the
+        # cursor protocol gets cursor-based resume/resize instead of the
+        # O(pass) re-read-and-discard fast-forward
+        from paddle_tpu.datapipe import is_checkpointable_source
+
+        src = reader if is_checkpointable_source(reader) else None
+        self._data_source = src
+        self._pending_cursor = None
+        if (src is not None and getattr(src, "shard_by_gang", False)
+                and gang is not None and gang.size > 1):
+            ranks = sorted(int(r) for r in gang.ranks)
+            src.bind_world(len(ranks), ranks.index(gang.rank))
         start_pass, start_batch = FLAGS.start_pass, 0
         if resume is not None and resume != "auto":
             raise ValueError(f"resume must be None or 'auto', got {resume!r}")
@@ -777,6 +800,14 @@ class SGDTrainer:
             start_pass, start_batch = self._gang_join(gang)
         elif resume == "auto":
             start_pass, start_batch = self._auto_resume()
+        cursor_restored = False
+        if src is not None and self._pending_cursor is not None:
+            # O(1) resume: point the source at the saved cursor — the
+            # fast-forward loop below is skipped entirely (ZERO re-read
+            # samples); it survives only as the plain-reader fallback
+            src.restore(self._pending_cursor)
+            cursor_restored = True
+            self._pending_cursor = None
         if (preemption is None and FLAGS.save_dir
                 and FLAGS.checkpoint_on_preemption):
             preemption = PreemptionHandler()
@@ -813,13 +844,24 @@ class SGDTrainer:
                         f"{type(e).__name__}: {e}")
 
                 try:
+                    if src is not None:
+                        src.seek(pass_id)
                     it = iter(reader())
                 except Exception as e:
                     raise _reader_failed(e) from e
                 self._prefetcher = None
                 skip = start_batch if pass_id == start_pass else 0
-                if skip:
-                    logger.info("resuming pass %d at batch %d", pass_id, skip)
+                first_batch = 0
+                if skip and cursor_restored:
+                    # the restored cursor already points at this batch:
+                    # batch numbering continues, nothing is re-read
+                    first_batch, skip = skip, 0
+                    logger.info("resuming pass %d at batch %d from the "
+                                "data cursor (no replay)", pass_id,
+                                first_batch)
+                elif skip:
+                    logger.info("resuming pass %d at batch %d "
+                                "(fast-forward fallback)", pass_id, skip)
 
                 def _wrap_prefetch():
                     # double-buffered async feeding (--prefetch_depth):
@@ -848,7 +890,7 @@ class SGDTrainer:
 
                 if not skip:
                     _wrap_prefetch()
-                batch_id = 0
+                batch_id = first_batch
                 while True:
                     if tracer.enabled and not skip \
                             and self._step_span is None:
@@ -874,6 +916,22 @@ class SGDTrainer:
                         if world is not None:
                             self._gang_resize(gang, world, pass_id,
                                               batch_id + skip, handler)
+                            if self._source_resharded:
+                                # the source re-split the permutation for
+                                # the new world: drop the old split's
+                                # read-ahead and re-enter the pass at the
+                                # same batch boundary.  The reshard
+                                # positioned the cursor at batch_id+skip,
+                                # so any remaining fast-forward (a
+                                # datapipe source resuming without a
+                                # manifest cursor) is cancelled — the
+                                # skip loop would otherwise discard
+                                # never-trained batches
+                                self._source_resharded = False
+                                self._close_prefetcher()
+                                batch_id, skip = batch_id + skip, 0
+                                it = iter(reader())
+                                _wrap_prefetch()
                     if preemption is not None and preemption.poll():
                         if self._step_span is not None:
                             # a preempted step is an incident: keep it
@@ -910,9 +968,12 @@ class SGDTrainer:
                     if skip:
                         # fast-forward a deterministic reader to the batch
                         # the preemption checkpoint recorded (raw items —
-                        # the prefetcher attaches once the skip is done)
+                        # the prefetcher attaches once the skip is done).
+                        # Plain-reader FALLBACK only: a datapipe source
+                        # resumes by cursor and never enters this branch
                         skip -= 1
                         batch_id += 1
+                        self.resume_replayed_batches += 1
                         if not skip:
                             _wrap_prefetch()
                         continue
@@ -961,6 +1022,15 @@ class SGDTrainer:
                         # trace (None) is not retried per batch
                         tl.set_flops(self.step_flops(feed))
                         tl.recompute_mfu()
+                    if src is not None:
+                        # corrupt shard records the source skipped under
+                        # its skip-and-count policy (datapipe/iterator.py)
+                        # — surfaced next to the step extras like
+                        # dropped_features
+                        self._last_extras = {
+                            **self._last_extras,
+                            "dropped_records":
+                                int(getattr(src, "dropped_records", 0))}
                     drops = getattr(feeder, "dropped_features", None)
                     if drops is not None:
                         # sparse-bag truncation is a data-loss event, not a
@@ -1155,6 +1225,15 @@ class SGDTrainer:
                     name="resume")
             gang.ack_resize()
         self._mesh_resize()
+        src = getattr(self, "_data_source", None)
+        if src is not None and getattr(src, "shard_by_gang", False):
+            # re-split the SAME permutation from the committed boundary
+            # under the new membership: the commit above recorded the
+            # cursor under the OLD world, so no sample is duplicated or
+            # dropped (datapipe/iterator.py; pinned by test)
+            src.reshard(len(new_ranks), new_ranks.index(gang.rank),
+                        pass_id=start[0], next_batch=start[1])
+            self._source_resharded = True
         self._resize_count += 1
         self._last_resize_reason = world.get("reason")
         self._obs_counters["resizes"].inc()
@@ -1460,6 +1539,22 @@ class SGDTrainer:
             return pass_dir(save_dir, pass_id)
         meta = dict(meta or {})
         meta.setdefault("rng_key", self._rng_to_list(self._rng))
+        src = getattr(self, "_data_source", None)
+        if src is not None and "data_cursor" not in meta:
+            # the input-pipeline cursor rides the manifest: a mid-pass
+            # checkpoint records (pass, next_batch) -> the source derives
+            # its O(1) cursor ARITHMETICALLY from the stepped-batch count
+            # (prefetch read-ahead can never leak in); an end-of-pass
+            # checkpoint records the next pass's start
+            try:
+                if meta.get("preempted"):
+                    cur = src.cursor_for(pass_id,
+                                         int(meta.get("next_batch", 0)))
+                else:
+                    cur = src.cursor_for(pass_id + 1, 0)
+                meta["data_cursor"] = cur
+            except Exception as e:  # noqa: BLE001 — never fail a save
+                logger.warning("data cursor not recorded: %s", e)
         if self.mesh_config is not None:
             # record the world shape the state was saved under, so a
             # restore onto a different world can attribute the reshard
@@ -1523,6 +1618,9 @@ class SGDTrainer:
         rng_key = (manifest.get("meta") or {}).get("rng_key")
         if rng_key is not None:
             self._rng = jnp.asarray(np.asarray(rng_key, np.uint32))
+        # input-pipeline cursor (docs/data.md): stashed for train() to
+        # hand to a checkpointable source instead of fast-forwarding
+        self._pending_cursor = (manifest.get("meta") or {}).get("data_cursor")
         if self.mesh is not None:
             self._place_sharded()
         self.rebuild_masks()

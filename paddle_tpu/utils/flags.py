@@ -308,6 +308,23 @@ define_flag("serve_slots", 8, "serving: decode slot capacity of the "
             "beams; also the admission row bound in generation mode)",
             validator=lambda v: v >= 1)
 
+# Deterministic sharded data pipeline (paddle_tpu/datapipe; docs/data.md)
+define_flag("data_pack", False, "sequence packing: several short "
+            "sequences share one padded row (segment ids + position "
+            "offsets plumbed through masking, the RNN carries, and the "
+            "sequence losses) — crushes the pad-waste that keeps "
+            "pad-heavy textclf/LSTM workloads MFU-starved; packed loss "
+            "matches the unpacked oracle on the same samples (pinned)")
+define_flag("data_shards", 8, "shard count for `python -m paddle_tpu "
+            "data pack` (indexed record shards with per-record CRCs and "
+            "a footer index; the shard set publishes atomically)",
+            validator=lambda v: v >= 1)
+define_flag("shuffle_seed", 0, "seed of the datapipe's deterministic "
+            "global shuffle: each pass's record order is a permutation "
+            "drawn from (seed, pass) and split per host — the whole "
+            "shuffle state is this one integer, which is what makes the "
+            "iterator cursor O(1) and restorable")
+
 # Parallelism (replaces trainer_count, pservers, ports_num, nics, rdma_tcp ...)
 define_flag("mesh_shape", "", "device mesh, e.g. '8' or '4x2' (empty = all devices, 1D)")
 define_flag("mesh_axes", "data", "comma-separated mesh axis names, e.g. 'data,model'")

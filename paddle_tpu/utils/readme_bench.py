@@ -44,6 +44,7 @@ _UNITS = [
     ("googlenet", "ms/batch"),
     ("pallas_", "ms (best variant)"),
     ("amp_ab", "ms (amp step; vs = ×f32)"),
+    ("seq_packing_ab", "samples/s (packed; vs = ×bucketed)"),
     ("serving_continuous_ab", "tok/s (continuous; vs = ×bucket)"),
     ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
     ("cold_start_ab", "s (warm boot; vs = ×cold)"),

@@ -343,6 +343,99 @@ def test_cli_obs_dump_summarizes_and_empty_exits_2(tmp_path, capsys):
     assert "no journal records" in capsys.readouterr().err
 
 
+def test_cli_data_pack_and_verify_roundtrip(tmp_path, capsys):
+    """`python -m paddle_tpu data pack|verify` (docs/data.md): pack a
+    module:callable reader into shards, verify passes; corruption makes
+    verify exit 2 naming the shard file and record index."""
+    from paddle_tpu.resilience import chaos
+
+    out = tmp_path / "shards"
+    rc = main(["data", "pack", str(out),
+               "--reader", "tests.test_cli:_sample_reader",
+               "--shards", "2"])
+    assert rc == 0
+    assert "packed 11 record(s) into 2 shard(s)" in capsys.readouterr().out
+    assert (out / "manifest.json").exists()
+
+    assert main(["data", "verify", str(out)]) == 0
+    assert "11 record(s)" in capsys.readouterr().out
+
+    path = chaos.corrupt_shard(str(out), shard=0, record=1)
+    assert main(["data", "verify", str(out)]) == 2
+    err = capsys.readouterr().err
+    assert "verify FAILED" in err and os.path.basename(path) in err
+
+
+def test_cli_data_pack_from_config_unbatches(tmp_path, capsys):
+    """`data pack --config CONF.py` drains the config's BATCH reader as
+    samples (96 mnist rows, not 3 batch objects)."""
+    out = tmp_path / "mshards"
+    rc = main(["data", "pack", str(out), f"--config={CONF}",
+               "--limit", "40"])
+    assert rc == 0
+    assert "packed 40 record(s)" in capsys.readouterr().out
+    from paddle_tpu.datapipe import ShardDataset
+
+    ds = ShardDataset(str(out))
+    assert len(ds) == 40
+    pixel, label = ds.read(0)
+    assert np.asarray(pixel).size >= 784
+
+
+def test_cli_help_lists_data_flags(capsys):
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "python -m paddle_tpu data" in out
+    for flag in ("--data_pack", "--data_shards", "--shuffle_seed"):
+        assert flag in out, flag
+
+
+def _sample_reader():
+    return iter([([i, i + 1], i % 2) for i in range(11)])
+
+
+TEXTCLF_CONF = '''
+import numpy as np
+import paddle_tpu.data as data
+import paddle_tpu.models as models
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+
+
+def get_config():
+    nn.reset_naming()
+    cost, _ = models.lstm_benchmark_net(40, emb_dim=8, hid_dim=16,
+                                        num_layers=1)
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(1, 40, rs.randint(2, 9)).tolist(),
+                int(rs.randint(0, 2))) for _ in range(64)]
+    return {
+        "cost": cost,
+        "optimizer": Adam(learning_rate=1e-3),
+        "reader": data.batch(lambda: iter(samples), 16),
+        # eval rides the SAME (packed) feeder: --data_pack must pack it
+        "test_reader": data.batch(lambda: iter(samples[:32]), 16),
+        "feeder": data.DataFeeder({"words": "ids_seq", "label": "int"}),
+    }
+'''
+
+
+def test_cli_train_with_data_pack(tmp_path):
+    """--data_pack re-plumbs the config's reader+feeder into packed rows
+    (the auto_pack wiring); a config without an ids_seq slot gets a
+    typed ConfigError instead of wrong training."""
+    conf = tmp_path / "textclf.py"
+    conf.write_text(TEXTCLF_CONF)
+    rc = main([f"--config={conf}", "--job=train", "--num_passes=1",
+               "--data_pack", "--log_period=0"])
+    assert rc == 0
+    FLAGS.data_pack = False
+    with pytest.raises(ConfigError, match="ids_seq"):
+        main([f"--config={CONF}", "--job=train", "--num_passes=1",
+              "--data_pack", "--log_period=0"])
+    FLAGS.data_pack = False
+
+
 def test_cli_rejects_bad_args():
     with pytest.raises(ConfigError, match="unrecognized"):
         main([f"--config={CONF}", "--job=train", "--no_such_flag=1"])

@@ -1355,6 +1355,74 @@ def bench_sharded_embedding_ab(rtt, peak):
     }
 
 
+def bench_sdc_overhead_ab(rtt, peak):
+    """A/B the SDC firewall's in-step state fingerprint
+    (resilience/integrity.py, --sdc_check_every) on the LSTM text-clf
+    shape: the checked arm folds params + optimizer slots into the u64
+    digest INSIDE every compiled step (the worst-case cadence — the
+    trainer only reads/exchanges it every N batches, so real overhead is
+    at most this row's), the off arm is the plain step.  The fingerprint
+    rides the fori_loop carry so XLA cannot dead-code it.
+    ``vs_baseline`` = off_ms / checked_ms (1.0 = free; <1 = the check
+    costs).  ``winner`` is 'on' when the overhead stays under 2% — the
+    firewall should be affordable at any cadence."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import lstm_benchmark_net
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.resilience.integrity import tree_fingerprint
+    from paddle_tpu.utils.flags import FLAGS
+
+    VOCAB, B, T, HID, EMB = 30000, 64, 100, 256, 128
+
+    def build(check: bool):
+        nn.reset_naming()
+        cost, _ = lstm_benchmark_net(VOCAB, emb_dim=EMB, hid_dim=HID,
+                                     num_layers=2)
+        rng = np.random.RandomState(0)
+        feeds = {
+            "words": (jnp.asarray(
+                rng.randint(3, VOCAB, (B, T)).astype(np.int32)),
+                jnp.asarray(
+                    rng.randint(T // 2, T + 1, B).astype(np.int32))),
+            "label": jnp.asarray(rng.randint(0, 2, (B, 1))),
+        }
+        base_step, base_carry = _topology_step(
+            cost, Adam(learning_rate=1e-3), feeds)
+
+        def one_step(carry):
+            inner, fp = carry
+            inner, loss = base_step(inner)
+            if check:
+                params, _, opt_state, _ = inner
+                fp = tree_fingerprint({"p": params, "o": opt_state})
+            return (inner, fp), loss
+
+        fp0 = jnp.zeros((2,), jnp.uint32)
+        return one_step, (base_carry, fp0)
+
+    step_off, carry_off = build(False)
+    sec_off, flops, _ = _time_chain(step_off, carry_off, iters=20, rtt=rtt)
+    step_on, carry_on = build(True)
+    sec_on, _, _ = _time_chain(step_on, carry_on, iters=20, rtt=rtt)
+    overhead = sec_on / sec_off - 1.0
+    winner = "on" if overhead < 0.02 else "off"
+    return {
+        "metric": f"sdc_overhead_ab_ms(b{B},h{HID},fp_every_step)",
+        "short": "sdc_overhead_ab",
+        "value": round(sec_on * 1e3, 3),
+        "unit": "ms",
+        "mfu": _mfu(sec_on, flops, peak),
+        "vs_baseline": round(sec_off / sec_on, 3),
+        "off_ms": round(sec_off * 1e3, 3),
+        "overhead_pct": round(overhead * 100.0, 2),
+        "winner": winner,
+        "default_flag": FLAGS.sdc_check_every > 0,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1405,6 +1473,7 @@ def main() -> None:
         safe(bench_sharded_embedding_ab),
         safe(bench_cold_start_ab),
         safe(bench_trace_overhead_ab),
+        safe(bench_sdc_overhead_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

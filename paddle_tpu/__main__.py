@@ -215,6 +215,7 @@ usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [
        python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
        python -m paddle_tpu obs {merge|dump|trace} DIR_OR_FILE... [--format text|json|perfetto]
        python -m paddle_tpu data {pack|verify} ... (indexed record shards, docs/data.md)
+       python -m paddle_tpu fsck DIR_OR_BUNDLE... [--quarantine] (at-rest integrity scrub, docs/resilience.md)
 
 The paddle_trainer CLI analog.  CONF.py defines get_config() (see the
 module docstring of paddle_tpu/__main__.py).  `serve` runs the
@@ -252,6 +253,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.datapipe.cli import run as data_run
 
         return data_run(argv[1:])
+    if argv and argv[0] == "fsck":
+        # the SDC firewall's at-rest scrub (docs/resilience.md "Silent
+        # corruption"): re-hash checkpoint chains, pserver snapshots, and
+        # deploy bundles; exit 0 clean / 2 with corrupt members named
+        from paddle_tpu.resilience.integrity import run_fsck
+
+        return run_fsck(argv[1:])
     if "-h" in argv or "--help" in argv:
         # also covers `serve --help`: the serve knobs are registered
         # --serve_* flags, so the global table IS its help surface (only

@@ -275,6 +275,13 @@ def run(argv: Optional[List[str]] = None) -> int:
                         "telemetry-off trace; also pins the train step "
                         "and decode_step identical with request tracing "
                         "armed (spans add zero compiled equations)")
+    p.add_argument("--sdc", action="store_true",
+                   help="audit the SDC-firewall contract: the compiled "
+                        "step with --sdc_check_every=0 must be "
+                        "equation-identical to a never-enabled build, "
+                        "and the in-jit state fingerprint (check on) "
+                        "must audit host-transfer-free "
+                        "(docs/resilience.md 'Silent corruption')")
     p.add_argument("--amp", action="store_true",
                    help="audit the mixed-precision contract: the compiled "
                         "--amp train step (forward + backward + loss "
@@ -305,7 +312,7 @@ def run(argv: Optional[List[str]] = None) -> int:
     configs = list(ns.config)
     if (not targets and not configs and ns.decode is None
             and ns.pserver is None and not ns.serve and not ns.obs
-            and not ns.amp and not ns.deploy):
+            and not ns.amp and not ns.deploy and not ns.sdc):
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -334,6 +341,10 @@ def run(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.analysis.amp_audit import audit_amp_step
 
         findings.extend(audit_amp_step())
+    if ns.sdc:
+        from paddle_tpu.resilience.integrity import audit_sdc_step
+
+        findings.extend(audit_sdc_step())
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
     if ns.serve:

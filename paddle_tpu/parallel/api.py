@@ -20,7 +20,38 @@ from paddle_tpu.parallel.mesh import as_mesh
 from paddle_tpu.parallel.sharding import ShardingRules, batch_sharding, replicated
 from paddle_tpu.param.optimizers import Optimizer
 
-__all__ = ["make_parallel_train_step", "shard_batch"]
+__all__ = ["make_parallel_train_step", "shard_batch", "agreement_spec"]
+
+
+def agreement_spec(mesh, axis: Optional[str] = None):
+    """Resolve the mesh + axis the cross-replica agreement collective
+    (resilience/integrity.py) runs over: ``(built_mesh, axis_name,
+    replica_count)``.
+
+    ``mesh`` may be a ``Mesh`` or a ``parallel.MeshConfig``; ``axis``
+    defaults to the config's DATA-role axis (the replica axis of
+    data-parallel training — the one whose members are bit-identical by
+    construction and therefore comparable).  A missing or size-1 axis is
+    a config error: agreement over one replica compares nothing."""
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.utils.error import ConfigError
+
+    if isinstance(mesh, MeshConfig):
+        name = axis or mesh.role_axis("data")
+        built = mesh.build()
+    else:
+        built = mesh
+        name = axis or "data"
+    if name not in built.axis_names:
+        raise ConfigError(
+            f"agreement axis {name!r} not in mesh axes "
+            f"{tuple(built.axis_names)}")
+    n = int(built.shape[name])
+    if n < 2:
+        raise ConfigError(
+            f"agreement over axis {name!r} needs >=2 replicas, mesh has "
+            f"{n} — nothing to compare")
+    return built, name, n
 
 
 def shard_batch(mesh, feed: Dict[str, Any], axis: str = "data") -> Dict[str, Any]:

@@ -45,8 +45,8 @@ from paddle_tpu.pserver.table import TableSpec, init_shard_rows
 from paddle_tpu.utils import logger
 
 __all__ = ["SnapshotError", "save_table_snapshot", "validate_snapshot",
-           "latest_snapshot", "load_table_host", "TableReader",
-           "snap_dir"]
+           "quarantine_snapshot", "latest_snapshot", "load_table_host",
+           "TableReader", "snap_dir"]
 
 SNAPSHOT_VERSION = 1
 
@@ -94,10 +94,20 @@ def save_table_snapshot(save_dir: str, spec: TableSpec, data, dirty,
             fpath = os.path.join(tmp, fname)
             np.savez_compressed(fpath, ids=ids_global, rows=rows)
             _fsync_file(fpath)
+            # fp64: the SDC-grade 64-bit fold (resilience/integrity.py)
+            # alongside the CRCs — an independent second detector, so an
+            # at-rest scrub's miss probability is ~2^-96, and the same
+            # digest family the trainer's cross-replica agreement check
+            # uses covers shard snapshots too
+            from paddle_tpu.resilience.integrity import (fingerprint_int,
+                                                         np_tree_fingerprint)
+
             files[fname] = {
                 "rows": int(ids_global.size),
                 "crc_ids": _crc(ids_global),
                 "crc_rows": _crc(rows),
+                "fp64": fingerprint_int(np_tree_fingerprint(
+                    {"ids": ids_global, "rows": rows})),
             }
             total += int(ids_global.size)
         manifest = {
@@ -143,6 +153,11 @@ def validate_snapshot(d: str) -> Optional[str]:
     (the string a raised SnapshotError carries)."""
     if not os.path.isdir(d):
         return "not a directory"
+    from paddle_tpu.resilience.checkpoint_io import quarantine_reason
+
+    q = quarantine_reason(d)
+    if q is not None:
+        return q
     try:
         manifest = read_snapshot_manifest(d)
     except FileNotFoundError:
@@ -162,7 +177,27 @@ def validate_snapshot(d: str) -> Optional[str]:
             return f"{fname}:ids CRC mismatch"
         if _crc(rows) != info.get("crc_rows"):
             return f"{fname}:rows CRC mismatch"
+        if "fp64" in info:
+            from paddle_tpu.resilience.integrity import (
+                fingerprint_int, np_tree_fingerprint)
+
+            got = fingerprint_int(np_tree_fingerprint(
+                {"ids": np.asarray(ids), "rows": np.asarray(rows)}))
+            if got != info["fp64"]:
+                return (f"{fname}:rows fp64 mismatch "
+                        f"({got:#018x} != {info['fp64']:#018x})")
     return None
+
+
+def quarantine_snapshot(d: str, reason: str) -> None:
+    """Scrubber hook (resilience/integrity.py): refuse this snapshot from
+    now on — ``validate_snapshot`` fails it, so ``latest_snapshot`` /
+    ``valid_chain_tip`` demote the chain to its predecessor — while the
+    payload stays on disk for forensics.  Shares the checkpoint tier's
+    marker protocol (one write path, one read path)."""
+    from paddle_tpu.resilience.checkpoint_io import quarantine_checkpoint
+
+    quarantine_checkpoint(d, reason)
 
 
 def valid_chain_tip(save_dir: str) -> int:

@@ -29,7 +29,8 @@ tier of the trainer survivable (docs/resilience.md):
 
 from paddle_tpu.resilience.errors import (CheckpointError, GangError,
                                           GangFailedError, GangResized,
-                                          ReaderError, TooManyBadSteps)
+                                          ReaderError, SDCDivergence,
+                                          TooManyBadSteps)
 from paddle_tpu.resilience.cluster import (GangContext, GangResult,
                                            GangSupervisor, RankReport,
                                            current_gang)
@@ -47,6 +48,13 @@ from paddle_tpu.resilience.checkpoint_io import (MANIFEST_VERSION,
 from paddle_tpu.resilience.guard import (global_grad_norm, guarded_update,
                                          init_loss_scale,
                                          scaled_guarded_update)
+from paddle_tpu.resilience.integrity import (ScrubDaemon, fingerprint_hex,
+                                             fingerprint_int,
+                                             latest_verified_pass,
+                                             make_agreement_check,
+                                             np_tree_fingerprint,
+                                             scrub_paths, sdc_vote,
+                                             tree_fingerprint)
 from paddle_tpu.resilience.reader import resilient_reader
 from paddle_tpu.resilience.signals import PreemptionHandler
 from paddle_tpu.resilience import chaos
@@ -82,4 +90,14 @@ __all__ = [
     "resilient_reader",
     "PreemptionHandler",
     "chaos",
+    "SDCDivergence",
+    "tree_fingerprint",
+    "np_tree_fingerprint",
+    "fingerprint_int",
+    "fingerprint_hex",
+    "sdc_vote",
+    "make_agreement_check",
+    "scrub_paths",
+    "latest_verified_pass",
+    "ScrubDaemon",
 ]

@@ -69,6 +69,9 @@ __all__ = [
     "truncate_shard",
     "slow_shard",
     "kill_mid_journal_write",
+    "flip_param_bit",
+    "flip_param_bit_at",
+    "flip_shard_row",
     "nan_feed",
     "inject_nan_batches",
     "flaky_reader",
@@ -253,6 +256,103 @@ def slow_shard(source, *, delay_s: float = 0.05) -> None:
     must hide it), never a hang."""
     ds = getattr(source, "dataset", source)
     ds._read_delay = float(delay_s)
+
+
+# ---------------------------------------------------------------------------
+# silent-data-corruption faults (resilience/integrity.py — docs/
+# resilience.md "Silent corruption").  The fault the cross-replica
+# agreement check exists to catch: ONE bit of ONE replica's live state
+# flips in memory, no error raised, no CRC to fail — training marches on
+# wrong.  In-process between batches, exactly the flaky-core/DMA model.
+# ---------------------------------------------------------------------------
+
+
+def _flip_bit(arr: np.ndarray, *, index: int, bit: int) -> np.ndarray:
+    """XOR bit ``bit`` of flat element ``index`` (a copy is returned).
+    ``bit`` indexes within the element little-endian — for f32, bit 20
+    is a high mantissa bit (~12% relative change: decisive for the
+    fingerprint AND for the loss, but finite, so the bad-step guard
+    cannot mask the fault by skipping)."""
+    out = np.ascontiguousarray(arr).copy()
+    itemsize = out.dtype.itemsize
+    if not 0 <= bit < itemsize * 8:
+        raise ValueError(f"bit {bit} outside a {itemsize * 8}-bit element")
+    flat = out.view(np.uint8).reshape(-1)
+    flat[index * itemsize + bit // 8] ^= np.uint8(1 << (bit % 8))
+    return out
+
+
+def flip_param_bit(trainer, *, leaf: Optional[str] = None, index: int = 0,
+                   bit: int = 20) -> str:
+    """XOR one bit of one parameter leaf of THIS rank's live state,
+    in-process — the silent corruption no storage CRC will ever see.
+    ``leaf`` defaults to the first parameter in sorted order.  The
+    corrupted array is placed back under the trainer's own sharding, so
+    the next compiled step consumes it exactly as it would the genuine
+    value.  Returns a description of the flip."""
+    import jax
+    import jax.numpy as jnp
+
+    names = sorted(trainer.params)
+    name = leaf if leaf is not None else names[0]
+    corrupted = _flip_bit(np.asarray(trainer.params[name]),
+                          index=index, bit=bit)
+    new = jnp.asarray(corrupted)
+    if trainer.mesh is not None:
+        new = jax.device_put(new, trainer._param_shardings()[name])
+    trainer.params[name] = new
+    return f"{name}[{index}] bit {bit}"
+
+
+def flip_param_bit_at(trainer, *, batch: int, pass_id: int = 0,
+                      marker: str, leaf: Optional[str] = None,
+                      index: int = 0, bit: int = 20,
+                      inner: Optional[Callable] = None) -> Callable:
+    """Worker-side event handler: flip the bit when batch ``batch`` of
+    pass ``pass_id`` BEGINS (between batches — the state was clean for
+    every step before, corrupt for every step after), marker-file guarded
+    like ``die_at`` so a relaunched/replacement incarnation trains
+    clean."""
+    from paddle_tpu.trainer import events as ev
+
+    def event_handler(e):
+        if (isinstance(e, ev.BeginIteration) and e.pass_id == pass_id
+                and e.batch_id == batch and not os.path.exists(marker)):
+            desc = flip_param_bit(trainer, leaf=leaf, index=index, bit=bit)
+            with open(marker, "w") as f:
+                f.write(desc + "\n")
+        if inner is not None:
+            inner(e)
+
+    return event_handler
+
+
+def flip_shard_row(table, *, row: int = 0, col: int = 0,
+                   bit: int = 20) -> str:
+    """Perturb one row of a live pserver table (anything carrying a
+    ``.data`` array — a ``pserver.Table`` or the tier's table entry):
+    the sharded-state flavor of ``flip_param_bit``.  The flip lands back
+    under the array's own sharding; detection rides the same in-step
+    fingerprint (pserver tables are folded into ``sdc_fp``) and, at
+    rest, the snapshot manifests' fp64 digests."""
+    import jax
+    import jax.numpy as jnp
+
+    data = table.data if hasattr(table, "data") else table
+    arr = np.asarray(data)
+    index = row * arr.shape[1] + col if arr.ndim >= 2 else row
+    corrupted = _flip_bit(arr, index=index, bit=bit)
+    new = jnp.asarray(corrupted)
+    sharding = getattr(data, "sharding", None)
+    if sharding is not None:
+        try:
+            new = jax.device_put(new, sharding)
+        except Exception:  # single-device/host arrays: placement is moot
+            pass
+    if hasattr(table, "data"):
+        table.data = new
+        return f"table row {row} col {col} bit {bit}"
+    return f"array[{index}] bit {bit}"
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,7 @@ from paddle_tpu.utils import FLAGS, logger
 
 __all__ = [
     "MANIFEST_VERSION",
+    "QUARANTINE_MARKER",
     "npz_safe",
     "save_pytree",
     "load_pytree",
@@ -47,6 +48,9 @@ __all__ = [
     "load_checkpoint",
     "read_manifest",
     "validate_checkpoint",
+    "quarantine_checkpoint",
+    "quarantine_reason",
+    "failing_member",
     "latest_pass",
     "latest_valid_pass",
     "prune_checkpoints",
@@ -61,6 +65,12 @@ MANIFEST_VERSION = 1
 _PASS_RE = re.compile(r"pass-(\d{5,})")
 
 _TMP_PREFIX = ".tmp-"
+
+# written by the SDC scrubber (resilience/integrity.py) into a dir whose
+# payload no longer re-hashes: validation refuses the dir from then on
+# (demoted out of latest_pass eligibility) while the forensic evidence
+# stays on disk for the postmortem
+QUARANTINE_MARKER = "QUARANTINED"
 
 # a temp dir younger than this is treated as an IN-FLIGHT save by a
 # concurrent writer and left alone by prune_checkpoints; older ones are
@@ -333,6 +343,9 @@ def validate_checkpoint(ckpt_dir: str, *, verify_crc: bool = True) -> Optional[s
     ``params.npz`` parses; they simply cannot be CRC-verified."""
     if not os.path.isdir(ckpt_dir):
         return "not a directory"
+    q = quarantine_reason(ckpt_dir)
+    if q is not None:
+        return q
     try:
         manifest = read_manifest(ckpt_dir)
     except FileNotFoundError:
@@ -374,6 +387,52 @@ def validate_checkpoint(ckpt_dir: str, *, verify_crc: bool = True) -> Optional[s
     return None
 
 
+def quarantine_checkpoint(ckpt_dir: str, reason: str) -> None:
+    """Drop ``ckpt_dir`` out of ``latest_pass`` eligibility without
+    destroying it: a marker file validation refuses from then on.  Used
+    by the at-rest scrubber (resilience/integrity.py) when a previously
+    valid checkpoint stops re-hashing.  The marker protocol is shared
+    with pserver snapshot dirs (``pserver.snapshot.quarantine_snapshot``
+    delegates here)."""
+    tmp = os.path.join(ckpt_dir,
+                       f".{QUARANTINE_MARKER}-{uuid.uuid4().hex[:8]}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"reason": reason, "time": time.time()}, f)
+        os.replace(tmp, os.path.join(ckpt_dir, QUARANTINE_MARKER))
+    except OSError as e:
+        logger.warning("could not quarantine %s: %s", ckpt_dir, e)
+
+
+def quarantine_reason(d: str) -> Optional[str]:
+    """The validation-reason string for a quarantined dir, or ``None``
+    when no marker is present — the read half of the shared marker
+    protocol."""
+    qpath = os.path.join(d, QUARANTINE_MARKER)
+    if not os.path.exists(qpath):
+        return None
+    try:
+        with open(qpath) as f:
+            why = json.load(f).get("reason", "")
+    except (OSError, json.JSONDecodeError, ValueError):
+        why = ""
+    return "quarantined by scrubber" + (f": {why}" if why else "")
+
+
+def failing_member(reason: str) -> str:
+    """Best-effort extraction of the file/member a validation reason
+    names ('params.npz:KEY CRC mismatch' -> 'params.npz'), for journal
+    records and fsck output; '' when no member is identifiable."""
+    if not reason:
+        return ""
+    toks = reason.split()
+    if toks[0] == "missing" and len(toks) > 1:
+        return toks[1]
+    if "." in toks[0]:
+        return toks[0].split(":", 1)[0]
+    return ""
+
+
 def latest_pass(save_dir: str, *, validate: bool = True) -> int:
     """Highest pass id with a VALID checkpoint under save_dir, or -1.
 
@@ -395,6 +454,13 @@ def latest_pass(save_dir: str, *, validate: bool = True) -> int:
             return pid
         logger.warning("skipping corrupt checkpoint %s: %s",
                        pass_dir(save_dir, pid), reason)
+        # not just a log line: postmortems (`obs merge`) must see WHEN a
+        # checkpoint went bad and which member failed, not merely that
+        # resume landed on an earlier pass (no-op without --obs_journal)
+        from paddle_tpu.obs import journal_event
+
+        journal_event("ckpt_quarantined", dir=pass_dir(save_dir, pid),
+                      member=failing_member(reason), reason=reason)
     return -1
 
 

@@ -261,6 +261,65 @@ class GangContext:
             self.heartbeat()
             time.sleep(_POLL_S)
 
+    # -- all-ranks exchange (the SDC fingerprint channel) ---------------
+
+    def exchange_json(self, obj: Any, *, name: str,
+                      timeout_s: Optional[float] = None) -> Dict[int, Any]:
+        """All-gather one small JSON payload across the CURRENT ranks:
+        publish this rank's value under ``name``, block (heartbeating)
+        until every live rank's value is visible, return ``{rank:
+        payload}``.  The cross-replica agreement channel of the SDC
+        firewall (resilience/integrity.py): the 8-byte state fingerprints
+        meet here every ``--sdc_check_every`` batches — the PARAMS never
+        leave the device, only their digest crosses this file protocol.
+
+        Names are epoch-namespaced like barriers, so a resized gang can
+        never rendezvous with a previous epoch's digests; a world publish
+        while waiting raises :class:`GangResized` (a peer died
+        mid-exchange — run the resize protocol, not the timeout)."""
+        stem = f"xchg-{name}-e{self.epoch:03d}-rank"
+        # retire THIS rank's file from two exchanges ago: entering round
+        # k implies every rank completed round k-1 (it is a rendezvous),
+        # which implies every rank finished READING round k-2 — so the
+        # k-2 file is dead and the gang dir stays O(world) files instead
+        # of growing by world_size per check (agree_preempt lists this
+        # directory at every batch boundary)
+        hist = getattr(self, "_xchg_history", None)
+        if hist is None:
+            hist = self._xchg_history = []
+        if len(hist) >= 2:
+            try:
+                os.remove(hist.pop(0))
+            except OSError:
+                pass
+        own = os.path.join(self.gang_dir, f"{stem}{self.rank}")
+        _atomic_write(own, json.dumps(obj))
+        hist.append(own)
+        deadline = time.monotonic() + (self.barrier_timeout_s
+                                       if timeout_s is None else timeout_s)
+        want = {r: os.path.join(self.gang_dir, f"{stem}{r}")
+                for r in self.ranks}
+        while True:
+            out: Dict[int, Any] = {}
+            for r, p in want.items():
+                try:
+                    with open(p) as f:
+                        out[r] = json.load(f)
+                except (FileNotFoundError, json.JSONDecodeError, OSError):
+                    break
+            if len(out) == len(want):
+                return out
+            if not self._resizing:
+                world = self.poll_world()
+                if world is not None:
+                    raise GangResized(world)
+            if time.monotonic() > deadline:
+                raise GangError(
+                    f"rank {self.rank}: exchange {name!r} (epoch "
+                    f"{self.epoch}) timed out — a peer likely died")
+            self.heartbeat()
+            time.sleep(_POLL_S)
+
     # -- preemption OR-reduce -------------------------------------------
 
     def agree_preempt(self, local: bool) -> bool:
@@ -379,6 +438,29 @@ class _JaxGang:
         flags = multihost_utils.process_allgather(
             np.asarray([bool(local)], dtype=np.bool_))
         return bool(np.any(flags))
+
+    def exchange_json(self, obj: Any, *, name: str,
+                      timeout_s: Optional[float] = None) -> Dict[int, Any]:
+        """DCN all-gather of one small JSON payload per process (the SDC
+        fingerprint channel on live pods); symmetric, every rank calls it
+        at the same batch boundary."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        cap = 256
+        raw = json.dumps(obj).encode()
+        if len(raw) > cap - 8:
+            raise GangError(f"exchange payload {name!r} exceeds {cap}B")
+        buf = np.zeros((cap,), np.uint8)
+        buf[:8] = np.frombuffer(len(raw).to_bytes(8, "little"), np.uint8)
+        buf[8:8 + len(raw)] = np.frombuffer(raw, np.uint8)
+        out = np.asarray(multihost_utils.process_allgather(buf))
+        out = out.reshape(self.size, cap)
+        result = {}
+        for r in range(self.size):
+            n = int.from_bytes(out[r, :8].tobytes(), "little")
+            result[r] = json.loads(out[r, 8:8 + n].tobytes().decode())
+        return result
 
     def broadcast_json(self, obj: Optional[Any], *, name: str = "decision",
                        timeout_s: Optional[float] = None) -> Any:
@@ -675,6 +757,24 @@ class GangSupervisor:
                         stale_s=age))
             if failed:
                 for f in failed:
+                    # a rank that exited because its state fingerprint
+                    # lost the cross-replica vote left a quarantine
+                    # marker (trainer._sdc_check) — fold the attribution
+                    # into the report so the shrink reason and the
+                    # journal both name the SDC, not a generic death
+                    marker = os.path.join(
+                        self.attempt_dir,
+                        f"sdc-quarantined-rank{f.rank}")
+                    if os.path.exists(marker):
+                        f.reason += " (sdc quarantine)"
+                        self._jrec("sdc_expel", fsync=True,
+                                   failed_rank=f.rank)
+                        try:  # consumed: a LATER unrelated death of the
+                              # same rank id (post grow-back) must not
+                              # re-read as an SDC expulsion
+                            os.remove(marker)
+                        except OSError:
+                            pass
                     # death/hang lands in the causal timeline BEFORE the
                     # decision it triggers (shrink vs relaunch fallback);
                     # `failed_rank` — the writer's own `rank` field must

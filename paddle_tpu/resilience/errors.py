@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 __all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps",
-           "GangError", "GangFailedError", "GangResized"]
+           "GangError", "GangFailedError", "GangResized", "SDCDivergence"]
 
 
 class CheckpointError(RuntimeError):
@@ -33,6 +33,16 @@ class TooManyBadSteps(RuntimeError):
     """The bad-step guard skipped ``max_bad_steps`` consecutive updates —
     the loss/gradients are persistently non-finite and continuing would
     only burn accelerator time."""
+
+
+class SDCDivergence(RuntimeError):
+    """This rank's in-jit state fingerprint lost the cross-replica vote
+    (resilience/integrity.py): its params/optimizer-slots differ from the
+    replicas that are bit-identical by construction — the silent-data-
+    corruption signature.  Raising it exits the rank nonzero so the
+    elastic supervisor expels (shrinks) it and heals the gang from a
+    verified checkpoint; the divergence itself is journaled and the
+    quarantine marker names this rank in the gang dir."""
 
 
 class GangError(RuntimeError):

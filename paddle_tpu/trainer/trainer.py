@@ -16,6 +16,7 @@ ring and the pserver tier; SURVEY.md §5.8).
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from contextlib import contextmanager
@@ -40,6 +41,52 @@ from paddle_tpu.trainer import events as ev
 from paddle_tpu.utils import FLAGS, logger
 
 __all__ = ["SGDTrainer"]
+
+#: consecutive SDC rollbacks a survivor tolerates before declaring the
+#: divergence persistent (a flaky host the vote cannot pin down) and
+#: aborting with the typed error instead of looping forever
+_SDC_MAX_ROLLBACKS = 4
+
+
+class _SdcRollback(Exception):
+    """Control flow, not a failure: the cross-replica vote found no
+    strict majority, this survivor restored the last verified checkpoint
+    (resilience/integrity.py), and the pass loop must re-enter at the
+    restored position.  ``cursor_ready`` marks a data source already
+    positioned (an elastic reshard mid-check) — no cursor restore or
+    fast-forward needed."""
+
+    def __init__(self, start_pass: int, start_batch: int, *,
+                 cursor_ready: bool = False) -> None:
+        super().__init__(f"sdc rollback to pass {start_pass} "
+                         f"batch {start_batch}")
+        self.start_pass = int(start_pass)
+        self.start_batch = int(start_batch)
+        self.cursor_ready = bool(cursor_ready)
+
+
+class _PassSchedule:
+    """Iterator over pass ids that an SDC rollback can REWIND: the pass
+    loop runs ``for pass_id in schedule`` and a rollback sets the next
+    yielded pass back to the restored checkpoint's — the loop body stays
+    exactly the straight-line resume machinery it already was."""
+
+    def __init__(self, start: int, stop: int) -> None:
+        self.next_pass = int(start)
+        self.stop = int(stop)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        if self.next_pass >= self.stop:
+            raise StopIteration
+        p = self.next_pass
+        self.next_pass += 1
+        return p
+
+    def rewind(self, pass_id: int) -> None:
+        self.next_pass = int(pass_id)
 
 
 class SGDTrainer:
@@ -218,6 +265,25 @@ class SGDTrainer:
         # for supervised serving replicas, healthz())
         self._resize_count = 0
         self._last_resize_reason: Optional[str] = None
+        # silent-data-corruption firewall (resilience/integrity.py;
+        # docs/resilience.md "Silent corruption"): the cadence is latched
+        # at construction because the step closure bakes the in-jit
+        # fingerprint in (0 = the step compiles with no trace of it,
+        # pinned by `lint --sdc`)
+        self.sdc_check_every = int(FLAGS.sdc_check_every)
+        self.sdc_mismatches_total = 0
+        self._sdc_rollbacks = 0
+        self._sdc_hold_epoch: Optional[int] = None
+        self._sdc_last_agreed: Optional[tuple] = None
+        # fingerprints the replicas AGREED on, newest last (bounded):
+        # rollback prefers a checkpoint whose manifest fp is in here — a
+        # checkpoint saved from already-corrupt state (flip before save,
+        # detection after) carries a never-agreed fp and is skipped, so
+        # the corruption cannot launder itself through the rollback
+        from collections import deque
+
+        self._sdc_agreed_fps: "deque[int]" = deque(maxlen=256)
+        self._last_extras: Dict[str, Any] = {}
         # unified telemetry (paddle_tpu/obs; docs/observability.md):
         # the step timeline + event journal + profiler windows are bound
         # per train() call; the registry handles live for the whole
@@ -238,6 +304,11 @@ class SGDTrainer:
                                        "checkpoint commits published"),
             "resizes": reg.counter("train_resizes_total",
                                    "elastic resizes adopted"),
+            "sdc_checks": reg.counter("train_sdc_checks_total",
+                                      "cross-replica integrity checks"),
+            "sdc_mismatch": reg.counter(
+                "train_sdc_mismatch_total",
+                "cross-replica fingerprint mismatches"),
         }
         self.timeline = None
         self._journal = None
@@ -283,6 +354,15 @@ class SGDTrainer:
         fused_apply = self.fused_apply
         growth_interval = int(FLAGS.loss_scale_growth)
         max_scale = float(FLAGS.loss_scale_max)
+        # SDC firewall: fold the post-update params + optimizer slots
+        # (+ pserver tables) into one u64 fingerprint INSIDE the compiled
+        # step — the state never crosses the host link, only its 8-byte
+        # digest does, at the check cadence (resilience/integrity.py)
+        sdc_fp_on = self.sdc_check_every > 0
+        if sdc_fp_on:
+            from paddle_tpu.resilience.integrity import tree_fingerprint
+        else:
+            tree_fingerprint = None
 
         def step(params, state, opt_state, ps, rng, feed):
             # ``ps`` is the pserver tier's pytree (tables/slots/dirty/step;
@@ -393,6 +473,11 @@ class SGDTrainer:
             else:
                 (new_params, new_ps), new_opt = do_update(
                     (params, ps), (grads, px_grads), opt_core)
+            if sdc_fp_on:
+                fp_tree = {"params": new_params, "opt": new_opt}
+                if tier is not None:
+                    fp_tree["pserver"] = new_ps
+                extras = {**extras, "sdc_fp": tree_fingerprint(fp_tree)}
             return loss, new_params, new_state, new_opt, new_ps, extras
 
         # kept un-jitted for the lint auditor (audit() re-traces it)
@@ -776,6 +861,18 @@ class SGDTrainer:
             if FLAGS.profile_dir and FLAGS.profile_steps else None)
         if profiler is not None:
             profiler.install_signal()
+        # background checkpoint scrubber (--scrub_every_s, rank 0 only —
+        # one scrubber per save_dir; docs/resilience.md "Silent
+        # corruption"): re-hash everything at rest on a cadence so a
+        # checkpoint that rots AFTER its first read is quarantined and
+        # the newest fully-verified pass stays marked for rollback
+        scrubber = None
+        if (FLAGS.scrub_every_s > 0 and FLAGS.save_dir
+                and (gang is None or gang.is_coordinator)):
+            from paddle_tpu.resilience.integrity import ScrubDaemon
+
+            scrubber = ScrubDaemon(FLAGS.save_dir,
+                                   every_s=FLAGS.scrub_every_s).start()
         resume = resume or FLAGS.resume or None
         # checkpointable data source (docs/data.md): a reader carrying the
         # cursor protocol gets cursor-based resume/resize instead of the
@@ -820,14 +917,20 @@ class SGDTrainer:
             preemption.install()
         if profiling:
             jax.profiler.start_trace(FLAGS.profile_dir)
+        # the pass loop iterates a REWINDABLE schedule: an SDC rollback
+        # (no replica majority — every survivor's state is suspect)
+        # restores the last verified checkpoint and rewinds the schedule
+        # to its pass instead of exiting the loop
+        schedule = _PassSchedule(start_pass, num_passes)
         try:
-            for pass_id in range(start_pass, num_passes):
+            for pass_id in schedule:
                 handler(ev.BeginPass(pass_id))
                 if jr is not None:
                     jr.set_context(pass_id=pass_id, batch_id=0)
                     jr.record("begin_pass")
                 costs: List[float] = []
                 loss = None
+                rolled_back = False
                 t0 = time.time()
 
                 def _reader_failed(e: Exception):
@@ -1057,6 +1160,29 @@ class SGDTrainer:
                         # rest head-sample at --trace_sample
                         sp, self._step_span = self._step_span, None
                         sp.end(status="ok", cost=round(cost, 6))
+                    if (gang is not None and self.sdc_check_every
+                            and gang.world_size > 1
+                            and (batch_id + 1) % self.sdc_check_every == 0):
+                        # cross-replica integrity check (the SDC
+                        # firewall): exchange the step's in-jit state
+                        # fingerprint and majority-vote it
+                        try:
+                            self._sdc_check(gang, pass_id, batch_id,
+                                            handler)
+                        except _SdcRollback as rb:
+                            start_pass = rb.start_pass
+                            start_batch = rb.start_batch
+                            cursor_restored = False
+                            if rb.cursor_ready:
+                                cursor_restored = True
+                            elif (src is not None
+                                  and self._pending_cursor is not None):
+                                src.restore(self._pending_cursor)
+                                cursor_restored = True
+                                self._pending_cursor = None
+                            schedule.rewind(start_pass)
+                            rolled_back = True
+                            break
                     if log_period and (batch_id + 1) % log_period == 0:
                         logger.info(
                             "Pass %d, Batch %d, Cost %.5f (%.1f batch/s)",
@@ -1078,6 +1204,12 @@ class SGDTrainer:
                                     pass_id, batch_id + 1, mid["cost"])
                     batch_id += 1
                 self._close_prefetcher()
+                if rolled_back:
+                    # SDC rollback: the state was just restored from the
+                    # last verified checkpoint — skip this pass's
+                    # teardown (it never completed) and re-enter at the
+                    # rewound pass/batch
+                    continue
                 result = {}
                 if test_reader is not None:
                     with timer("TestTimer"), self._ph("eval"):
@@ -1139,6 +1271,8 @@ class SGDTrainer:
             if profiler is not None:
                 profiler.close()
                 profiler.uninstall_signal()
+            if scrubber is not None:
+                scrubber.stop()
             if jr is not None:
                 jr.record("train_end", preempted=self.preempted)
             if preemption is not None:
@@ -1178,6 +1312,192 @@ class SGDTrainer:
             logger.warning(
                 "preemption requested but --save_dir is unset: exiting "
                 "WITHOUT a checkpoint")
+
+    # -- silent-data-corruption check (resilience/integrity.py) ----------
+
+    def _sdc_check(self, gang, pass_id: int, batch_id: int,
+                   handler: Optional[Callable]) -> None:
+        """One cross-replica agreement round at a batch boundary.
+
+        The step already computed the u64 fingerprint of params +
+        optimizer slots (+ pserver tables) on device; only those 8 bytes
+        cross the gang channel here.  All replicas are bit-identical by
+        construction (pinned resume equivalence), so ANY disagreement is
+        silent corruption:
+
+        - a unique strict majority → the minority rank(s) quarantine
+          themselves (marker + journal) and exit via ``SDCDivergence``;
+          the elastic supervisor expels them (shrink, never a whole-gang
+          relaunch) and a replacement rejoins from a verified checkpoint;
+        - no strict majority (the 2-replica tie) → the tie breaks against
+          the non-coordinator ranks, AND every survivor rolls back to the
+          last verified checkpoint — with a tie no rank can certify its
+          own state, so correctness never depends on the attribution
+          being right.
+
+        Further checks hold until the expulsion lands (epoch change):
+        re-voting against a quarantined peer's stale digest would only
+        re-litigate the same incident."""
+        from paddle_tpu.resilience.errors import SDCDivergence
+        from paddle_tpu.resilience.integrity import sdc_vote
+
+        if self._sdc_hold_epoch is not None:
+            if gang.epoch == self._sdc_hold_epoch:
+                return
+            self._sdc_hold_epoch = None
+        fp_dev = self._last_extras.get("sdc_fp")
+        if fp_dev is None:
+            return
+        from paddle_tpu.resilience.integrity import fingerprint_int
+
+        fp = fingerprint_int(jax.device_get(fp_dev))
+        try:
+            raw = gang.exchange_json(
+                fp, name=f"sdc-p{pass_id:05d}-b{batch_id:06d}")
+        except GangResized as e:
+            # a peer died mid-exchange: run the resize protocol the same
+            # way a save barrier would
+            self._gang_resize(gang, e.world, pass_id, batch_id + 1,
+                              handler)
+            if self._source_resharded:
+                self._source_resharded = False
+                raise _SdcRollback(pass_id, batch_id + 1,
+                                   cursor_ready=True)
+            return
+        self._obs_counters["sdc_checks"].inc()
+        fps = {int(r): int(v) for r, v in raw.items()}
+        vote = sdc_vote(fps, gang.coordinator)
+        if vote.agreed:
+            self._sdc_last_agreed = (pass_id, batch_id, fp)
+            self._sdc_agreed_fps.append(fp)
+            return
+        self.sdc_mismatches_total += 1
+        self._obs_counters["sdc_mismatch"].inc()
+        jr = self._journal
+        if jr is not None:
+            # fsync'd: the incident anchor the merged postmortem orders
+            # the expel/rollback/rejoin records against
+            jr.record("sdc_mismatch", fsync=True,
+                      fps={str(r): f"{v:016x}" for r, v in fps.items()},
+                      minority=vote.minority, tie=vote.tie)
+        if gang.rank in vote.minority:
+            gdir = getattr(gang, "gang_dir", None)
+            if gdir is not None:
+                try:  # the supervisor folds this into expel attribution
+                    with open(os.path.join(
+                            gdir, f"sdc-quarantined-rank{gang.rank}"),
+                            "w") as f:
+                        json.dump({"pass": pass_id, "batch": batch_id,
+                                   "fp": f"{fp:016x}",
+                                   "presumed": f"{vote.presumed:016x}"},
+                                  f)
+                except OSError:
+                    pass
+            if jr is not None:
+                jr.record("sdc_quarantine", fsync=True, fp=f"{fp:016x}",
+                          presumed=f"{vote.presumed:016x}")
+            logger.error(
+                "SDC: rank %d fingerprint %016x lost the replica vote "
+                "(presumed-good %016x) at pass %d batch %d — exiting "
+                "for quarantine", gang.rank, fp, vote.presumed, pass_id,
+                batch_id)
+            raise SDCDivergence(
+                f"rank {gang.rank} state fingerprint {fp:016x} diverged "
+                f"from the replica vote ({vote.presumed:016x}) at pass "
+                f"{pass_id} batch {batch_id}")
+        # survivor: suppress re-checks until the expulsion lands
+        self._sdc_hold_epoch = gang.epoch
+        if not vote.tie:
+            # a strict majority certified this state by agreement — no
+            # rollback; the minority is being expelled
+            logger.warning(
+                "SDC: replica majority holds %016x; minority rank(s) %s "
+                "diverged and will be expelled", vote.presumed,
+                vote.minority)
+            return
+        # tie: attribution impossible — restore the last verified
+        # checkpoint so correctness never rides on the tie-break
+        if not FLAGS.save_dir:
+            if jr is not None:
+                jr.record("sdc_no_rollback", reason="no save_dir")
+            logger.error(
+                "SDC: replica tie with no --save_dir — cannot roll back "
+                "to a verified checkpoint; continuing on suspect state")
+            return
+        p = self._sdc_rollback_target(FLAGS.save_dir, jr)
+        if p < 0:
+            if jr is not None:
+                jr.record("sdc_no_rollback", reason="no valid checkpoint")
+            logger.error(
+                "SDC: replica tie but no verified checkpoint under %r — "
+                "continuing on suspect state", FLAGS.save_dir)
+            return
+        self._sdc_rollbacks += 1
+        if self._sdc_rollbacks > _SDC_MAX_ROLLBACKS:
+            raise SDCDivergence(
+                f"{self._sdc_rollbacks} SDC rollbacks without a clean "
+                "check — divergence is persistent")
+        manifest = self.load(FLAGS.save_dir, p, validate=True)
+        sp, sb = self._resume_point(p, manifest)
+        if jr is not None:
+            jr.record("sdc_rollback", fsync=True, restored_pass=p,
+                      start_pass=sp, start_batch=sb)
+        logger.warning(
+            "SDC: no replica majority — rolled back to verified "
+            "checkpoint pass %d (re-entering pass %d batch %d)", p, sp,
+            sb)
+        raise _SdcRollback(sp, sb)
+
+    def _sdc_rollback_target(self, save_dir: str, jr) -> int:
+        """Resolve the rollback target: the newest CRC-valid pass whose
+        manifest fingerprint the replicas actually AGREED on.
+
+        CRC validation alone cannot reject a checkpoint that was saved
+        from already-corrupt state (flip before the save, detection
+        after — the CRCs are computed over the corrupt bytes and match
+        perfectly), so preferring an agreement-certified fingerprint is
+        what keeps the corruption from laundering itself through the
+        rollback.  When no checkpoint is certifiable (no check coincided
+        with a save boundary, or a restart emptied the agreed set), the
+        newest CRC-valid pass is used and the uncertifiable fallback is
+        journaled — honest, not silent."""
+        from paddle_tpu.resilience.checkpoint_io import (_PASS_RE,
+                                                         validate_checkpoint)
+        from paddle_tpu.resilience.integrity import latest_verified_pass
+
+        newest = latest_verified_pass(save_dir)
+        if newest < 0:
+            return -1
+        agreed = set(self._sdc_agreed_fps)
+        try:
+            ids = sorted(
+                (int(m.group(1)) for m in
+                 (_PASS_RE.fullmatch(n) for n in os.listdir(save_dir))
+                 if m), reverse=True)
+        except OSError:
+            ids = []
+        for pid in ids:
+            if pid > newest:
+                continue
+            d = pass_dir(save_dir, pid)
+            if validate_checkpoint(d) is not None:
+                continue
+            try:
+                fp_hex = (read_manifest(d).get("meta") or {}).get("sdc_fp")
+            except Exception:  # noqa: BLE001 — unreadable meta: skip
+                continue
+            if fp_hex is not None and int(fp_hex, 16) in agreed:
+                return pid
+        if jr is not None:
+            jr.record("sdc_rollback_unverified", fsync=True,
+                      newest_valid=newest)
+        logger.warning(
+            "SDC: no checkpoint under %r carries an agreement-verified "
+            "fingerprint — rolling back to the newest CRC-valid pass %d "
+            "(cannot certify it predates the corruption; align "
+            "--sdc_check_every with the pass length so end-of-pass "
+            "checkpoints are certified)", save_dir, newest)
+        return newest
 
     # -- elastic gang resize (worker half; docs/resilience.md) -----------
 
@@ -1539,6 +1859,17 @@ class SGDTrainer:
             return pass_dir(save_dir, pass_id)
         meta = dict(meta or {})
         meta.setdefault("rng_key", self._rng_to_list(self._rng))
+        fp_dev = self._last_extras.get("sdc_fp")
+        if fp_dev is not None and "sdc_fp" not in meta:
+            # the state fingerprint at save time rides the manifest: the
+            # scrubber and postmortems can tie a checkpoint to the exact
+            # state the replicas agreed on (resilience/integrity.py)
+            from paddle_tpu.resilience.integrity import fingerprint_hex
+
+            try:
+                meta["sdc_fp"] = fingerprint_hex(jax.device_get(fp_dev))
+            except Exception:  # noqa: BLE001 — never fail a save for this
+                pass
         src = getattr(self, "_data_source", None)
         if src is not None and "data_cursor" not in meta:
             # the input-pipeline cursor rides the manifest: a mid-pass
@@ -1618,6 +1949,9 @@ class SGDTrainer:
         rng_key = (manifest.get("meta") or {}).get("rng_key")
         if rng_key is not None:
             self._rng = jnp.asarray(np.asarray(rng_key, np.uint32))
+        # the cached step fingerprint described the pre-load state — a
+        # save (or SDC check) right after a restore must not read it
+        self._last_extras.pop("sdc_fp", None)
         # input-pipeline cursor (docs/data.md): stashed for train() to
         # hand to a checkpointable source instead of fast-forwarding
         self._pending_cursor = (manifest.get("meta") or {}).get("data_cursor")

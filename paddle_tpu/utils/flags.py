@@ -231,6 +231,28 @@ define_flag("checkpoint_on_preemption", True, "on SIGTERM/SIGINT, write an "
 define_flag("reader_retries", 0, "CLI: wrap the config's reader in "
             "resilience.resilient_reader with this retry budget (0 = off)")
 
+# Silent-data-corruption firewall (resilience/integrity.py;
+# docs/resilience.md "Silent corruption")
+define_flag("sdc_check_every", 0, "cross-replica integrity check cadence: "
+            "every N batches the jitted step's in-device fingerprint of "
+            "params + optimizer slots (+ pserver tables) is exchanged "
+            "across the data-parallel replicas and majority-voted; the "
+            "minority rank is quarantined and expelled via the elastic "
+            "shrink, survivors roll back to the last verified checkpoint "
+            "when no strict majority exists (0 = off; the compiled step "
+            "is then equation-identical to the unchecked one — gated by "
+            "`lint --sdc`)",
+            validator=lambda v: v >= 0)
+define_flag("scrub_every_s", 0.0, "background checkpoint scrubber cadence "
+            "on rank 0: re-hash manifested CRCs of checkpoint chains, "
+            "pserver shard snapshots, and deploy bundles at rest every N "
+            "seconds; a newly-corrupt dir is QUARANTINED out of "
+            "latest_pass eligibility, journaled as a scrub_fail anchor, "
+            "and scrub.json marks the newest fully-verified pass "
+            "(0 = off; `python -m paddle_tpu fsck DIR` is the one-shot "
+            "form)",
+            validator=lambda v: v >= 0)
+
 # Gang supervision (resilience/cluster.py; docs/resilience.md multi-host)
 define_flag("gang_max_restarts", 3, "gang supervisor: relaunch the whole "
             "gang at most N times after a rank dies or hangs before "

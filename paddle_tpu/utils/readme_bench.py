@@ -49,6 +49,7 @@ _UNITS = [
     ("sharded_embedding_ab", "ms (a2a lookup; vs = ×psum)"),
     ("cold_start_ab", "s (warm boot; vs = ×cold)"),
     ("trace_overhead_ab", "tok/s (tracing armed; vs = ×off)"),
+    ("sdc_overhead_ab", "ms (fp every step; vs = ×off)"),
 ]
 
 

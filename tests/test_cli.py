@@ -443,3 +443,40 @@ def test_cli_rejects_bad_args():
         main([f"--config={CONF}", "--job=frobnicate"])
     with pytest.raises(ConfigError, match="--config"):
         main(["--job=train", "--config="])
+
+
+def test_cli_fsck_exit_codes_and_quarantine(tmp_path, capsys):
+    """`python -m paddle_tpu fsck DIR`: exit 0 when every checkpoint
+    re-hashes, exit 2 with the corrupt member NAMED; --quarantine
+    demotes the dir out of latest_pass eligibility (docs/resilience.md
+    "Silent corruption")."""
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos, save_checkpoint
+    from paddle_tpu.resilience.checkpoint_io import latest_pass, pass_dir
+
+    root = tmp_path / "ckpts"
+    for pid in range(2):
+        save_checkpoint(str(root), pid,
+                        params={"w": np.full((4,), float(pid), np.float32)})
+    assert main(["fsck", str(root)]) == 0
+    capsys.readouterr()
+
+    chaos.corrupt_checkpoint(pass_dir(str(root), 1), target="params.npz")
+    assert main(["fsck", str(root)]) == 2
+    out = capsys.readouterr().out
+    assert "params.npz" in out and "pass-00001" in out
+    assert latest_pass(str(root)) == 0  # read path skips it regardless
+
+    assert main(["fsck", str(root), "--quarantine"]) == 2
+    assert (root / "pass-00001" / "QUARANTINED").exists()
+    assert (root / "scrub.json").exists()
+    capsys.readouterr()
+
+
+def test_cli_help_lists_sdc_flags(capsys):
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "python -m paddle_tpu fsck" in out
+    for flag in ("--sdc_check_every", "--scrub_every_s"):
+        assert flag in out, flag

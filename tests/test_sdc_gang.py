@@ -1,0 +1,289 @@
+"""SDC firewall acceptance proofs on REAL 2-process CPU training gangs.
+
+The end-to-end contract (ISSUE/docs/resilience.md "Silent corruption"):
+chaos flips ONE bit of ONE param leaf on ONE rank mid-pass — the fault
+no CRC, no NaN guard, and no heartbeat will ever see —
+
+- WITH `--sdc_check_every=N`: the divergence is detected within N
+  batches by the cross-replica fingerprint vote, the divergent rank is
+  expelled via the ELASTIC SHRINK (attempts == 1 — never the loud
+  whole-gang relaunch), the survivor rolls back to the last verified
+  checkpoint (a 2-replica tie certifies nobody), a replacement rejoins
+  from a verified checkpoint through the normal grow-back, and the
+  completed run's losses and final params match the uninterrupted
+  oracle to 1e-6;
+- the same holds when the COORDINATOR is the corrupt rank: the tie
+  expels the wrong rank (attribution needs >=3 replicas) but the
+  rollback discards the corrupt window, so the final state is STILL
+  oracle-identical — correctness never rides on the attribution;
+- WITHOUT the check (the negative control): the same fault completes
+  "successfully" and silently diverges — pinned, so the firewall's
+  value is measured, not assumed.
+
+Mechanics mirror tests/test_gang.py: each rank is an OS process running
+the full trainer on one virtual CPU device; gang coordination rides the
+supervisor's shared-directory protocol.
+"""
+
+import json
+import os
+import signal
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.param.optimizers import Adam
+from paddle_tpu.resilience import GangSupervisor
+from paddle_tpu.trainer import SGDTrainer, events as ev
+from paddle_tpu.utils.flags import FLAGS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HARD_TIMEOUT_S = 240
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout():
+    def _abort(signum, frame):
+        raise RuntimeError(
+            f"sdc gang test exceeded {HARD_TIMEOUT_S}s hard timeout")
+
+    prev = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(HARD_TIMEOUT_S)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.fixture(autouse=True)
+def fresh_names():
+    nn.reset_naming()
+    yield
+
+
+# Each rank runs the REAL trainer with the SDC firewall armed
+# (--sdc_check_every from argv).  Rank `chaos_rank` flips one bit of its
+# weight matrix between batches at pass 1 batch 2 (marker-guarded: the
+# replacement incarnation trains clean).  Losses/params are written only
+# on CLEAN completion, so a quarantined incarnation never overwrites the
+# replacement's record.
+SDC_WORKER = textwrap.dedent("""\
+    import json, os, sys, time
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("PADDLE_TPU_COMPUTE_DTYPE", "float32")
+
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.trainer import SGDTrainer, events as ev
+    from paddle_tpu.utils import FLAGS
+
+    save_dir, out_dir, check_every, chaos_rank, pace = sys.argv[1:6]
+    rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    FLAGS.save_dir = save_dir
+    FLAGS.log_period = 0
+    FLAGS.sdc_check_every = int(check_every)
+    pace = float(pace)
+
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=2)
+    cost = nn.mse_cost(input=nn.fc(x, 2, act="relu", name="h"), label=y)
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+
+    rs = np.random.RandomState(0)
+    feeds = [{"x": rs.randn(4, 4).astype(np.float32),
+              "y": rs.randn(4, 2).astype(np.float32)} for _ in range(6)]
+
+    losses = {}
+    def record(e):
+        if isinstance(e, ev.EndIteration):
+            losses[f"{e.pass_id}:{e.batch_id}"] = float(e.cost)
+            if pace:
+                time.sleep(pace)
+
+    handler = record
+    if rank == int(chaos_rank):
+        # the WEIGHT matrix, not the (zero-initialized) bias: flipping a
+        # mantissa bit of 0.0 yields a denormal no loss would ever see
+        weight = [k for k in sorted(tr.params)
+                  if np.asarray(tr.params[k]).ndim >= 2][0]
+        handler = chaos.flip_param_bit_at(
+            tr, pass_id=1, batch=2, leaf=weight, index=1, bit=20,
+            marker=os.path.join(out_dir, "fault-fired"), inner=record)
+
+    tr.train(lambda: iter(feeds), num_passes=3, event_handler=handler,
+             resume="auto")
+
+    with open(os.path.join(out_dir, f"losses-rank{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    if rank == 0:
+        np.savez(os.path.join(out_dir, "final-rank0.npz"),
+                 **{k: np.asarray(v) for k, v in tr.params.items()})
+""")
+
+_ORACLE = {}
+
+
+def _reference_run(monkeypatch):
+    """The uninterrupted single-process oracle (cached across tests —
+    same model/seed/feeds every time)."""
+    monkeypatch.setattr(FLAGS, "save_dir", "")
+    monkeypatch.setattr(FLAGS, "log_period", 0)
+    monkeypatch.setattr(FLAGS, "sdc_check_every", 0)
+    if _ORACLE:
+        return _ORACLE["losses"], _ORACLE["params"]
+    nn.reset_naming()
+    x = nn.data("x", size=4)
+    y = nn.data("y", size=2)
+    cost = nn.mse_cost(input=nn.fc(x, 2, act="relu", name="h"), label=y)
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+    rs = np.random.RandomState(0)
+    feeds = [{"x": rs.randn(4, 4).astype(np.float32),
+              "y": rs.randn(4, 2).astype(np.float32)} for _ in range(6)]
+    losses = {}
+
+    def record(e):
+        if isinstance(e, ev.EndIteration):
+            losses[f"{e.pass_id}:{e.batch_id}"] = float(e.cost)
+
+    tr.train(lambda: iter(feeds), num_passes=3, event_handler=record)
+    _ORACLE["losses"] = losses
+    _ORACLE["params"] = {k: np.asarray(v) for k, v in tr.params.items()}
+    return _ORACLE["losses"], _ORACLE["params"]
+
+
+def _sdc_gang(tmp_path, *, check_every, chaos_rank, pace=0.1, **kw):
+    script = tmp_path / "worker.py"
+    script.write_text(SDC_WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    kw.setdefault("heartbeat_s", 0.2)
+    kw.setdefault("watchdog_s", 10.0)
+    kw.setdefault("startup_grace_s", 180.0)
+    kw.setdefault("backoff_s", 0.05)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("env", {"PYTHONPATH": REPO_ROOT + os.pathsep
+                          + os.environ.get("PYTHONPATH", "")})
+    sup = GangSupervisor(
+        ["localhost"] * 2, str(script),
+        [str(tmp_path / "ckpts"), str(out_dir), str(check_every),
+         str(chaos_rank), str(pace)],
+        gang_dir=str(tmp_path / "gang"), **kw)
+    return sup, out_dir
+
+
+def _load_losses(out_dir, rank=0):
+    with open(os.path.join(out_dir, f"losses-rank{rank}.json")) as f:
+        return json.load(f)
+
+
+def test_sdc_flip_detected_expelled_and_healed_to_oracle(
+        tmp_path, monkeypatch):
+    """THE acceptance proof: one bit of rank 1's weight matrix flips at
+    pass 1 batch 2.  Detection lands at the next check boundary (batch 3,
+    inside the --sdc_check_every=2 budget), rank 1 quarantines itself and
+    is expelled by the ELASTIC SHRINK — attempts == 1, never a
+    whole-gang relaunch — the survivor rolls back to the verified pass-0
+    checkpoint (2-replica tie), a replacement rejoins from the verified
+    resize commit, and the finished run matches the uninterrupted oracle
+    to 1e-6 everywhere."""
+    ref_losses, ref_params = _reference_run(monkeypatch)
+    sup, out_dir = _sdc_gang(tmp_path, check_every=2, chaos_rank=1,
+                             elastic=True)
+    result = sup.run()
+
+    assert result.attempts == 1              # no whole-gang relaunch
+    assert result.shrinks == 1 and result.grows == 1
+    assert result.resize_fallbacks == 0
+    assert (out_dir / "fault-fired").exists()
+    expelled = [r for r in result.reports if r.rank == 1
+                and "sdc quarantine" in r.reason]
+    assert expelled, result.reports
+    assert "elastic shrink" in expelled[0].reason
+
+    # the survivor healed to the oracle — every batch, to 1e-6
+    got = _load_losses(out_dir, rank=0)
+    assert set(got) == set(ref_losses)
+    for key, v in got.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    final = np.load(out_dir / "final-rank0.npz")
+    for k, v in ref_params.items():
+        np.testing.assert_allclose(final[k], v, rtol=1e-6, atol=1e-7)
+
+    # the replacement joined from a verified checkpoint and finished the
+    # run on the oracle trajectory
+    got1 = _load_losses(out_dir, rank=1)
+    assert "2:5" in got1
+    for key, v in got1.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=f"joiner {key}")
+
+
+def test_sdc_flip_on_coordinator_still_heals_to_oracle(
+        tmp_path, monkeypatch):
+    """The documented conservative-tie property: when the CORRUPT rank is
+    the coordinator, the 2-replica tie expels the wrong rank (exact
+    attribution needs >=3 replicas) — but the survivor's rollback to the
+    verified checkpoint discards its own corrupt window, so the final
+    state is STILL oracle-identical.  Correctness never depends on the
+    tie-break guessing right."""
+    ref_losses, ref_params = _reference_run(monkeypatch)
+    sup, out_dir = _sdc_gang(tmp_path, check_every=2, chaos_rank=0,
+                             elastic=True)
+    result = sup.run()
+
+    assert result.attempts == 1
+    assert result.shrinks == 1 and result.grows == 1
+    assert (out_dir / "fault-fired").exists()
+    # tie-break: the non-coordinator was expelled (exact attribution is
+    # a >=3-replica property; state safety is not)
+    expelled = [r for r in result.reports if "sdc quarantine" in r.reason]
+    assert expelled and expelled[0].rank == 1
+
+    got = _load_losses(out_dir, rank=0)
+    assert set(got) == set(ref_losses)
+    for key, v in got.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    final = np.load(out_dir / "final-rank0.npz")
+    for k, v in ref_params.items():
+        np.testing.assert_allclose(final[k], v, rtol=1e-6, atol=1e-7)
+
+
+def test_sdc_negative_control_silently_diverges_without_check(
+        tmp_path, monkeypatch):
+    """The negative control the firewall is measured against: the SAME
+    bit flip with --sdc_check_every=0 completes 'successfully' — no
+    detection, no expel, no relaunch — and rank 1's trajectory silently
+    diverges from the oracle while rank 0's matches it.  This is the
+    exact failure mode of today's stack, pinned."""
+    ref_losses, _ = _reference_run(monkeypatch)
+    sup, out_dir = _sdc_gang(tmp_path, check_every=0, chaos_rank=1,
+                             pace=0.0)
+    result = sup.run()
+
+    assert result.attempts == 1 and result.reports == []
+    assert result.shrinks == 0 and result.grows == 0
+    assert (out_dir / "fault-fired").exists()
+
+    got0 = _load_losses(out_dir, rank=0)
+    for key, v in got0.items():
+        np.testing.assert_allclose(v, ref_losses[key], rtol=1e-6,
+                                   err_msg=key)
+    got1 = _load_losses(out_dir, rank=1)
+    # clean before the flip...
+    for key in ("0:0", "1:0", "1:1"):
+        np.testing.assert_allclose(got1[key], ref_losses[key], rtol=1e-6)
+    # ...silently wrong after it, all the way to the end
+    post = [abs(got1[k] - ref_losses[k]) / max(abs(ref_losses[k]), 1e-12)
+            for k in ("1:2", "1:3", "2:5")]
+    assert max(post) > 1e-4, post

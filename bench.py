@@ -25,8 +25,12 @@ null-program calibration.
 
 from __future__ import annotations
 
+import argparse
+import glob
 import json
 import os
+import re
+import sys
 import time
 
 import numpy as np
@@ -1423,7 +1427,188 @@ def bench_sdc_overhead_ab(rtt, peak):
     }
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# --check: regression gate against the newest BENCH_r*.json capture
+# ---------------------------------------------------------------------------
+
+#: short row name -> bench callable(rtt, peak).  The registry ``--check``
+#: and ``--rows`` select from; each key MUST equal the ``short`` its row
+#: function reports, so fresh rows line up with baseline summary keys.
+ROWS = {
+    "seq2seq": bench_seq2seq,
+    "seq2seq_decode": bench_seq2seq_decode,
+    "lstm_b64h256": bench_lstm_textclf,
+    "lstm_b64h512": lambda r, p: bench_lstm_textclf(r, p, hidden=512),
+    "lstm_b64h1280": lambda r, p: bench_lstm_textclf(r, p, hidden=1280),
+    "lstm_b128h256": lambda r, p: bench_lstm_textclf(r, p, batch_size=128),
+    "lstm_b256h256": lambda r, p: bench_lstm_textclf(r, p, batch_size=256),
+    "lstm_b512h256r": lambda r, p: bench_lstm_textclf(
+        r, p, batch_size=512, remat=True),
+    "resnet20_b256": bench_resnet_cifar,
+    "smallnet_b64": bench_smallnet,
+    "smallnet_b512": lambda r, p: bench_smallnet(r, p, batch_size=512),
+    "alexnet_b64": lambda r, p: bench_alexnet(r, p, batch_size=64),
+    "alexnet_b128": bench_alexnet,
+    "alexnet_b256": lambda r, p: bench_alexnet(r, p, batch_size=256),
+    "alexnet_b512": lambda r, p: bench_alexnet(r, p, batch_size=512),
+    "googlenet_b64": lambda r, p: bench_googlenet(r, p, batch_size=64),
+    "googlenet_b128": bench_googlenet,
+    "googlenet_b256": lambda r, p: bench_googlenet(r, p, batch_size=256),
+}
+
+
+def _higher_better(unit: str) -> bool:
+    """Throughput units (words/s, images/s, tok/s, samples/s) regress
+    downward; latency units (ms, ms/batch, s) regress upward."""
+    u = (unit or "").lower()
+    return not (u in ("ms", "s") or u.startswith("ms/") or
+                u.startswith("s/"))
+
+
+def load_baseline_summary(path: str):
+    """Extract the ``summary`` map (short -> [value, mfu, vs_baseline])
+    from a capture file: either bench.py's own JSON line, or the driver's
+    wrapper ``{'n','cmd','rc','tail','parsed'}``.  When ``parsed`` is
+    null the tail holds only the LAST ~2000 chars of the line — which is
+    exactly why ``summary`` is emitted as the last key: it survives the
+    truncation and is regex-recoverable here."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        if isinstance(doc.get("summary"), dict):
+            return doc["summary"]
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and isinstance(parsed.get("summary"),
+                                                   dict):
+            return parsed["summary"]
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            m = re.search(r'"summary":\s*(\{.*\})\s*\}\s*$', tail,
+                          re.DOTALL)
+            if m:
+                try:
+                    return json.loads(m.group(1))
+                except ValueError:
+                    pass
+    raise ValueError(f"no summary object recoverable from {path}")
+
+
+def newest_baseline(root: str = ".") -> str:
+    caps = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not caps:
+        raise FileNotFoundError(f"no BENCH_r*.json under {root!r}")
+    return caps[-1]
+
+
+def compare_rows(fresh_rows, baseline, tol: float = 0.10):
+    """Pure comparison core (unit-tested without running a bench).
+
+    Each fresh row's headline value AND its MFU are checked against the
+    baseline summary entry of the same short name, in the unit's
+    direction, under a noise guard of ``max(tol, rep spread - 1)`` — a
+    fresh capture whose own reps disagree by 30% cannot condemn a 15%
+    delta.  Returns ``(failures, checked, skipped)``: human-readable
+    failure strings, rows actually compared, rows with no usable
+    baseline."""
+    failures, checked, skipped = [], [], []
+    for row in fresh_rows:
+        name = row.get("short") or row.get("metric", "?")
+        base = baseline.get(name)
+        if (base is None or base == "ERROR"
+                or not isinstance(base, (list, tuple)) or base[0] is None):
+            skipped.append(name)
+            continue
+        if row.get("unit") == "ERROR" or row.get("value") is None:
+            failures.append(f"{name}: fresh run errored: "
+                            f"{row.get('error', 'no value')}")
+            continue
+        val, base_val = float(row["value"]), float(base[0])
+        lo, hi = row.get("ms_min"), row.get("ms_max")
+        spread = (float(hi) / float(lo) - 1.0) if lo and hi and lo > 0 \
+            else 0.0
+        guard = max(float(tol), spread)
+        unit = row.get("unit", "")
+        ratio = val / base_val if base_val else 1.0
+        if _higher_better(unit):
+            ok = ratio >= 1.0 - guard
+        else:
+            ok = ratio <= 1.0 + guard
+        checked.append(name)
+        if not ok:
+            failures.append(
+                f"{name}: {val:g} {unit} vs baseline {base_val:g} "
+                f"({ratio:.3f}x, guard {guard:.0%}, rep spread "
+                f"{spread:.0%})")
+        bm, fm = base[1], row.get("mfu")
+        if bm is not None and fm is not None and \
+                float(fm) < float(bm) * (1.0 - guard):
+            failures.append(
+                f"{name}: MFU {float(fm):.4f} vs baseline "
+                f"{float(bm):.4f} (guard {guard:.0%})")
+    return failures, checked, skipped
+
+
+def run_check(ns) -> int:
+    """``bench.py --check``: re-measure the selected rows and fail (rc 1)
+    on regression vs the newest capture (or ``--baseline PATH``)."""
+    import jax
+
+    base_path = ns.baseline or newest_baseline(
+        os.path.dirname(os.path.abspath(__file__)))
+    baseline = load_baseline_summary(base_path)
+    names = [n.strip() for n in ns.rows.split(",") if n.strip()] \
+        if ns.rows != "all" else list(ROWS)
+    unknown = [n for n in names if n not in ROWS]
+    if unknown:
+        print(f"bench --check: unknown rows {unknown}; registry: "
+              f"{sorted(ROWS)}", file=sys.stderr)
+        return 2
+    kind = jax.devices()[0].device_kind
+    peak = _chip_peak(kind)
+    rtt = _calibrate_rtt()
+    fresh = []
+    for n in names:
+        try:
+            fresh.append(ROWS[n](rtt, peak))
+        except Exception as e:  # noqa: BLE001 — an errored row is a failure
+            fresh.append({"short": n, "value": None, "unit": "ERROR",
+                          "error": f"{type(e).__name__}: {e}"[:200]})
+    failures, checked, skipped = compare_rows(fresh, baseline, tol=ns.tol)
+    report = {
+        "baseline": os.path.basename(base_path),
+        "device": kind,
+        "checked": checked,
+        "skipped": skipped,
+        "failures": failures,
+        "ok": not failures,
+    }
+    print(json.dumps(report))
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Benchmark driver: full capture (one JSON line) by "
+                    "default; --check regresses selected rows against "
+                    "the newest BENCH_r*.json capture")
+    ap.add_argument("--check", action="store_true",
+                    help="re-measure --rows and exit 1 on regression vs "
+                         "the baseline capture")
+    ap.add_argument("--rows", default="seq2seq", metavar="A,B|all",
+                    help="comma-separated ROWS registry keys to check "
+                         "(default: the seq2seq headline; 'all' = every "
+                         "registered row)")
+    ap.add_argument("--baseline", default=None, metavar="CAPTURE.json",
+                    help="capture to compare against (default: newest "
+                         "BENCH_r*.json next to bench.py)")
+    ap.add_argument("--tol", type=float, default=0.10,
+                    help="regression tolerance floor (the guard is "
+                         "max(tol, fresh rep spread - 1))")
+    ns = ap.parse_args(argv)
+    if ns.check:
+        return run_check(ns)
+
     import jax
 
     kind = jax.devices()[0].device_kind
@@ -1500,7 +1685,8 @@ def main() -> None:
                                        headline.get("mfu_worst"), None]
     out["summary"] = summary
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

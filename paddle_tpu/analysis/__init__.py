@@ -4,17 +4,21 @@ The TPU-stack analog of legacy Paddle's eager ``config_parser.py``
 validation: a jaxpr auditor for compiled topologies/steps (dtype
 promotion, host transfers, constant bloat, unsharded meshes, unaligned
 Pallas tiles), an AST trace-safety linter for Python sources (tracer
-leaks/branches, trace-time impurity, retrace storms), a suppression
-plane, and the ``python -m paddle_tpu lint`` CLI.  See docs/lint.md for
-the check catalog.
+leaks/branches, trace-time impurity, retrace storms), the whole-stack
+static safety passes (``analysis.static``: host-concurrency race lint,
+gang collective protocol checker, static HBM/donation audit), a
+suppression plane, and the ``python -m paddle_tpu lint`` CLI.  See
+docs/lint.md for the check catalog.
 """
 
 from paddle_tpu.analysis.findings import (Finding, SEVERITIES,
                                           apply_allowlist, errors_summary,
                                           format_findings, load_allowlist,
                                           severity_at_least)
-from paddle_tpu.analysis.jaxpr_walk import (eqn_subjaxprs, find_primitives,
-                                            hlo_control_flow, walk_eqns)
+from paddle_tpu.analysis.jaxpr_walk import (aval_bytes, eqn_subjaxprs,
+                                            find_primitives,
+                                            hlo_control_flow,
+                                            peak_live_bytes, walk_eqns)
 from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
                                              audit_decode, audit_fn,
                                              audit_jaxpr,
@@ -22,8 +26,11 @@ from paddle_tpu.analysis.jaxpr_audit import (DECODE_CHECKS, JAXPR_CHECKS,
                                              audit_no_dense_rows)
 from paddle_tpu.analysis.ast_lint import (AST_CHECKS, lint_file, lint_path,
                                           lint_source)
-from paddle_tpu.analysis.flops import (chip_peak_bandwidth, chip_peak_flops,
-                                       count_jaxpr_flops, jaxpr_flops)
+from paddle_tpu.analysis.flops import (chip_hbm_bytes, chip_peak_bandwidth,
+                                       chip_peak_flops, count_jaxpr_flops,
+                                       jaxpr_flops)
+from paddle_tpu.analysis.static import (audit_hbm_jaxpr, run_hbm,
+                                        run_protocol, run_race)
 
 __all__ = [
     "Finding",
@@ -52,4 +59,11 @@ __all__ = [
     "jaxpr_flops",
     "chip_peak_flops",
     "chip_peak_bandwidth",
+    "chip_hbm_bytes",
+    "aval_bytes",
+    "peak_live_bytes",
+    "run_race",
+    "run_protocol",
+    "run_hbm",
+    "audit_hbm_jaxpr",
 ]

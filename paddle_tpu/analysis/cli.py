@@ -10,6 +10,8 @@ Usage:
     python -m paddle_tpu lint --deploy model.ptz
     python -m paddle_tpu lint --pserver V,D,N,S
     python -m paddle_tpu lint --obs
+    python -m paddle_tpu lint --race --protocol --hbm
+    python -m paddle_tpu lint --all --format sarif
 
 ``--path DIR`` runs the AST trace-safety linter over the tree;
 ``--config CONF.py`` additionally builds the config's trainer and audits
@@ -59,8 +61,32 @@ vocab-tiled top-k readout kernel's BlockSpecs.  Both the kernel and the
 XLA-fallback variants are traced (the kernel in interpret mode off-TPU),
 so a serving regression fails lint on any backend.
 
-Exit status: 1 when any finding at/above ``--fail-on`` (default ERROR)
-survives suppression, else 0.  ``--fail-on NEVER`` always exits 0.
+``--race [FILE]`` runs the host-concurrency lock-discipline checker over
+the known concurrent classes (serving, feeder prefetch, obs registries,
+the gang cluster): the guard lock of each mutable attribute is inferred
+from ``with self._lock:`` usage, and any read/write reachable from a
+cross-thread entry point outside the guard is flagged — intentionally
+lock-free fields carry ``# tpu-lint: guarded-by=none - <invariant>``
+annotations.  Lock-order inversions across classes are ERRORs.
+
+``--protocol [FILE]`` runs the gang collective/barrier protocol checker
+over trainer + cluster + checkpoint_io + integrity: on a rank-conditional
+branch both sides must reach the SAME collectives in the SAME order (the
+read-first-grow deadlock shape), and an except handler may not swallow or
+exit past a collective its peers still block on.
+
+``--hbm`` runs the static HBM audit over the real compiled train and
+decode steps: peak-live-bytes (liveness walk, donation credited) vs the
+chip HBM table, donated-buffer-use-after-donation, and f64/weak-type
+constants that defeat the compile-cache key.
+
+``--all`` runs every registered pass (tree lint + decode + pserver + obs
++ amp + sdc + race + protocol + hbm + the slot-step audit).
+
+Exit status (uniform across every pass — docs/lint.md has the matrix):
+0 = ran clean, 1 = findings at/above ``--fail-on`` (default ERROR)
+survive suppression, 2 = usage error (unknown flag, unreadable
+allowlist).  ``--fail-on NEVER`` always exits 0 after a successful run.
 """
 
 from __future__ import annotations
@@ -299,20 +325,68 @@ def run(argv: Optional[List[str]] = None) -> int:
                         "the dequantized forward (and the int8 in-trace "
                         "closure) for dtype-promotion and constant-bloat "
                         "(repeatable; docs/deploy.md)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--race", nargs="?", const="", default=None,
+                   metavar="FILE",
+                   help="host-concurrency race lint: infer each mutable "
+                        "attribute's guard lock and flag cross-thread "
+                        "access outside it (default: the known concurrent "
+                        "classes; FILE restricts to one module)")
+    p.add_argument("--protocol", nargs="?", const="", default=None,
+                   metavar="FILE",
+                   help="gang collective/barrier protocol checker: both "
+                        "sides of a rank-conditional branch must reach "
+                        "the same collectives in the same order (default: "
+                        "trainer + resilience tier; FILE restricts)")
+    p.add_argument("--hbm", action="store_true",
+                   help="static HBM audit of the real compiled train and "
+                        "decode steps: peak-live-bytes vs the chip table, "
+                        "donation honored, no f64/weak-type cache-key "
+                        "poison")
+    p.add_argument("--all", action="store_true",
+                   help="run every registered pass (tree lint + decode + "
+                        "pserver + obs + amp + sdc + race + protocol + "
+                        "hbm + slot-step audit)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--fail-on", default="ERROR", type=str.upper,
                    choices=("ERROR", "WARN", "INFO", "NEVER"),
                    help="exit 1 when findings at/above this severity remain")
     p.add_argument("--allowlist", metavar="FILE",
                    help="suppression file: '<check-id> [message substring]' "
                         "per line")
-    ns = p.parse_args(argv)
+    try:
+        ns = p.parse_args(argv)
+    except SystemExit as e:
+        if e.code in (0, None):  # --help: the documented SystemExit(0)
+            raise
+        return 2  # unknown flag / bad choice: usage error, uniformly 2
+
+    allow_entries = None
+    if ns.allowlist:
+        try:  # validate BEFORE the passes run: a typo'd path is a usage
+            # error, not a full lint run followed by a crash
+            allow_entries = load_allowlist(ns.allowlist)
+        except OSError as e:
+            print(f"lint: cannot read allowlist {ns.allowlist!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if ns.all:
+        # every registered pass; explicit flags keep their given specs
+        ns.decode = ns.decode if ns.decode is not None else ""
+        ns.pserver = ns.pserver if ns.pserver is not None else ""
+        ns.obs = ns.amp = ns.sdc = ns.hbm = True
+        ns.race = ns.race if ns.race is not None else ""
+        ns.protocol = ns.protocol if ns.protocol is not None else ""
 
     targets = list(ns.path)
     configs = list(ns.config)
     if (not targets and not configs and ns.decode is None
             and ns.pserver is None and not ns.serve and not ns.obs
-            and not ns.amp and not ns.deploy and not ns.sdc):
+            and not ns.amp and not ns.deploy and not ns.sdc
+            and ns.race is None and ns.protocol is None and not ns.hbm):
+        targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    if ns.all and not ns.path:
         targets = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
     findings: List[Finding] = []
@@ -345,16 +419,29 @@ def run(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.resilience.integrity import audit_sdc_step
 
         findings.extend(audit_sdc_step())
+    if ns.race is not None:
+        from paddle_tpu.analysis.static import run_race
+
+        findings.extend(run_race((ns.race,) if ns.race else ()))
+    if ns.protocol is not None:
+        from paddle_tpu.analysis.static import run_protocol
+
+        findings.extend(run_protocol((ns.protocol,) if ns.protocol else ()))
+    if ns.hbm:
+        from paddle_tpu.analysis.static import run_hbm
+
+        findings.extend(run_hbm())
     for bundle in ns.serve:
         findings.extend(_audit_serving_bundle(bundle))
-    if ns.serve:
-        # --serve also gates the continuous path's fused step (once)
+    if ns.serve or ns.all:
+        # --serve also gates the continuous path's fused step (once);
+        # --all runs the bundle-independent half even with no bundle
         findings.extend(_audit_slot_step_closure())
     for bundle in ns.deploy:
         findings.extend(_audit_deploy_bundle(bundle))
 
-    if ns.allowlist:
-        findings = apply_allowlist(findings, load_allowlist(ns.allowlist))
+    if allow_entries is not None:
+        findings = apply_allowlist(findings, allow_entries)
 
     print(format_findings(findings, ns.format))
     if ns.fail_on == "NEVER":

@@ -171,13 +171,65 @@ def _counts(findings: Sequence[Finding]) -> Dict[str, int]:
     return c
 
 
+#: Finding severity -> SARIF result level
+_SARIF_LEVEL = {"ERROR": "error", "WARN": "warning", "INFO": "note"}
+
+
+def _sarif(ranked: Sequence[Finding], counts: Dict[str, int]) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotators (GitHub code
+    scanning, VS Code SARIF viewer) ingest.  AST findings carry a
+    physicalLocation (file + startLine); jaxpr findings carry their
+    eqn-path provenance as a logicalLocation fullyQualifiedName."""
+    rule_ix: Dict[str, int] = {}
+    rules: List[Dict] = []
+    results: List[Dict] = []
+    for f in ranked:
+        if f.check not in rule_ix:
+            rule_ix[f.check] = len(rules)
+            rules.append({"id": f.check,
+                          "defaultConfiguration":
+                              {"level": _SARIF_LEVEL[f.severity]}})
+        if f.file is not None:
+            phys: Dict = {"artifactLocation": {"uri": f.file}}
+            if f.line:
+                phys["region"] = {"startLine": int(f.line)}
+            loc = {"physicalLocation": phys}
+        else:
+            loc = {"logicalLocations":
+                   [{"fullyQualifiedName": f.where or "<unknown>"}]}
+        results.append({
+            "ruleId": f.check,
+            "ruleIndex": rule_ix[f.check],
+            "level": _SARIF_LEVEL[f.severity],
+            "message": {"text": f.message},
+            "locations": [loc],
+        })
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddle-tpu-lint",
+                "informationUri":
+                    "https://github.com/dzhwinter/Paddle",
+                "rules": rules,
+            }},
+            "results": results,
+            "properties": {"counts": counts},
+        }],
+    }, indent=1)
+
+
 def format_findings(findings: Sequence[Finding], fmt: str = "text") -> str:
-    """Render findings for the CLI: 'text' (one line per finding + summary)
-    or 'json' (machine-readable, stable keys)."""
+    """Render findings for the CLI: 'text' (one line per finding +
+    summary), 'json' (machine-readable, stable keys), or 'sarif'
+    (SARIF 2.1.0 for CI annotation surfaces)."""
     order = {s: i for i, s in enumerate(SEVERITIES)}
     ranked = sorted(findings,
                     key=lambda f: (-order[f.severity], f.file or "",
                                    f.line or 0, f.check))
+    if fmt == "sarif":
+        return _sarif(ranked, _counts(findings))
     if fmt == "json":
         return json.dumps({
             "findings": [f.to_dict() for f in ranked],

@@ -23,7 +23,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["count_jaxpr_flops", "jaxpr_flops", "chip_peak_flops",
-           "chip_peak_bandwidth", "CHIP_PEAK_FLOPS", "CHIP_PEAK_BW"]
+           "chip_peak_bandwidth", "chip_hbm_bytes", "CHIP_PEAK_FLOPS",
+           "CHIP_PEAK_BW", "CHIP_HBM_BYTES"]
 
 #: chip peak dense FLOP/s (bf16) by device_kind substring, most specific
 #: first — the denominator of every MFU number this repo publishes
@@ -38,6 +39,18 @@ CHIP_PEAK_BW = (
     ("v6 lite", 1640e9), ("v6e", 1640e9),
     ("v5 lite", 819e9), ("v5e", 819e9), ("v5p", 2765e9), ("v5", 2765e9),
     ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
+
+
+_GiB = float(1 << 30)
+
+#: HBM capacity per chip (bytes) — the denominator of the static
+#: peak-live-bytes gate (``lint --hbm``)
+CHIP_HBM_BYTES = (
+    ("v6 lite", 32 * _GiB), ("v6e", 32 * _GiB),
+    ("v5 lite", 16 * _GiB), ("v5e", 16 * _GiB), ("v5p", 95 * _GiB),
+    ("v5", 95 * _GiB),
+    ("v4", 32 * _GiB), ("v3", 32 * _GiB), ("v2", 16 * _GiB),
 )
 
 
@@ -60,6 +73,12 @@ def chip_peak_flops(kind: str) -> Optional[float]:
 def chip_peak_bandwidth(kind: str) -> Optional[float]:
     """Peak HBM bytes/s for a ``device_kind`` string; None off-TPU."""
     return _chip_lookup(kind, CHIP_PEAK_BW, 819e9)
+
+
+def chip_hbm_bytes(kind: str) -> Optional[float]:
+    """HBM bytes per chip for a ``device_kind`` string; None off-TPU
+    (an unknown TPU generation assumes v5e)."""
+    return _chip_lookup(kind, CHIP_HBM_BYTES, 16 * _GiB)
 
 
 def count_jaxpr_flops(jaxpr) -> float:

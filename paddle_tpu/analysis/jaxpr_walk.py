@@ -12,9 +12,10 @@ primitive will actually execute once).
 
 from __future__ import annotations
 
-from typing import Iterator, List, Set, Tuple
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
 
-__all__ = ["eqn_subjaxprs", "walk_eqns", "find_primitives"]
+__all__ = ["eqn_subjaxprs", "walk_eqns", "find_primitives",
+           "aval_bytes", "peak_live_bytes"]
 
 #: primal-computation param keys, most specific first; exactly ONE is taken
 _PRIMAL_KEYS = ("call_jaxpr", "jaxpr", "fun_jaxpr")
@@ -87,6 +88,124 @@ def find_primitives(jaxpr, names: Set[str],
     export (config/deploy._unrolled_scans verification)."""
     return [(eqn.primitive.name, p) for eqn, p in walk_eqns(jaxpr, path)
             if eqn.primitive.name in names]
+
+
+def aval_bytes(aval) -> int:
+    """HBM bytes of one abstract value (0 for tokens/abstract avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):  # symbolic dim: count as 1
+            pass
+    return n * getattr(dtype, "itemsize", 4)
+
+
+def _is_var(v) -> bool:
+    return hasattr(v, "aval") and not hasattr(v, "val")  # Var, not Literal
+
+
+def _last_uses(jaxpr) -> Dict[object, int]:
+    """var -> index of the LAST eqn reading it (len(eqns) for jaxpr
+    outputs, which stay live to the end; absent = never read)."""
+    last: Dict[object, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            last[v] = len(jaxpr.eqns)
+    return last
+
+
+def _open_peak(jaxpr) -> Tuple[int, int]:
+    """(peak live bytes, boundary bytes) of an OPEN jaxpr, all inputs
+    treated non-donated.  ``boundary`` = invars + constvars + outvars —
+    the bytes that alias the enclosing scope's buffers, which a caller
+    subtracts to get the sub-jaxpr's *transient* contribution."""
+    stats = _liveness(jaxpr, donated=frozenset())
+    boundary = (stats["args_bytes"] + stats["consts_bytes"]
+                + stats["out_bytes"])
+    return stats["peak_bytes"], boundary
+
+
+def _inner_extra(eqn) -> int:
+    """Transient HBM a primitive's sub-jaxpr needs beyond its own
+    boundary buffers (worst branch for ``cond``; one iteration's
+    transients for ``scan``/``while`` — buffers are reused per step)."""
+    extra = 0
+    for inner, _mult in eqn_subjaxprs(eqn):
+        peak, boundary = _open_peak(inner)
+        extra = max(extra, max(0, peak - boundary))
+    return extra
+
+
+def _liveness(jaxpr, donated: frozenset) -> Dict[str, int]:
+    last = _last_uses(jaxpr)
+    alive: Set[object] = set()
+    cur = 0
+    args_bytes = consts_bytes = 0
+    for v in jaxpr.constvars:
+        consts_bytes += aval_bytes(v.aval)
+    for v in jaxpr.invars:
+        args_bytes += aval_bytes(v.aval)
+    for v in list(jaxpr.constvars) + list(jaxpr.invars):
+        if v in alive:
+            continue
+        alive.add(v)
+        cur += aval_bytes(v.aval)
+        if v not in donated:
+            # the caller owns a non-donated input: its buffer exists for
+            # the whole program whether or not we still read it
+            last[v] = max(last.get(v, 0), len(jaxpr.eqns))
+    peak = cur
+    # free never-read donated inputs/consts immediately
+    for v in list(alive):
+        if last.get(v, -1) < 0:
+            cur -= aval_bytes(v.aval)
+            alive.discard(v)
+    for i, eqn in enumerate(jaxpr.eqns):
+        born = sum(aval_bytes(v.aval) for v in eqn.outvars)
+        peak = max(peak, cur + born + _inner_extra(eqn))
+        for v in eqn.outvars:
+            if v not in alive:
+                alive.add(v)
+                cur += aval_bytes(v.aval)
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if _is_var(v) and v in alive and last.get(v, -1) <= i:
+                cur -= aval_bytes(v.aval)
+                alive.discard(v)
+    out_bytes = sum(aval_bytes(v.aval) for v in jaxpr.outvars
+                    if hasattr(v, "aval"))
+    return {"peak_bytes": peak, "args_bytes": args_bytes,
+            "consts_bytes": consts_bytes, "out_bytes": out_bytes,
+            "end_bytes": cur}
+
+
+def peak_live_bytes(closed, donate_argnums: Sequence[int] = ()
+                    ) -> Dict[str, int]:
+    """Static peak-live-bytes estimate of a (closed) jaxpr.
+
+    A liveness walk over eqn outputs: every buffer is born at its
+    producing eqn, dies after its last read, non-donated arguments and
+    jaxpr outputs stay live for the whole program, and donated arguments
+    are credited back at their donation point (last read — XLA reuses the
+    buffer for a shape/dtype-matched output from there).  Sub-jaxprs
+    (scan/while/cond bodies) contribute their transient peak on top of
+    the live set at their eqn.  Returns ``{"peak_bytes", "args_bytes",
+    "consts_bytes", "out_bytes", "donated_bytes"}``."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    donated = frozenset(jaxpr.invars[i] for i in donate_argnums
+                        if 0 <= i < len(jaxpr.invars))
+    stats = _liveness(jaxpr, donated)
+    stats["donated_bytes"] = sum(aval_bytes(v.aval) for v in donated)
+    del stats["end_bytes"]
+    return stats
 
 
 def hlo_control_flow(hlo_text: str) -> List[str]:

@@ -1,0 +1,25 @@
+"""Whole-stack static safety passes (``lint --race/--protocol/--hbm``).
+
+PR 1's trace-time lint sees the *compiled program*; these passes see the
+*host program around it* — the lock-guarded serving/observability classes,
+the rank-conditional gang protocol, and the static HBM footprint of the
+compiled steps.  Every recent incident class fixed by hand (abandoned
+worker commits, the read-first grow deadlock, flushed-buffer span
+mutations) was a statically detectable lock-discipline or barrier-ordering
+bug; these passes turn those conventions into checked gates.
+
+- ``race``     — lock-discipline checker over the known concurrent classes
+- ``protocol`` — barrier/collective matching over the gang protocol
+- ``hbm``      — static peak-live-bytes + donation audit of compiled steps
+
+All three emit :class:`paddle_tpu.analysis.findings.Finding` and honor the
+existing suppression planes (``# tpu-lint: disable=`` directives and
+``--allowlist``); the race pass adds ``# tpu-lint: guarded-by=`` (see
+docs/lint.md).
+"""
+
+from paddle_tpu.analysis.static.hbm import audit_hbm_jaxpr, run_hbm
+from paddle_tpu.analysis.static.protocol import run_protocol
+from paddle_tpu.analysis.static.race import run_race
+
+__all__ = ["run_race", "run_protocol", "run_hbm", "audit_hbm_jaxpr"]

@@ -1,0 +1,411 @@
+"""``lint --protocol`` — barrier/collective protocol checker.
+
+The gang protocol (elastic resize, checkpoint commit, SDC voting) is a
+set of *matched* blocking ops: every rank must reach the same barriers /
+exchanges in the same order, or the gang deadlocks.  Two incident classes
+were fixed by hand and are exactly the shapes this pass detects:
+
+- **unmatched collective** (``protocol-unmatched``, ERROR): a
+  rank-conditional branch (``if rank == 0:`` / ``gang.is_coordinator``)
+  after which one side can reach a collective the other side cannot;
+- **order inversion** (``protocol-order``, ERROR): both sides reach the
+  same collectives but in different order — the read-first grow deadlock
+  (PR 8): the joiner read the resume broadcast *before* the barrier while
+  the coordinator barriered before publishing, so neither advanced;
+- **exception edge** (``protocol-exception``): an ``except`` handler that
+  swallows (never re-raises) around — or returning past — collectives its
+  peers still block on: the abandoned-worker commit shape (PR 6), one
+  rank silently leaving the protocol mid-step.
+
+The checker parses the protocol modules (trainer, cluster, checkpoint_io,
+integrity by default), builds a call graph (``self.m()`` within a class,
+bare names within a module, then globally-unique bare names across the
+scanned set), linearizes each function into its ordered collective
+sequence, and compares the two sides of every rank-conditional branch —
+including the shared fall-through continuation, which a side that
+``return``s early never reaches.  A ``barrier=gang.barrier`` keyword
+*reference* counts as reaching a barrier (the t5x-style commit protocol
+passes the collective down as a callback).
+
+Findings carry the ``if``/handler line, so the standard
+``# tpu-lint: disable=protocol-*`` line/def directives apply; genuinely
+one-sided ops matched cross-function (the coordinator-only resume
+broadcast consumed by ``_gang_join``) are annotated in place, each naming
+its invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.findings import (Finding, line_suppressions,
+                                          suppressed)
+
+__all__ = ["run_protocol", "scan_modules", "DEFAULT_PROTOCOL_TARGETS",
+           "COLLECTIVES"]
+
+DEFAULT_PROTOCOL_TARGETS = (
+    "trainer/trainer.py",
+    "resilience/cluster.py",
+    "resilience/checkpoint_io.py",
+    "resilience/integrity.py",
+)
+
+#: blocking collective ops every rank must reach together.  One-sided ops
+#: (ack_resize, poll_world, epoch publishes) are deliberately absent:
+#: they have a single blocked peer by design and matching them would
+#: flag the protocol's own implementation.
+COLLECTIVES = frozenset({
+    "barrier", "exchange_json", "broadcast_json", "allgather",
+    "all_gather", "process_allgather", "broadcast_one_to_all",
+})
+
+# no \b guards: 'is_coordinator' / 'local_rank' must match, and an
+# underscore is a word character, so word boundaries would miss them
+_RANK_RE = re.compile(r"(rank|coordinator|chief|leader)", re.IGNORECASE)
+
+#: an op occurrence: (collective name, source line, note)
+_Op = Tuple[str, int, str]
+
+
+class _Module:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.sup = line_suppressions(source)
+        self.func_ranges = [
+            (n.lineno, max(n.lineno, getattr(n, "end_lineno", n.lineno)))
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        #: top-level functions by name
+        self.functions: Dict[str, ast.AST] = {}
+        #: class -> method -> node
+        self.classes: Dict[str, Dict[str, ast.AST]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                meths = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        meths[sub.name] = sub
+                self.classes[node.name] = meths
+
+
+class _Checker:
+    def __init__(self, modules: List[_Module]):
+        self.modules = modules
+        self.findings: List[Finding] = []
+        #: (module-path, class-or-None, func) -> summary op list
+        self._summaries: Dict[Tuple[str, Optional[str], str], List[_Op]] = {}
+        self._stack: Set[Tuple[str, Optional[str], str]] = set()
+        #: bare name -> (module, class, name) when globally unique
+        self._global: Dict[str, Tuple[_Module, Optional[str], str]] = {}
+        counts: Dict[str, int] = {}
+        for mod in modules:
+            for fn in mod.functions:
+                counts[fn] = counts.get(fn, 0) + 1
+                self._global[fn] = (mod, None, fn)
+        for name, n in counts.items():
+            if n > 1:
+                del self._global[name]
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve(self, call: ast.Call, mod: _Module,
+                 cls: Optional[str]) -> Optional[Tuple[_Module,
+                                                       Optional[str], str]]:
+        fn = call.func
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self" and cls is not None
+                and fn.attr in mod.classes.get(cls, {})):
+            return (mod, cls, fn.attr)
+        if isinstance(fn, ast.Name):
+            if fn.id in mod.functions:
+                return (mod, None, fn.id)
+            return self._global.get(fn.id)
+        return None
+
+    def summary(self, mod: _Module, cls: Optional[str],
+                name: str) -> List[_Op]:
+        key = (mod.path, cls, name)
+        if key in self._summaries:
+            return self._summaries[key]
+        if key in self._stack:
+            return []  # recursion: bounded, contributes nothing extra
+        node = (mod.classes.get(cls, {}) if cls else mod.functions).get(name)
+        if node is None:
+            return []
+        self._stack.add(key)
+        try:
+            ops, _exits = self._seq(node.body, mod, cls, emit=True)
+        finally:
+            self._stack.discard(key)
+        self._summaries[key] = ops
+        return ops
+
+    # -- expression ops ----------------------------------------------------
+
+    def _expr_ops(self, expr: ast.AST, mod: _Module,
+                  cls: Optional[str]) -> List[_Op]:
+        ops: List[_Op] = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = None
+                if isinstance(fn, ast.Attribute):
+                    name = fn.attr
+                elif isinstance(fn, ast.Name):
+                    name = fn.id
+                if name in COLLECTIVES:
+                    ops.append((name, node.lineno, ""))
+                    continue
+                target = self._resolve(node, mod, cls)
+                if target is not None:
+                    callee = self.summary(*target)
+                    ops.extend((op, node.lineno, f"via {target[2]}()")
+                               for op, _ln, _note in callee)
+                # a collective passed down as a callback reference
+                # (save_checkpoint(barrier=gang.barrier)) reaches it
+                for kw in node.keywords:
+                    for sub in ast.walk(kw.value):
+                        if (isinstance(sub, ast.Attribute)
+                                and sub.attr in COLLECTIVES
+                                and not isinstance(
+                                    getattr(sub, "ctx", None), ast.Store)):
+                            ops.append((sub.attr, node.lineno,
+                                        f"passed as {kw.arg}="))
+        return ops
+
+    # -- statement linearization -------------------------------------------
+
+    def _always_exits(self, stmts: Sequence[ast.AST]) -> bool:
+        for s in stmts:
+            if isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)):
+                return True
+            if isinstance(s, ast.If) and s.orelse and \
+                    self._always_exits(s.body) and \
+                    self._always_exits(s.orelse):
+                return True
+        return False
+
+    def _is_rank_test(self, test: ast.AST, mod: _Module) -> bool:
+        seg = None
+        try:
+            seg = ast.get_source_segment(mod.source, test)
+        except Exception:  # pragma: no cover - malformed locations
+            seg = None
+        if seg is None:
+            seg = ast.dump(test)
+        return bool(_RANK_RE.search(seg))
+
+    def _seq(self, stmts: Sequence[ast.AST], mod: _Module,
+             cls: Optional[str], *, emit: bool) -> Tuple[List[_Op], bool]:
+        """Linearize ``stmts`` into ordered collective ops; ``emit``
+        controls whether divergence findings fire (a function body is
+        checked once — inlined callers reuse the summary silently)."""
+        ops: List[_Op] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.If):
+                then_ops, then_exit = self._seq(s.body, mod, cls, emit=emit)
+                else_ops, else_exit = self._seq(s.orelse, mod, cls,
+                                                emit=emit)
+                if self._is_rank_test(s.test, mod):
+                    rest_ops, rest_exit = self._seq(
+                        stmts[i + 1:], mod, cls, emit=emit)
+                    side_a = then_ops + ([] if then_exit else rest_ops)
+                    side_b = else_ops + ([] if else_exit else rest_ops)
+                    if emit:
+                        self._compare(s, side_a, side_b, mod)
+                    merged = ops + _first_order(side_a + side_b)
+                    return merged, (then_exit and else_exit) or rest_exit
+                ops.extend(then_ops)
+                ops.extend(else_ops)
+                if then_exit and else_exit:
+                    return ops, True
+                continue
+            if isinstance(s, ast.Try):
+                body_ops, body_exit = self._seq(s.body, mod, cls, emit=emit)
+                rest_ops, _ = self._seq(stmts[i + 1:], mod, cls, emit=False)
+                for h in s.handlers:
+                    h_ops, h_exit = self._seq(h.body, mod, cls, emit=emit)
+                    swallows = not any(isinstance(n, ast.Raise)
+                                       for n in ast.walk(h))
+                    if not swallows or not emit:
+                        continue
+                    skipped = [op for op in body_ops
+                               if op[0] not in {o[0] for o in h_ops}]
+                    after = [op for op in rest_ops
+                             if op[0] not in {o[0] for o in h_ops}]
+                    if skipped and not suppressed(
+                            "protocol-exception", h.lineno, mod.sup,
+                            mod.func_ranges):
+                        self.findings.append(Finding(
+                            check="protocol-exception", severity="WARN",
+                            file=mod.path, line=h.lineno,
+                            message=f"except handler swallows mid-protocol"
+                            f": a raise before "
+                            f"{_names(skipped)} (line "
+                            f"{skipped[0][1]}) leaves peers blocked there "
+                            f"while this rank continues"))
+                    elif h_exit and after and not suppressed(
+                            "protocol-exception", h.lineno, mod.sup,
+                            mod.func_ranges):
+                        self.findings.append(Finding(
+                            check="protocol-exception", severity="ERROR",
+                            file=mod.path, line=h.lineno,
+                            message=f"except handler exits past "
+                            f"{_names(after)} that the success path still "
+                            f"reaches — an abandoned rank skips a "
+                            f"collective its peers block on (the "
+                            f"abandoned-commit shape)"))
+                ops.extend(body_ops)
+                # handler ops are modeled via findings, not the sequence
+                fin_ops, _ = self._seq(s.finalbody, mod, cls, emit=emit)
+                ops.extend(fin_ops)
+                if body_exit:
+                    return ops, True
+                continue
+            if isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                # a loop body runs 0..N times: its ops are *conditional*;
+                # model one iteration for reachability
+                t_ops, _ = self._seq(s.body, mod, cls, emit=emit)
+                if isinstance(s, ast.While):
+                    ops.extend(self._expr_ops(s.test, mod, cls))
+                ops.extend(t_ops)
+                e_ops, _ = self._seq(s.orelse, mod, cls, emit=emit)
+                ops.extend(e_ops)
+                continue
+            if isinstance(s, ast.With):
+                for item in s.items:
+                    ops.extend(self._expr_ops(item.context_expr, mod, cls))
+                t_ops, t_exit = self._seq(s.body, mod, cls, emit=emit)
+                ops.extend(t_ops)
+                if t_exit:
+                    return ops, True
+                continue
+            if isinstance(s, (ast.Return, ast.Raise)):
+                if getattr(s, "value", None) is not None:
+                    ops.extend(self._expr_ops(s.value, mod, cls))
+                if isinstance(s, ast.Raise) and s.exc is not None:
+                    ops.extend(self._expr_ops(s.exc, mod, cls))
+                return ops, True
+            if isinstance(s, (ast.Continue, ast.Break)):
+                return ops, True
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue  # nested defs run later, not on this path
+            ops.extend(self._expr_ops(s, mod, cls))
+        return ops, False
+
+    def _compare(self, node: ast.If, side_a: List[_Op], side_b: List[_Op],
+                 mod: _Module) -> None:
+        a, b = _first_order(side_a), _first_order(side_b)
+        names_a = [op[0] for op in a]
+        names_b = [op[0] for op in b]
+        if set(names_a) == set(names_b):
+            if names_a != names_b:
+                if not suppressed("protocol-order", node.lineno, mod.sup,
+                                  mod.func_ranges):
+                    self.findings.append(Finding(
+                        check="protocol-order", severity="ERROR",
+                        file=mod.path, line=node.lineno,
+                        message=f"rank-conditional branches reach the same "
+                        f"collectives in DIFFERENT order (one side "
+                        f"{' -> '.join(names_a)}, the other "
+                        f"{' -> '.join(names_b)}) — the read-first grow "
+                        f"deadlock shape: each side blocks where the "
+                        f"other has not arrived"))
+            return
+        if suppressed("protocol-unmatched", node.lineno, mod.sup,
+                      mod.func_ranges):
+            return
+        only_a = [op for op in a if op[0] not in set(names_b)]
+        only_b = [op for op in b if op[0] not in set(names_a)]
+        for side, ops in (("taken", only_a), ("not-taken", only_b)):
+            if not ops:
+                continue
+            cites = ", ".join(
+                f"{op}@line {ln}" + (f" ({note})" if note else "")
+                for op, ln, note in ops)
+            self.findings.append(Finding(
+                check="protocol-unmatched", severity="ERROR",
+                file=mod.path, line=node.lineno,
+                message=f"only the {side} branch of this rank-conditional "
+                f"can reach {cites}; ranks on the other side never "
+                f"arrive, so the collective blocks forever"))
+
+
+def _first_order(ops: List[_Op]) -> List[_Op]:
+    """Dedup to first occurrence per collective, preserving order — the
+    comparison unit (repeat counts are implementation detail; ORDER and
+    MEMBERSHIP are the protocol)."""
+    seen: Set[str] = set()
+    out: List[_Op] = []
+    for op in ops:
+        if op[0] not in seen:
+            seen.add(op[0])
+            out.append(op)
+    return out
+
+
+def _names(ops: List[_Op]) -> str:
+    return "/".join(sorted({op[0] for op in ops}))
+
+
+def scan_modules(paths: Sequence[str]) -> List[Finding]:
+    modules: List[_Module] = []
+    findings: List[Finding] = []
+    for path in paths:
+        if not os.path.exists(path):
+            findings.append(Finding(
+                check="protocol-target", severity="ERROR", file=path,
+                message="no such file"))
+            continue
+        with open(path) as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                check="protocol-parse", severity="ERROR", file=path,
+                line=e.lineno, message=f"unparsable: {e.msg}"))
+            continue
+        modules.append(_Module(path, source, tree))
+    checker = _Checker(modules)
+    for mod in modules:
+        for fn in mod.functions:
+            checker.summary(mod, None, fn)
+        for cls, meths in mod.classes.items():
+            for m in meths:
+                checker.summary(mod, cls, m)
+    findings.extend(checker.findings)
+    return findings
+
+
+def run_protocol(paths: Sequence[str] = ()) -> List[Finding]:
+    """Protocol-check ``paths`` (files or trees); with none given, the
+    gang-protocol modules of the installed package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    files: List[str] = []
+    if not paths:
+        files = [os.path.join(pkg, rel) for rel in DEFAULT_PROTOCOL_TARGETS]
+    else:
+        for p in paths:
+            if os.path.isdir(p):
+                for root, dirs, names in os.walk(p):
+                    dirs[:] = [d for d in dirs
+                               if d not in ("__pycache__", ".git")]
+                    files.extend(os.path.join(root, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".py"))
+            else:
+                files.append(p)
+    return scan_modules(files)

@@ -1,0 +1,463 @@
+"""``lint --race`` — static lock-discipline checker for the host tier.
+
+The reference Paddle hand-audited its threading (MultiGradientMachine
+worker threads, pserver RPC); our rewrite replaced that with lock-guarded
+host classes (SlotScheduler, BatchQueue, WorkerSupervisor, the metrics
+registry, the journal, the tracer).  The discipline is a *convention* —
+"``self._lock`` guards the slot table" — that nothing checks.  This pass
+checks it:
+
+1. **Guard inference.**  For every class that owns a lock attribute
+   (``self._lock = threading.Lock()`` / ``RLock`` / ``Condition``), every
+   mutable ``self.<field>`` that is written at least once inside a
+   ``with self.<lock>:`` block is inferred *guarded by* that lock.
+2. **Unguarded access.**  Any read (WARN) or write (ERROR) of a guarded
+   field outside the guard — in any method a foreign thread can enter
+   (conservatively: every method except ``__init__``; a private helper
+   whose every intraclass call site holds the lock inherits it as
+   *held-on-entry*) — is a finding.
+3. **Lock-order inversion.**  ``with B:`` nested (lexically or through a
+   held-on-entry helper) inside ``with A:`` adds the edge A→B to a global
+   lock graph across all scanned files; any cycle is an ERROR naming the
+   participating locks.
+
+Intentional lock-free fields declare themselves with an annotation that
+MUST name its invariant::
+
+    self.closed = False  # tpu-lint: guarded-by=none - monotonic flag,
+                         # single writer, stale read only delays shutdown
+
+``guarded-by=<lockattr>`` instead *overrides* the inferred guard; on an
+access line (rather than the ``__init__`` assignment) it exempts just that
+line.  An annotation without invariant text is itself an ERROR
+(``race-annotation``) — the whole point is that the invariant is written
+down.  ``# tpu-lint: disable=race-*`` line/def directives work as for
+every other AST check.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.findings import (Finding, line_suppressions,
+                                          suppressed)
+
+__all__ = ["run_race", "scan_file", "DEFAULT_RACE_TARGETS"]
+
+#: the known concurrent modules (ISSUE: serving tier, data prefetch,
+#: observability, gang cluster) — the default ``--race`` target set
+DEFAULT_RACE_TARGETS = (
+    "serving/server.py",
+    "serving/slots.py",
+    "serving/batching.py",
+    "serving/worker.py",
+    "data/feeder.py",
+    "obs/registry.py",
+    "obs/journal.py",
+    "obs/trace.py",
+    "resilience/cluster.py",
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+_GUARDED_BY = re.compile(
+    r"#\s*tpu-lint:\s*guarded-by=(\w+)\s*(?:[-—–:]\s*(\S.*))?")
+
+_SKIP_METHODS = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func) or ""
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in _LOCK_FACTORIES
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    line: int
+    held: Tuple[str, ...]
+    method: str
+
+
+@dataclass
+class _ClassScan:
+    name: str
+    locks: Set[str] = field(default_factory=set)
+    accesses: List[_Access] = field(default_factory=list)
+    #: (method, acquired-lock, locally-held-at-acquire, line)
+    acquires: List[Tuple[str, str, Tuple[str, ...], int]] = \
+        field(default_factory=list)
+    #: intraclass call sites: callee -> [(caller, held-at-site)]
+    calls: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = \
+        field(default_factory=dict)
+    methods: Set[str] = field(default_factory=set)
+    #: guarded-by policy from annotated __init__ assignments:
+    #: field -> (lockname-or-'none', line)
+    policy: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+
+
+class _MethodVisitor:
+    """Walks one method body tracking the lexically-held lock set."""
+
+    def __init__(self, scan: _ClassScan, method: str,
+                 module_locks: Set[str], annotations: Dict[int, tuple]):
+        self.scan = scan
+        self.method = method
+        self.module_locks = module_locks
+        self.annotations = annotations
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in self.scan.locks):
+            return expr.attr
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+    def visit(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                self.visit(item.context_expr, held)
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    acquired.append(lk)
+                    self.scan.acquires.append(
+                        (self.method, lk, held, node.lineno))
+            inner = held + tuple(a for a in acquired if a not in held)
+            for stmt in node.body:
+                self.visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def/lambda may run on another thread (Thread
+            # target, callback): its body holds NOTHING
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for stmt in body:
+                self.visit(stmt, ())
+            return
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            if node.attr not in self.scan.locks:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                self.scan.accesses.append(_Access(
+                    node.attr, write, node.lineno, held, self.method))
+            return  # self.<attr> has no deeper self references
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"):
+                self.scan.calls.setdefault(fn.attr, []).append(
+                    (self.method, held))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, held)
+
+
+def _scan_class(cls: ast.ClassDef, module_locks: Set[str],
+                annotations: Dict[int, tuple]) -> _ClassScan:
+    scan = _ClassScan(cls.name)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    scan.methods = {m.name for m in methods}
+    # pass 1: lock attributes + annotated field policies
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, value = node.target, node.value
+            else:
+                continue
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                if _is_lock_ctor(value):
+                    scan.locks.add(tgt.attr)
+                ann = annotations.get(node.lineno)
+                if ann is not None:
+                    scan.policy[tgt.attr] = (ann[0], node.lineno)
+    # pass 2: accesses / acquisitions / intraclass calls
+    for m in methods:
+        v = _MethodVisitor(scan, m.name, module_locks, annotations)
+        for stmt in m.body:
+            v.visit(stmt, ())
+    return scan
+
+
+def _held_on_entry(scan: _ClassScan) -> Dict[str, frozenset]:
+    """Locks a method provably holds on EVERY entry: the intersection over
+    its intraclass call sites of (locks held at the site + the caller's
+    own held-on-entry).  Public methods and uncalled helpers get the empty
+    set — anyone may call them bare."""
+    he: Dict[str, frozenset] = {m: frozenset() for m in scan.methods}
+    for _ in range(4):  # tiny graphs; fixpoint in a few rounds
+        changed = False
+        for m in scan.methods:
+            sites = scan.calls.get(m, ())
+            if not m.startswith("_") or not sites:
+                continue
+            acc: Optional[frozenset] = None
+            for caller, held in sites:
+                eff = frozenset(held) | he.get(caller, frozenset())
+                acc = eff if acc is None else (acc & eff)
+            acc = acc or frozenset()
+            if acc != he[m]:
+                he[m] = acc
+                changed = True
+        if not changed:
+            break
+    return he
+
+
+def _module_scan(tree: ast.Module, module_locks: Set[str],
+                 acquires: List[Tuple[str, str, Tuple[str, ...], int]]):
+    """Module-level functions contribute lock-ORDER edges only (module
+    locks guard module globals, which this pass does not model)."""
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                name = None
+                expr = item.context_expr
+                if isinstance(expr, ast.Name) and expr.id in module_locks:
+                    name = f"<module>.{expr.id}"
+                if name is not None:
+                    acquired.append(name)
+                    acquires.append(("<module>", name, held, node.lineno))
+            inner = held + tuple(acquired)
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        if isinstance(node, ast.ClassDef):
+            return  # classes handled separately
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for s in stmt.body:
+                walk(s, ())
+
+
+def scan_file(path: str,
+              edges: Optional[List[Tuple[str, str, str, int]]] = None
+              ) -> List[Finding]:
+    """Race-lint one file.  ``edges`` (if given) collects qualified
+    lock-order edges ``(held, acquired, file, line)`` for the caller's
+    global cycle detection instead of per-file."""
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(check="race-parse", severity="ERROR", file=path,
+                        line=e.lineno, message=f"unparsable: {e.msg}")]
+
+    sup = line_suppressions(source)
+    func_ranges = [(n.lineno, max(n.lineno, getattr(n, "end_lineno", n.lineno)))
+                   for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    annotations: Dict[int, tuple] = {}
+    findings: List[Finding] = []
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _GUARDED_BY.search(line)
+        if m:
+            annotations[i] = (m.group(1), (m.group(2) or "").strip())
+            if not (m.group(2) or "").strip():
+                findings.append(Finding(
+                    check="race-annotation", severity="ERROR", file=path,
+                    line=i, message="guarded-by annotation must name its "
+                    "invariant: '# tpu-lint: guarded-by=<lock|none> - "
+                    "<why this is safe>'"))
+
+    module_locks = {
+        t.targets[0].id if isinstance(t, ast.Assign) else t.target.id
+        for t in tree.body
+        if (isinstance(t, ast.Assign) and len(t.targets) == 1
+            and isinstance(t.targets[0], ast.Name)
+            and _is_lock_ctor(t.value))
+        or (isinstance(t, ast.AnnAssign) and isinstance(t.target, ast.Name)
+            and t.value is not None and _is_lock_ctor(t.value))}
+
+    local_edges: List[Tuple[str, str, str, int]] = []
+    sink = edges if edges is not None else local_edges
+    mod_acquires: List[Tuple[str, str, Tuple[str, ...], int]] = []
+    _module_scan(tree, module_locks, mod_acquires)
+    for _fn, lk, held, line in mod_acquires:
+        for h in held:
+            sink.append((h, lk, path, line))
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        scan = _scan_class(cls, module_locks, annotations)
+        if not scan.locks:
+            continue
+        he = _held_on_entry(scan)
+
+        def qual(lock: str) -> str:
+            return lock if lock.startswith("<module>") else \
+                f"{scan.name}.{lock}"
+
+        for method, lk, held, line in scan.acquires:
+            for h in tuple(held) + tuple(he.get(method, ())):
+                if h != lk:
+                    sink.append((qual(h), qual(lk), path, line))
+
+        # guard inference: a field WRITTEN under a lock (outside __init__)
+        # is guarded by the lock most of its guarded writes hold.  Reads
+        # never vote: a field only ever written at construction cannot
+        # race, however many locked reads it has
+        votes: Dict[str, Dict[str, int]] = {}
+        for a in scan.accesses:
+            if a.method in _SKIP_METHODS or not a.write:
+                continue
+            eff = frozenset(a.held) | he.get(a.method, frozenset())
+            for lk in eff:
+                votes.setdefault(a.attr, {})[lk] = \
+                    votes.setdefault(a.attr, {}).get(lk, 0) + 1
+        guards: Dict[str, str] = {}
+        for attr, tally in votes.items():
+            if tally:
+                guards[attr] = max(tally, key=lambda k: tally[k])
+        exempt: Set[str] = set()
+        for attr, (lockname, line) in scan.policy.items():
+            if lockname == "none":
+                exempt.add(attr)
+            elif lockname in scan.locks:
+                guards[attr] = lockname
+            else:
+                findings.append(Finding(
+                    check="race-annotation", severity="ERROR", file=path,
+                    line=line,
+                    message=f"guarded-by={lockname} names no lock "
+                            f"attribute of {scan.name} (locks: "
+                            f"{sorted(scan.locks)}; use 'none' for "
+                            f"intentionally lock-free fields)"))
+
+        for a in scan.accesses:
+            if a.method in _SKIP_METHODS or a.attr in exempt:
+                continue
+            guard = guards.get(a.attr)
+            if guard is None:
+                continue  # no lock discipline exists for this field
+            eff = frozenset(a.held) | he.get(a.method, frozenset())
+            if guard in eff:
+                continue
+            if a.line in annotations:  # line-level guarded-by exemption
+                continue
+            check = "race-unguarded-write" if a.write else \
+                "race-unguarded-read"
+            if suppressed(check, a.line, sup, func_ranges):
+                continue
+            kind = "lock attribute" if not guard.startswith("<module>") \
+                else "module lock"
+            findings.append(Finding(
+                check=check,
+                severity="ERROR" if a.write else "WARN",
+                file=path, line=a.line,
+                message=f"{scan.name}.{a.attr} is guarded by {kind} "
+                        f"{guard.split('.')[-1]!r} elsewhere but "
+                        f"{'written' if a.write else 'read'} here in "
+                        f"{a.method}() without it (annotate "
+                        f"'# tpu-lint: guarded-by=none - <invariant>' if "
+                        f"intentionally lock-free)"))
+
+    if edges is None:
+        findings.extend(_order_findings(local_edges))
+    return findings
+
+
+def _order_findings(edges: Sequence[Tuple[str, str, str, int]]
+                    ) -> List[Finding]:
+    """Cycle detection over the global lock-order graph: an edge A→B means
+    B was acquired while A was held; any cycle is a potential deadlock."""
+    graph: Dict[str, Set[str]] = {}
+    where: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for a, b, f, line in edges:
+        graph.setdefault(a, set()).add(b)
+        where.setdefault((a, b), (f, line))
+    findings: List[Finding] = []
+    seen_cycles: Set[frozenset] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                f, line = where[(cycle[0], cycle[1])]
+                findings.append(Finding(
+                    check="race-lock-order", severity="ERROR",
+                    file=f, line=line,
+                    message="lock-order inversion: "
+                            + " -> ".join(cycle)
+                            + " (two threads taking these in opposite "
+                              "order deadlock)"))
+            elif stack.count(nxt) == 0:
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return findings
+
+
+def run_race(paths: Sequence[str] = ()) -> List[Finding]:
+    """Race-lint ``paths`` (files or trees); with none given, the known
+    concurrent modules of the installed package
+    (:data:`DEFAULT_RACE_TARGETS`)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    files: List[str] = []
+    if not paths:
+        files = [os.path.join(pkg, rel) for rel in DEFAULT_RACE_TARGETS]
+    else:
+        for p in paths:
+            if os.path.isdir(p):
+                for root, dirs, names in os.walk(p):
+                    dirs[:] = [d for d in dirs
+                               if d not in ("__pycache__", ".git")]
+                    files.extend(os.path.join(root, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".py"))
+            else:
+                files.append(p)
+    findings: List[Finding] = []
+    edges: List[Tuple[str, str, str, int]] = []
+    for f in files:
+        if not os.path.exists(f):
+            findings.append(Finding(
+                check="race-target", severity="ERROR", file=f,
+                message="no such file"))
+            continue
+        findings.extend(scan_file(f, edges))
+    findings.extend(_order_findings(edges))
+    return findings

@@ -41,8 +41,8 @@ class ServingFuture:
     def __init__(self) -> None:
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self._result: Optional[Dict[str, np.ndarray]] = None
-        self._error: Optional[Exception] = None
+        self._result: Optional[Dict[str, np.ndarray]] = None  # tpu-lint: guarded-by=none - set once under _lock BEFORE _event.set(); readers only look after _event.wait(), whose happens-before publishes the write
+        self._error: Optional[Exception] = None  # tpu-lint: guarded-by=none - same once-before-set() protocol as _result: post-wait() reads are ordered after the single write
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -247,7 +247,7 @@ class BatchQueue:
         self.max_queue = int(max_queue)
         self._q: deque = deque()
         self._cv = threading.Condition()
-        self._closed = False
+        self._closed = False  # tpu-lint: guarded-by=none - monotonic False->True flag; a stale lock-free read only delays observing shutdown by one poll (close() still wakes waiters under _cv)
 
     def depth(self) -> int:
         with self._cv:

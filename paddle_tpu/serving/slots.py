@@ -284,7 +284,7 @@ class SlotScheduler:
         self._init_carry = lambda: init_slot_carry(
             tpl, slots=self.slots, beam_size=backend.beam_size,
             max_len=backend.max_len, eos=backend.eos)
-        self.carry = self._init_carry()
+        self.carry = self._init_carry()  # tpu-lint: guarded-by=none - single stepping thread: only the worker (or boot) thread computes carry; writes take _lock purely for the abandoned-worker commit handshake, reads stay on the owning thread
         self._entries: List[Optional[_SlotEntry]] = [None] * self.slots
         self._free: List[int] = list(range(self.slots - 1, -1, -1))
         self._pending: Dict[int, _PendingRequest] = {}

@@ -57,13 +57,13 @@ class WorkerSupervisor:
         self._clock = clock
         self._sleep = sleep
         self.restarts = 0
-        self._generation = 0
+        self._generation = 0  # tpu-lint: guarded-by=none - monotonic int bumped only under _lock; lock-free == probes are advisory: an abandoned worker runs at most one extra loop, and every state COMMIT re-checks under the slot table's lock
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._worker: Optional[threading.Thread] = None
+        self._worker: Optional[threading.Thread] = None  # tpu-lint: guarded-by=none - swapped under _lock; readers snapshot the reference once (atomic under the GIL) and at worst see the previous generation's thread for one poll
         self._monitor: Optional[threading.Thread] = None
-        self._crash_exc: Optional[Exception] = None
-        self._busy_since: Optional[float] = None
+        self._crash_exc: Optional[Exception] = None  # tpu-lint: guarded-by=none - written only by the dying worker thread; the monitor reads it only after alive() goes False, and thread death publishes the write
+        self._busy_since: Optional[float] = None  # tpu-lint: guarded-by=none - atomic reference swap by the live worker only; the monitor snapshots once per poll, so a stale value shifts hang detection by at most one poll
 
     # -- the worker side ----------------------------------------------------
 

@@ -1169,7 +1169,11 @@ class SGDTrainer:
                         try:
                             self._sdc_check(gang, pass_id, batch_id,
                                             handler)
-                        except _SdcRollback as rb:
+                        # invariant: _SdcRollback is not a one-rank
+                        # escape — the vote itself is the collective, and
+                        # _sdc_check raises on EVERY rank or on none, so
+                        # no peer is left blocked in exchange_json
+                        except _SdcRollback as rb:  # tpu-lint: disable=protocol-exception
                             start_pass = rb.start_pass
                             start_batch = rb.start_batch
                             cursor_restored = False
@@ -1538,7 +1542,11 @@ class SGDTrainer:
         with gang.resizing():
             gang.adopt_world(world)
             self._resize_commit(gang, pass_id, meta)
-            if grew and gang.is_coordinator:
+            # invariant: this one-sided send pairs the JOINER's
+            # broadcast_json receive inside _gang_join (a different
+            # process, mid-join), not this function's other branch —
+            # survivors are not grew-side and never enter the collective
+            if grew and gang.is_coordinator:  # tpu-lint: disable=protocol-unmatched
                 gang.broadcast_json(
                     {"pass": pass_id if FLAGS.save_dir else -1,
                      "start_pass": start[0], "start_batch": start[1]},

@@ -1,0 +1,37 @@
+"""Planted-bug fixtures for ``lint --protocol``: exception paths that
+abandon peers inside a collective (the PR 6 abandoned-worker commit
+shape, reconstructed).
+
+``commit_with_escape``: the except handler returns past the commit
+barrier the success path still reaches — the crashed rank walks away
+while every peer blocks in ``barrier("commit")`` (``protocol-exception``
+ERROR).  ``swallow_mid_protocol``: the handler swallows an exception
+raised between two collectives, so this rank skips ``exchange_json``
+while peers wait in it (``protocol-exception`` WARN).
+``unmatched_sides``: only the coordinator reaches ``allgather``
+(``protocol-unmatched`` ERROR).
+"""
+
+
+def commit_with_escape(gang, state):
+    try:
+        state.save_local()
+    except OSError:
+        return None
+    gang.barrier("commit")
+    return state
+
+
+def swallow_mid_protocol(gang, payload):
+    try:
+        gang.exchange_json(payload)
+        payload.validate()
+    except ValueError:
+        pass
+    return payload
+
+
+def unmatched_sides(gang, rank):
+    if rank == 0:
+        return gang.allgather({"ready": True})
+    return None

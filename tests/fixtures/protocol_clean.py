@@ -1,0 +1,38 @@
+"""Clean fixture for ``lint --protocol``: rank-conditional code whose
+sides reach identical collective sequences — none of these shapes may
+produce a finding.
+
+``publish``: both sides reach ``barrier`` then ``broadcast_json`` in the
+same order (the divergence is only in the payload).  ``guarded_commit``:
+the except handler re-raises, so no peer is abandoned mid-protocol.
+``flag_conditional``: the branch tests a feature flag, not a rank — the
+checker must not treat it as a two-sided protocol.
+"""
+
+
+def publish(gang, is_coordinator, epoch):
+    gang.barrier("publish")
+    if is_coordinator:
+        gang.broadcast_json({"epoch": epoch})
+    else:
+        gang.broadcast_json(None)
+    return epoch
+
+
+def guarded_commit(gang, state):
+    try:
+        state.save_local()
+    except OSError:
+        state.mark_dirty()
+        raise
+    gang.barrier("commit")
+    return state
+
+
+def flag_conditional(gang, use_packing):
+    if use_packing:
+        payload = {"packed": True}
+    else:
+        payload = {"packed": False}
+    gang.exchange_json(payload)
+    return payload

@@ -1,0 +1,17 @@
+"""Planted-bug fixture for ``lint --protocol``: the read-first-grow
+deadlock shape (PR 8's adopt-first-grow bug, reconstructed).
+
+The coordinator reaches ``barrier`` then ``broadcast_json``; a joining
+peer reaches ``broadcast_json`` then ``barrier``.  Same collective SET,
+opposite ORDER — each side blocks in a different collective forever.
+The checker must emit ``protocol-order`` here.
+"""
+
+
+def grow_world(gang, is_coordinator):
+    if is_coordinator:
+        gang.barrier("grow")
+        gang.broadcast_json({"epoch": 1})
+    else:
+        gang.broadcast_json(None)
+        gang.barrier("grow")

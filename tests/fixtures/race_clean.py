@@ -1,0 +1,30 @@
+"""Clean fixture for ``lint --race``: every access pattern here is
+either consistently locked, construction-immutable, or annotated with
+its lock-free invariant — the pass must produce ZERO findings.
+"""
+
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.limit = 16  # never written after construction
+        self.closed = False  # tpu-lint: guarded-by=none - monotonic flag, single writer; a stale read only delays shutdown one poll
+
+    def add(self, x):
+        with self._lock:
+            if len(self.items) < self.limit:
+                self.items.append(x)
+
+    def drain(self):
+        with self._lock:
+            out, self.items = self.items, []
+            return out
+
+    def close(self):
+        self.closed = True
+
+    def is_closed(self):
+        return self.closed

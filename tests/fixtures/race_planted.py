@@ -1,0 +1,53 @@
+"""Planted-bug fixture for ``lint --race``.
+
+``Counter``: ``count`` is written under ``self._lock`` in ``incr`` but
+bumped bare in ``incr_fast`` (``race-unguarded-write`` ERROR) and read
+bare in ``peek`` (``race-unguarded-read`` WARN).  The ``snapshot``
+method's locked access stays clean, and ``bare`` (no lock discipline at
+all) must produce nothing.  ``forward``/``backward`` take the two module
+locks in opposite orders (``race-lock-order`` ERROR).  ``annotated``
+carries a ``guarded-by=none`` WITHOUT an invariant (``race-annotation``
+ERROR).
+"""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward(table):
+    with LOCK_A:
+        with LOCK_B:
+            table.append(1)
+
+
+def backward(table):
+    with LOCK_B:
+        with LOCK_A:
+            table.pop()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.bare = 0
+        self.annotated = 0  # tpu-lint: guarded-by=none
+
+    def incr(self):
+        with self._lock:
+            self.count += 1
+
+    def incr_fast(self):
+        self.count += 1
+
+    def peek(self):
+        return self.count
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+
+    def touch(self):
+        self.bare += 1

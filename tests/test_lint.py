@@ -649,3 +649,171 @@ def test_lint_obs_gates_telemetry_contract(capsys):
 
     assert run(["--obs"]) == 0
     assert "0 error(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# static passes (docs/lint.md): --race / --protocol / --hbm
+# ---------------------------------------------------------------------------
+
+FIX = os.path.join(HERE, "fixtures")
+
+
+def _by_check(findings):
+    by = {}
+    for f in findings:
+        by.setdefault(f.check, []).append(f)
+    return by
+
+
+def test_race_planted_fixture_fires_every_check():
+    from paddle_tpu.analysis.static import run_race
+
+    fs = run_race((os.path.join(FIX, "race_planted.py"),))
+    by = _by_check(fs)
+    lo = by["race-lock-order"]
+    assert lo[0].severity == "ERROR" and lo[0].line == 21
+    assert lo[0].file.endswith("race_planted.py")
+    ann = by["race-annotation"]  # guarded-by with no stated invariant
+    assert ann[0].severity == "ERROR" and ann[0].line == 36
+    wr = by["race-unguarded-write"]
+    assert wr[0].severity == "ERROR" and wr[0].line == 43
+    rd = by["race-unguarded-read"]
+    assert rd[0].severity == "WARN" and rd[0].line == 46
+
+
+def test_race_clean_fixture_quiet():
+    from paddle_tpu.analysis.static import run_race
+
+    assert run_race((os.path.join(FIX, "race_clean.py"),)) == []
+
+
+def test_protocol_grow_deadlock_fixture_caught():
+    """The PR 8 regression shape: the coordinator barriers before the
+    broadcast the joiner is blocked on — a rank-conditional order skew."""
+    from paddle_tpu.analysis.static import run_protocol
+
+    fs = run_protocol((os.path.join(FIX, "protocol_grow_deadlock.py"),))
+    hits = [f for f in fs if f.check == "protocol-order"]
+    assert hits and hits[0].severity == "ERROR" and hits[0].line == 12
+
+
+def test_protocol_abandoned_commit_fixture_caught():
+    """The PR 6 regression shape: an exception path that exits the
+    function past a collective its peers will still enter."""
+    from paddle_tpu.analysis.static import run_protocol
+
+    fs = run_protocol((os.path.join(FIX, "protocol_abandoned_commit.py"),))
+    by = _by_check(fs)
+    exc = sorted(by["protocol-exception"], key=lambda f: f.line)
+    assert [(f.severity, f.line) for f in exc] == [("ERROR", 19),
+                                                  ("WARN", 29)]
+    un = by["protocol-unmatched"]
+    assert un[0].severity == "ERROR" and un[0].line == 35
+
+
+def test_protocol_clean_fixture_quiet():
+    from paddle_tpu.analysis.static import run_protocol
+
+    assert run_protocol((os.path.join(FIX, "protocol_clean.py"),)) == []
+
+
+def test_ci_race_pass_clean_on_own_tree():
+    """Pinned gate: every shared-mutable write in the concurrent classes
+    is lock-held or carries a `guarded-by` annotation naming its
+    invariant (docs/lint.md) — a new bare write fails the suite."""
+    from paddle_tpu.analysis.static import run_race
+
+    fs = run_race(())
+    assert fs == [], [(f.file, f.line, f.check) for f in fs]
+
+
+def test_ci_protocol_pass_clean_on_own_tree():
+    """Pinned gate: trainer + resilience collectives stay order-aligned
+    across rank-conditional branches and exception paths."""
+    from paddle_tpu.analysis.static import run_protocol
+
+    fs = run_protocol(())
+    assert fs == [], [(f.file, f.line, f.check) for f in fs]
+
+
+def test_ci_hbm_audit_error_free():
+    """Pinned gate: the real compiled train/decode steps audit free of
+    donation-reuse, f64 constants, and over-capacity peaks; the stats
+    findings themselves must be present (both steps actually traced)."""
+    from paddle_tpu.analysis.static import run_hbm
+
+    fs = run_hbm()
+    assert not severity_at_least(fs, "ERROR"), \
+        [(f.check, f.message) for f in fs if f.severity == "ERROR"]
+    labels = {f.where for f in fs if f.check == "hbm-peak"}
+    assert any("train_step" in w for w in labels)
+    assert any("decode_step" in w for w in labels)
+
+
+# ---------------------------------------------------------------------------
+# SARIF output + the uniform exit-code contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_shape(capsys):
+    """--format sarif emits the SARIF 2.1.0 shape tooling expects:
+    versioned log, tool.driver.rules covering every result's ruleId,
+    physical locations for AST findings."""
+    from paddle_tpu.analysis.cli import run
+
+    rc = run(["--race", os.path.join(FIX, "race_planted.py"),
+              "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    drv = doc["runs"][0]["tool"]["driver"]
+    assert drv["name"] == "paddle-tpu-lint"
+    rule_ids = {r["id"] for r in drv["rules"]}
+    results = doc["runs"][0]["results"]
+    assert results
+    for res in results:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] in ("error", "warning", "note")
+        assert res["message"]["text"]
+        loc = res["locations"][0]
+        assert "physicalLocation" in loc or "logicalLocations" in loc
+    phys = next(r["locations"][0]["physicalLocation"] for r in results
+                if "physicalLocation" in r["locations"][0])
+    assert phys["artifactLocation"]["uri"].endswith("race_planted.py")
+    assert phys["region"]["startLine"] > 0
+
+
+def test_cli_exit_code_contract(capsys, tmp_path):
+    """The documented 0/1/2 contract (docs/lint.md): 0 clean, 1 findings
+    at/above --fail-on, 2 usage error — and a usage error is reported
+    before any pass burns time."""
+    from paddle_tpu.analysis.cli import run
+
+    assert run(["--no-such-flag"]) == 2  # argparse error -> rc 2
+    capsys.readouterr()
+    assert run(["--race", os.path.join(FIX, "race_clean.py"),
+                "--allowlist", str(tmp_path / "missing")]) == 2
+    capsys.readouterr()
+    assert run(["--race", os.path.join(FIX, "race_clean.py")]) == 0
+    capsys.readouterr()
+    assert run(["--race", os.path.join(FIX, "race_planted.py")]) == 1
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as ei:  # argparse's own --help exit
+        run(["--help"])
+    assert ei.value.code == 0
+    capsys.readouterr()
+
+
+@pytest.mark.slow
+def test_cli_all_runs_every_pass_clean(capsys):
+    """`lint --all` is the one-shot CI surface: every pass over the
+    package tree, ERROR-free."""
+    from paddle_tpu.analysis.cli import run
+
+    rc = run(["--all", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    checks = {f["check"] for f in out["findings"]}
+    assert any(c.startswith("hbm-") for c in checks)  # hbm stats present
+    assert rc == 0, [f for f in out["findings"]
+                     if f["severity"] == "ERROR"]

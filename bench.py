@@ -1427,6 +1427,133 @@ def bench_sdc_overhead_ab(rtt, peak):
     }
 
 
+def bench_publish_reload_ab(rtt, peak):
+    """A/B the continuous-publishing reload path (docs/publish.md):
+    adopting a newly published model version by RESTART (close the
+    server, boot a fresh one from the new version — even with the warm
+    shared compile cache) vs HOT SWAP (HotSwapManager.poll: load + prime
+    off the hot path + atomic runner swap) under a live request stream.
+    ``value`` is hot-swap-to-ready; ``vs_baseline`` the restart/hot-swap
+    ratio.  The restart path's unavailability window IS its ready
+    latency; the hot-swap window must also drop ZERO streamed requests,
+    or the swap does not win.  ``default_flag`` mirrors --serve_watch
+    (the hot-swap serve loop is opt-in)."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _t
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.publish import publish_cache_dir, publish_from_checkpoints
+    from paddle_tpu.serving.reload import HotSwapManager, load_published
+    from paddle_tpu.serving.server import InferenceServer
+    from paddle_tpu.trainer import SGDTrainer
+    from paddle_tpu.utils.flags import FLAGS
+
+    root = tempfile.mkdtemp(prefix="publish_reload_ab_")
+    try:
+        nn.reset_naming()
+        x = nn.data("x", size=128)
+        h = nn.fc(x, 256, act="tanh", name="h")
+        out = nn.fc(h, 64, act="softmax", name="out")
+        label = nn.data("label", size=1, dtype="int32")
+        cost = nn.classification_cost(out, label, name="cost")
+        tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+        batch = {"x": np.zeros((8, 128), np.float32),
+                 "label": np.zeros((8, 1), np.int32)}
+        req = {"x": np.zeros((1, 128), np.float32),
+               "label": np.zeros((1, 1), np.int32)}
+        save, pub = os.path.join(root, "ckpt"), os.path.join(root, "pub")
+        for p in range(3):               # v1..v3, one pass apiece
+            tr.train_batch(batch)
+            tr.save(save, p)
+            publish_from_checkpoints(pub, tr.topology, save,
+                                     warm_max_batch=8)
+
+        def boot(max_version):
+            model, info, v = load_published(pub, max_version=max_version)
+            srv = InferenceServer(model, max_batch=8,
+                                  default_deadline_ms=60000)
+            srv.start(compile_cache=publish_cache_dir(pub))
+            return srv, info, v
+
+        # one live stream spans BOTH adoption strategies: per-phase
+        # failed requests are the downtime each strategy charges
+        srv_ref = [None]
+        errors = [0]
+        done = [0]
+        stop = threading.Event()
+
+        def stream():
+            while not stop.is_set():
+                try:
+                    srv_ref[0].infer(req, deadline_ms=60000)
+                    done[0] += 1
+                except Exception:  # noqa: BLE001 — a drop is the metric
+                    errors[0] += 1
+                    _t.sleep(0.002)      # closed server fails instantly
+
+        srv_ref[0], _, _ = boot(1)
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        _t.sleep(0.05)                   # stream established
+
+        # A) restart adoption: v2 lands -> close + fresh boot.  Ready
+        #    latency == unavailability window: every streamed request in
+        #    it fails (server_closed / no server).
+        t0 = _t.perf_counter()
+        old = srv_ref[0]
+        old.close()
+        srv_ref[0], info, v = boot(2)
+        restart_s = _t.perf_counter() - t0
+        restart_errors = errors[0]
+
+        # B) hot-swap adoption on the SAME server: v3 lands -> poll()
+        #    primes off the hot path and swaps between batches; the
+        #    stream must not lose a single request.
+        srv = srv_ref[0]
+        mgr = HotSwapManager(srv, pub, probation_requests=4)
+        mgr.attach_current(v, info)
+        errors[0] = 0
+        t0 = _t.perf_counter()
+        act = mgr.poll()
+        hot_swap_s = _t.perf_counter() - t0
+        while mgr.in_probation:
+            mgr.tick()
+            _t.sleep(0.005)
+        stop.set()
+        th.join(10)
+        swap_errors = errors[0]
+        swapped = bool(act and act.get("action") == "swapped")
+        srv.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    if swapped and swap_errors == 0 and (restart_errors
+                                         or hot_swap_s < 0.95 * restart_s):
+        winner = "hot_swap"
+    elif swap_errors or hot_swap_s > 1.05 * restart_s:
+        winner = "restart"
+    else:
+        winner = "tie"
+    return {
+        "metric": "publish_reload_ab_hot_swap_to_ready_s(live_stream)",
+        "short": "publish_reload_ab",
+        "value": round(hot_swap_s, 3),
+        "unit": "s",
+        "mfu": None,
+        "vs_baseline": round(restart_s / max(hot_swap_s, 1e-9), 3),
+        "restart_to_ready_s": round(restart_s, 3),
+        "hot_swap_to_ready_s": round(hot_swap_s, 3),
+        "stream_completed": done[0],
+        "restart_window_errors": restart_errors,
+        "hot_swap_window_errors": swap_errors,
+        "winner": winner,
+        "default_flag": bool(FLAGS.serve_watch),
+    }
+
+
 # ---------------------------------------------------------------------------
 # --check: regression gate against the newest BENCH_r*.json capture
 # ---------------------------------------------------------------------------
@@ -1454,6 +1581,7 @@ ROWS = {
     "googlenet_b64": lambda r, p: bench_googlenet(r, p, batch_size=64),
     "googlenet_b128": bench_googlenet,
     "googlenet_b256": lambda r, p: bench_googlenet(r, p, batch_size=256),
+    "publish_reload_ab": bench_publish_reload_ab,
 }
 
 
@@ -1659,6 +1787,7 @@ def main(argv=None) -> int:
         safe(bench_cold_start_ab),
         safe(bench_trace_overhead_ab),
         safe(bench_sdc_overhead_ab),
+        safe(bench_publish_reload_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

@@ -213,6 +213,7 @@ _USAGE = """\
 usage: python -m paddle_tpu --job={train|test|checkgrad|time} --config=CONF.py [--flag=value ...]
        python -m paddle_tpu lint [--config CONF|--path DIR|--serve BUNDLE|--obs|--race|--protocol|--hbm|--all] [--format text|json|sarif] ...
        python -m paddle_tpu serve --serve_bundle=MODEL.ptz [--serve_* ...]
+       python -m paddle_tpu serve --serve_watch --publish_dir=DIR [--serve_* ...]
        python -m paddle_tpu obs {merge|dump|trace} DIR_OR_FILE... [--format text|json|perfetto]
        python -m paddle_tpu data {pack|verify} ... (indexed record shards, docs/data.md)
        python -m paddle_tpu fsck DIR_OR_BUNDLE... [--quarantine] (at-rest integrity scrub, docs/resilience.md)

@@ -405,6 +405,8 @@ class InferenceModel:
                 h.update(f"{k}:{tuple(a.shape)}:{a.dtype}".encode())
             fingerprint = h.hexdigest()[:32]
         self.fingerprint = fingerprint
+        #: source artifact path (set by load_inference_model)
+        self.bundle_path = ""
         #: XLA compiles this process actually paid (prime misses + cold
         #: infer signatures) — the cold-start acceptance counter
         self.compile_events = 0
@@ -644,7 +646,8 @@ def _read_member(z: zipfile.ZipFile, path: str, name: str) -> bytes:
 
 
 def load_inference_model(path: str, *,
-                         int8_in_trace: bool = False) -> InferenceModel:
+                         int8_in_trace: bool = False,
+                         arch_fingerprint: bool = False) -> InferenceModel:
     """Load a ``.ptz`` bundle into a servable :class:`InferenceModel`.
 
     Quantized bundles (``merge_model(quantize=...)``) dequantize on load
@@ -652,7 +655,14 @@ def load_inference_model(path: str, *,
     matmul weights instead stay quantized in HBM and dequantize inside
     the compiled forward (to the compute dtype), gated by the lint
     auditor — a gate failure logs and falls back to load-time
-    dequantization, never a silently degraded program."""
+    dequantization, never a silently degraded program.
+
+    ``arch_fingerprint`` keys the compile cache by the ARCHITECTURE
+    (config proto + parameter shapes/dtypes) instead of the bundle's
+    byte CRCs: parameters ride every compiled call as arguments, so two
+    weight versions of one model share warmed executables — the hot-swap
+    reload path (serving/reload.py) depends on this to pay zero XLA
+    compiles when v2 replaces v1."""
     try:
         zf = zipfile.ZipFile(path, "r")
     except FileNotFoundError:
@@ -702,6 +712,11 @@ def load_inference_model(path: str, *,
         crcs = {i.filename: i.CRC for i in z.infolist()}
     fp = "bundle:" + "-".join(
         f"{crcs.get(m, 0):08x}" for m in ("model.pb", "params.npz"))
+    if arch_fingerprint:
+        # fingerprint=None -> InferenceModel derives the architecture
+        # hash (the int8 in-trace variant differs naturally: its params
+        # tree carries the int8 arrays + scale leaves)
+        fp = None
     qinfo = manifest.get("quantize") or {}
     qmeta = qinfo.get("arrays") or {}
     if qmeta:
@@ -709,8 +724,10 @@ def load_inference_model(path: str, *,
                                  for m in qmeta.values()):
             deq, int8 = _dequantize_params(params, qmeta, path=path,
                                            keep_int8=True)
-            model = InferenceModel(mc, deq, state, manifest,
-                                   fingerprint=fp + ":int8t", int8=int8)
+            model = InferenceModel(
+                mc, deq, state, manifest,
+                fingerprint=None if fp is None else fp + ":int8t",
+                int8=int8)
             if model._int8_gate():
                 return model
             from paddle_tpu.utils import logger
@@ -719,7 +736,11 @@ def load_inference_model(path: str, *,
                            "the lint gate — dequantizing at load instead",
                            path)
         params, _ = _dequantize_params(params, qmeta, path=path)
-    return InferenceModel(mc, params, state, manifest, fingerprint=fp)
+    model = InferenceModel(mc, params, state, manifest, fingerprint=fp)
+    #: the artifact the model was loaded from (the reload/healthz surface
+    #: names it; empty for models built in-process)
+    model.bundle_path = path
+    return model
 
 
 # ---------------------------------------------------------------------------

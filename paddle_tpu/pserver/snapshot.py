@@ -25,6 +25,7 @@ the rows training touched since, not V.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import re
@@ -44,9 +45,9 @@ from paddle_tpu.resilience.errors import CheckpointError
 from paddle_tpu.pserver.table import TableSpec, init_shard_rows
 from paddle_tpu.utils import logger
 
-__all__ = ["SnapshotError", "save_table_snapshot", "validate_snapshot",
-           "quarantine_snapshot", "latest_snapshot", "load_table_host",
-           "TableReader", "snap_dir"]
+__all__ = ["SnapshotError", "ReloadStopped", "save_table_snapshot",
+           "validate_snapshot", "quarantine_snapshot", "latest_snapshot",
+           "load_table_host", "TableReader", "snap_dir"]
 
 SNAPSHOT_VERSION = 1
 
@@ -305,6 +306,24 @@ def load_table_host(save_dir: str, *, upto: Optional[int] = None
     return spec, table, sid
 
 
+@dataclasses.dataclass(frozen=True)
+class ReloadStopped:
+    """Typed record of a hot-reload that could not reach the newest
+    snapshot: ``snap`` it stopped at, the failing ``member`` inside it
+    (best-effort, same extraction as checkpoint fsck), and the full
+    validation ``reason``.  Held on ``TableReader.last_stop`` so reload
+    probation logic (serving/reload.py) can see a stalled table without
+    parsing log lines."""
+
+    snap: int
+    member: str
+    reason: str
+
+    def __str__(self) -> str:
+        member = f" ({self.member})" if self.member else ""
+        return f"snap {self.snap}{member}: {self.reason}"
+
+
 class TableReader:
     """Serving-side hot-reloadable view of one snapshotted table."""
 
@@ -312,19 +331,41 @@ class TableReader:
         self.save_dir = save_dir
         self.spec, self.table, self.version = load_table_host(save_dir)
         self.rows_replayed = 0
+        #: typed record of the last stopped reload, or None after a clean
+        #: one — the accessor hot-swap probation keys off
+        self.last_stop: Optional[ReloadStopped] = None
 
     def hot_reload(self) -> int:
         """Apply snapshots newer than the loaded version; returns rows
         replayed.  A corrupt NEW snapshot leaves the reader on its current
-        (previous-snapshot) view and logs the typed reason — serving keeps
-        answering from the last good table."""
+        (previous-snapshot) view and records the typed stop — serving
+        keeps answering from the last good table, and ``last_stop`` tells
+        the probation logic WHICH snap and member stalled it (journaled as
+        ``snapshot_reload_stopped``, counted as
+        ``pserver_reload_stopped_total``)."""
+        from paddle_tpu.obs import get_registry, journal_event
+        from paddle_tpu.resilience.checkpoint_io import failing_member
+
         newest = latest_snapshot(self.save_dir, validate=False)
         replayed = 0
+        self.last_stop = None
         for k in range(self.version + 1, newest + 1):
             try:
                 replayed += _apply_snap(self.table, snap_dir(self.save_dir, k))
             except SnapshotError as e:
-                logger.warning("hot_reload stopped at snap %d: %s", k, e)
+                reason = str(e)
+                member = failing_member(
+                    reason.split("failed validation: ", 1)[-1])
+                self.last_stop = ReloadStopped(snap=k, member=member,
+                                               reason=reason)
+                journal_event("snapshot_reload_stopped",
+                              table=self.spec.name, snap=k, member=member,
+                              reason=reason)
+                get_registry().counter(
+                    "pserver_reload_stopped_total",
+                    "table hot-reloads stopped by a corrupt snapshot",
+                    labels=("table",), table=self.spec.name).inc()
+                logger.warning("hot_reload stopped at %s", self.last_stop)
                 break
             self.version = k
         self.rows_replayed += replayed
@@ -341,4 +382,5 @@ class TableReader:
     def healthz(self) -> dict:
         return {"table": self.spec.name, "version": self.version,
                 "vocab": self.spec.vocab, "dim": self.spec.dim,
-                "rows_replayed": self.rows_replayed}
+                "rows_replayed": self.rows_replayed,
+                "last_stop": str(self.last_stop) if self.last_stop else None}

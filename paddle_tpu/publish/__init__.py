@@ -1,0 +1,25 @@
+"""Continuous model publication (docs/publish.md).
+
+The gated publisher closes the train->serve loop: every
+``--publish_every`` passes the trainer exports a quantize-gated deploy
+bundle into a versioned, CRC-manifested publish directory — but ONLY
+from a checkpoint pass the SDC firewall has verified
+(``latest_verified_pass``; resilience/integrity.py).  A live server
+watches the directory and hot-swaps new versions with zero dropped
+requests (serving/reload.py).
+"""
+
+from paddle_tpu.publish.publisher import (PublishRefused, Publisher,
+                                          freshness_from_journal,
+                                          latest_version, list_versions,
+                                          publish_cache_dir,
+                                          publish_from_checkpoints,
+                                          read_version_manifest,
+                                          validate_version, version_dir)
+
+__all__ = [
+    "PublishRefused", "Publisher", "freshness_from_journal",
+    "latest_version", "list_versions", "publish_cache_dir",
+    "publish_from_checkpoints", "read_version_manifest",
+    "validate_version", "version_dir",
+]

@@ -1,0 +1,374 @@
+"""Gated model publication: verified checkpoint -> versioned deploy bundle.
+
+Layout of a publish directory (docs/publish.md)::
+
+    publish_dir/
+      v-00001/
+        model.ptz       # the deploy bundle (config/deploy.py merge_model)
+        manifest.json   # version, pass_id, train_commit_time, CRC32s,
+                        # architecture fingerprint, quantize recipe
+      v-00002/ ...
+      ccache/           # shared compile cache (config/compile_cache.py):
+                        # executables are keyed by the ARCHITECTURE
+                        # fingerprint, so every published weight version
+                        # of one model shares the warmed entries
+
+The gate: a version is only ever cut from a checkpoint pass at or below
+``latest_verified_pass(save_dir)`` (resilience/integrity.py) whose
+directory still CRC-validates — an unverified or quarantined pass is
+unpublishable by construction, and the bundle bytes come from the
+verified checkpoint on disk, never from live trainer memory.  Every
+publish writes through the checkpoint_io discipline: dot-prefixed temp
+dir, per-file fsync, one ``os.replace``.  Attempts and refusals are
+journaled (``publish_commit`` / ``publish_refused``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.utils.log import logger
+
+__all__ = ["PublishRefused", "Publisher", "freshness_from_journal",
+           "latest_version", "list_versions", "publish_cache_dir",
+           "publish_from_checkpoints", "read_version_manifest",
+           "validate_version", "version_dir"]
+
+_VERSION_RE = re.compile(r"v-(\d{5,})$")
+_TMP_PREFIX = ".tmp-"
+#: the bundle member every version dir carries
+BUNDLE_NAME = "model.ptz"
+MANIFEST_NAME = "manifest.json"
+#: shared compile cache for every version of the publish dir
+CACHE_SUBDIR = "ccache"
+
+
+class PublishRefused(RuntimeError):
+    """The gate refused to cut a version: the requested pass is newer
+    than ``latest_verified_pass``, its checkpoint no longer validates,
+    or the quantize error gate failed.  ``reason`` is the machine-
+    readable signal the refusal was journaled under."""
+
+    def __init__(self, message: str, *, reason: str,
+                 pass_id: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.reason = reason
+        self.pass_id = pass_id
+
+
+def version_dir(publish_dir: str, version: int) -> str:
+    return os.path.join(publish_dir, f"v-{version:05d}")
+
+
+def list_versions(publish_dir: str) -> List[int]:
+    """Every published version number, ascending (temp dirs and the
+    shared cache are never matched)."""
+    try:
+        names = os.listdir(publish_dir)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        m = _VERSION_RE.fullmatch(n)
+        if m and os.path.isdir(os.path.join(publish_dir, n)):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_version(publish_dir: str) -> int:
+    """Newest published version number, or 0 when none exist."""
+    vs = list_versions(publish_dir)
+    return vs[-1] if vs else 0
+
+
+def read_version_manifest(vdir: str) -> Dict[str, Any]:
+    with open(os.path.join(vdir, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
+def validate_version(vdir: str) -> Optional[str]:
+    """Re-hash one published version against its manifest; returns the
+    failure reason (naming the damaged member) or None.  The at-rest
+    integrity check the reload path runs before trusting a version —
+    the publish-tier analog of ``validate_checkpoint``."""
+    try:
+        manifest = read_version_manifest(vdir)
+    except FileNotFoundError:
+        return f"missing {MANIFEST_NAME}"
+    except (json.JSONDecodeError, OSError) as e:
+        return f"{MANIFEST_NAME} unreadable: {e}"
+    for fname, want in (manifest.get("files") or {}).items():
+        path = os.path.join(vdir, fname)
+        try:
+            with open(path, "rb") as f:
+                crc = zlib.crc32(f.read())
+        except OSError as e:
+            return f"member {fname} unreadable: {e}"
+        if crc != int(want.get("crc32", -1)):
+            return (f"member {fname} CRC mismatch "
+                    f"(stored {want.get('crc32')}, computed {crc})")
+    if not (manifest.get("files") or {}):
+        return "manifest lists no files"
+    return None
+
+
+def publish_cache_dir(publish_dir: str):
+    """The publish directory's shared compile cache — executables keyed
+    by the architecture fingerprint, shared by every weight version."""
+    from paddle_tpu.config.compile_cache import CompileCacheDir
+
+    return CompileCacheDir(os.path.join(publish_dir, CACHE_SUBDIR))
+
+
+def _journal_refused(reason: str, message: str,
+                     pass_id: Optional[int]) -> PublishRefused:
+    from paddle_tpu.obs import journal_event
+
+    journal_event("publish_refused", reason=reason, detail=message,
+                  pass_id=pass_id)
+    logger.warning("publish refused (%s): %s", reason, message)
+    return PublishRefused(message, reason=reason, pass_id=pass_id)
+
+
+def _load_checkpoint_trees(topology, ckpt_dir: str):
+    """Restore params/state from the VERIFIED checkpoint's bytes — the
+    published weights are the scrubbed artifact, not live memory."""
+    import jax
+
+    from paddle_tpu.resilience.checkpoint_io import load_pytree, read_manifest
+
+    manifest = read_manifest(ckpt_dir)
+    init_p, init_s = jax.eval_shape(
+        lambda k: topology.init(k), jax.random.PRNGKey(0))
+
+    def dtypes_of(fname: str) -> Dict[str, str]:
+        arrays = ((manifest.get("files") or {}).get(fname) or {}).get(
+            "arrays") or {}
+        return {k: v.get("orig_dtype") for k, v in arrays.items()
+                if v.get("orig_dtype")}
+
+    params = load_pytree(os.path.join(ckpt_dir, "params.npz"), init_p,
+                         dtypes_of("params.npz"))
+    state = {}
+    if init_s and manifest.get("has_state"):
+        state = load_pytree(os.path.join(ckpt_dir, "state.npz"), init_s,
+                            dtypes_of("state.npz"))
+    return params, state, manifest
+
+
+def publish_from_checkpoints(
+    publish_dir: str,
+    topology,
+    save_dir: str,
+    *,
+    pass_id: Optional[int] = None,
+    name: str = "model",
+    quantize: Optional[str] = None,
+    quantize_tol: float = 0.05,
+    example_feed: Optional[Dict[str, Any]] = None,
+    warm_cache: bool = True,
+    warm_max_batch: int = 8,
+    meta: Optional[dict] = None,
+) -> str:
+    """Cut one gated, versioned publish from the checkpoint tier.
+
+    ``pass_id`` defaults to ``latest_verified_pass(save_dir)``; an
+    explicit pass NEWER than the verified tip — or one whose checkpoint
+    dir is quarantined or no longer CRC-validates — raises the typed
+    :class:`PublishRefused` (journaled as ``publish_refused``), so an
+    unverified pass is unpublishable by construction.
+
+    The bundle export runs the full ``merge_model`` plane (quantize
+    error gate, optional lint audit via ``example_feed``); with
+    ``warm_cache`` the new model's bucket compile surfaces are primed
+    into the publish dir's SHARED cache (architecture-fingerprint keys),
+    so a reload — or a fresh boot of any version — pays zero XLA
+    compiles.  Returns the published version directory."""
+    from paddle_tpu.config.deploy import load_inference_model, merge_model
+    from paddle_tpu.obs import journal_event
+    from paddle_tpu.resilience.checkpoint_io import (_fsync_dir, _fsync_file,
+                                                     pass_dir,
+                                                     quarantine_reason,
+                                                     validate_checkpoint)
+    from paddle_tpu.resilience.integrity import latest_verified_pass
+
+    t_publish0 = time.time()
+    verified = latest_verified_pass(save_dir)
+    requested = verified if pass_id is None else int(pass_id)
+    if requested < 0:
+        raise _journal_refused(
+            "no_verified_pass",
+            f"no verified checkpoint under {save_dir!r} to publish",
+            requested)
+    if requested > verified:
+        raise _journal_refused(
+            "pass_not_verified",
+            f"pass {requested} is newer than the latest verified pass "
+            f"{verified} — the scrubber has not blessed it", requested)
+    ckpt_dir = pass_dir(save_dir, requested)
+    q = quarantine_reason(ckpt_dir)
+    if q is not None:
+        raise _journal_refused(
+            "pass_quarantined",
+            f"pass {requested} is quarantined: {q}", requested)
+    bad = validate_checkpoint(ckpt_dir)
+    if bad is not None:
+        raise _journal_refused(
+            "checkpoint_invalid",
+            f"pass {requested} no longer validates: {bad}", requested)
+    params, state, ckpt_manifest = _load_checkpoint_trees(topology, ckpt_dir)
+    #: the freshness SLO's clock zero — the wall-clock the checkpoint
+    #: tier committed this state at
+    train_commit_time = float(ckpt_manifest.get("time") or t_publish0)
+
+    os.makedirs(publish_dir, exist_ok=True)
+    tmp = os.path.join(publish_dir, f"{_TMP_PREFIX}{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    try:
+        bundle_meta = {
+            **(meta or {}),
+            "pass_id": requested,
+            "train_commit_time": train_commit_time,
+        }
+        try:
+            merge_model(os.path.join(tmp, BUNDLE_NAME), topology,
+                        params, state or None, name=name, meta=bundle_meta,
+                        example_feed=example_feed, quantize=quantize,
+                        quantize_tol=quantize_tol)
+        except ValueError as e:
+            # the quantize error gate (or a structural export failure)
+            # refuses typed like the verification gate — a bundle that
+            # would serve degraded predictions is never published
+            raise _journal_refused("export_gate", str(e), requested) from e
+        # the architecture fingerprint is the compile-cache identity every
+        # weight version shares (params ride compiled calls as arguments)
+        model = load_inference_model(os.path.join(tmp, BUNDLE_NAME),
+                                     arch_fingerprint=True)
+        if warm_cache:
+            _prime_bundle(model, publish_dir, warm_max_batch)
+        with open(os.path.join(tmp, BUNDLE_NAME), "rb") as f:
+            crc = zlib.crc32(f.read())
+        version = latest_version(publish_dir) + 1
+        manifest = {
+            "version": version,
+            "name": name,
+            "pass_id": requested,
+            "train_commit_time": train_commit_time,
+            "publish_time": time.time(),
+            "fingerprint": model.fingerprint,
+            "quantize": (model.manifest.get("quantize") or {}).get("mode"),
+            "files": {BUNDLE_NAME: {"crc32": crc}},
+        }
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_file(os.path.join(tmp, BUNDLE_NAME))
+        _fsync_dir(tmp)
+        final = version_dir(publish_dir, version)
+        os.replace(tmp, final)
+        _fsync_dir(publish_dir)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    # fsync'd: the durable anchor the freshness SLO is reconstructed
+    # against (freshness_from_journal)
+    journal_event("publish_commit", fsync=True, version=version,
+                  pass_id=requested, dir=final,
+                  train_commit_time=train_commit_time,
+                  fingerprint=model.fingerprint,
+                  publish_s=round(time.time() - t_publish0, 3))
+    logger.info("published v%d (pass %d) -> %s", version, requested, final)
+    return final
+
+
+def _prime_bundle(model, publish_dir: str, max_batch: int) -> None:
+    """Warm the publish dir's shared compile cache with the new model's
+    bucket executables (PR 12 machinery): the server's reload — and any
+    fresh boot of this or a later version — loads instead of compiling."""
+    from paddle_tpu.serving.batching import batch_bucket, warmup_bucket_feeds
+    from paddle_tpu.serving.feeds import example_feed
+
+    cache = publish_cache_dir(publish_dir)
+    feed = example_feed(model.topology)
+    buckets = sorted({batch_bucket(r, max_batch)
+                      for r in range(1, max_batch + 1)})
+    for padded in warmup_bucket_feeds(feed, buckets):
+        model.prime(padded, cache=cache)
+
+
+class Publisher:
+    """Bound publisher: one publish directory + topology, republished
+    every call (the trainer's ``--publish_every`` hook)."""
+
+    def __init__(self, publish_dir: str, topology, *, name: str = "model",
+                 quantize: Optional[str] = None, quantize_tol: float = 0.05,
+                 warm_cache: bool = True, warm_max_batch: int = 8) -> None:
+        self.publish_dir = publish_dir
+        self.topology = topology
+        self.name = name
+        self.quantize = quantize
+        self.quantize_tol = quantize_tol
+        self.warm_cache = warm_cache
+        self.warm_max_batch = warm_max_batch
+
+    def publish(self, save_dir: str,
+                pass_id: Optional[int] = None) -> str:
+        return publish_from_checkpoints(
+            self.publish_dir, self.topology, save_dir, pass_id=pass_id,
+            name=self.name, quantize=self.quantize,
+            quantize_tol=self.quantize_tol, warm_cache=self.warm_cache,
+            warm_max_batch=self.warm_max_batch)
+
+
+def freshness_from_journal(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Reconstruct the train-commit -> serving-ready freshness SLO from a
+    merged journal timeline: one row per successful publish, carrying the
+    publish latency (``train_commit_time`` -> ``publish_commit``), the
+    swap time (``reload_commit``), and serving-ready (``probation_passed``
+    — or the swap itself when no probation record exists yet).
+
+    Input is ``merge_journals()`` output (or any list of journal records
+    with ``kind``/``t`` fields)."""
+    rows: Dict[int, Dict[str, Any]] = {}
+    for r in events:
+        kind, v = r.get("kind"), r.get("version")
+        if v is None:
+            continue
+        v = int(v)
+        if kind == "publish_commit":
+            rows[v] = {
+                "version": v,
+                "pass_id": r.get("pass_id"),
+                "train_commit_time": r.get("train_commit_time"),
+                "published_at": r.get("t"),
+                "swapped_at": None,
+                "serving_ready_at": None,
+                "rolled_back": False,
+            }
+        elif kind == "reload_commit" and v in rows:
+            rows[v]["swapped_at"] = r.get("t")
+            rows[v]["serving_ready_at"] = r.get("t")
+        elif kind == "probation_passed" and v in rows:
+            rows[v]["serving_ready_at"] = r.get("t")
+        elif kind == "publish_rollback" and v in rows:
+            rows[v]["rolled_back"] = True
+            rows[v]["serving_ready_at"] = None
+    out = []
+    for v in sorted(rows):
+        row = rows[v]
+        t0, t1 = row.get("train_commit_time"), row.get("serving_ready_at")
+        row["freshness_s"] = (round(float(t1) - float(t0), 3)
+                              if t0 is not None and t1 is not None else None)
+        out.append(row)
+    return out

@@ -65,6 +65,7 @@ __all__ = [
     "truncate_file",
     "corrupt_checkpoint",
     "corrupt_latest_checkpoint",
+    "corrupt_publish",
     "corrupt_shard",
     "truncate_shard",
     "slow_shard",
@@ -181,6 +182,32 @@ def corrupt_checkpoint(ckpt_dir: str, *, target: str = "params.npz",
         os.remove(path)
     else:
         raise ValueError(f"unknown chaos mode {mode!r}")
+
+
+def corrupt_publish(publish_dir: str, *, version: Optional[int] = None,
+                    member: str = "model.ptz", mode: str = "corrupt",
+                    nbytes: int = 64) -> Optional[str]:
+    """Damage one member of a published model version (paddle_tpu.publish
+    layout, default: the NEWEST version's bundle) — the torn/bit-rotted
+    publish the hot-reload path must skip: the reload manager journals
+    ``publish_skipped_corrupt`` and the previous version keeps serving.
+    Returns the damaged version dir, or None when nothing is published."""
+    from paddle_tpu.publish import latest_version, version_dir
+
+    v = latest_version(publish_dir) if version is None else int(version)
+    if v <= 0:
+        return None
+    vdir = version_dir(publish_dir, v)
+    path = os.path.join(vdir, member)
+    if mode == "corrupt":
+        corrupt_file(path, nbytes=nbytes)
+    elif mode == "truncate":
+        truncate_file(path)
+    elif mode == "delete":
+        os.remove(path)
+    else:
+        raise ValueError(f"unknown chaos mode {mode!r}")
+    return vdir
 
 
 def corrupt_latest_checkpoint(save_dir: str, *, target: str = "params.npz",

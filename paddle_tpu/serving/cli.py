@@ -139,35 +139,13 @@ def _continuous_smoke() -> int:
         server.close()
 
 
-def run(argv: Optional[List[str]] = None) -> int:
-    from paddle_tpu.config.deploy import load_inference_model
-    from paddle_tpu.serving.feeds import example_feed
+def _build_server(model):
+    """One InferenceServer from the ``--serve_*`` flags (bucket mode) —
+    shared by the bundle, watch, and watch-smoke paths."""
     from paddle_tpu.serving.server import InferenceServer
-    from paddle_tpu.utils import FLAGS, logger
-    from paddle_tpu.utils.devices import init
-    from paddle_tpu.utils.error import ConfigError
+    from paddle_tpu.utils import FLAGS
 
-    rest = init(list(argv or []))
-    if rest:
-        raise ConfigError(f"serve: unrecognized arguments: {rest}")
-    # --metrics_port exposes the shared registry ServerMetrics now lives
-    # in (docs/observability.md): /metrics + /metrics.json
-    from paddle_tpu.obs import ensure_metrics_server
-
-    ensure_metrics_server()
-    if FLAGS.serve_continuous:
-        if FLAGS.serve_smoke <= 0:
-            raise ConfigError(
-                "serve: --serve_continuous is a smoke-only CLI surface "
-                "(pass --serve_smoke=N); production continuous serving "
-                "builds InferenceServer(mode='generation') over a "
-                "SlotBackend in-process — docs/serving.md")
-        return _continuous_smoke()
-    if not FLAGS.serve_bundle:
-        raise ConfigError("serve: --serve_bundle=<model.ptz> is required")
-
-    model = load_inference_model(FLAGS.serve_bundle)  # BundleCorruptError is typed
-    server = InferenceServer(
+    return InferenceServer(
         model,
         max_batch=FLAGS.serve_max_batch,
         batch_delay_ms=FLAGS.serve_batch_delay_ms,
@@ -180,6 +158,198 @@ def run(argv: Optional[List[str]] = None) -> int:
         hang_timeout_s=FLAGS.serve_hang_timeout_s,
         nonfinite=FLAGS.serve_nonfinite,
     )
+
+
+def _watch_serve() -> int:
+    """``--serve_watch``: boot from the newest valid version under
+    ``--publish_dir`` (corrupt versions journaled + skipped) with the
+    publish dir's SHARED warm compile cache, then hot-reload newer
+    publishes as they land — zero-downtime swap, probation window,
+    automatic rollback (docs/publish.md)."""
+    import os
+
+    from paddle_tpu.config.compile_cache import open_cache
+    from paddle_tpu.serving.reload import HotSwapManager, load_published
+    from paddle_tpu.utils import FLAGS, logger
+    from paddle_tpu.utils.error import ConfigError
+
+    if not FLAGS.publish_dir:
+        raise ConfigError("serve: --serve_watch needs --publish_dir=DIR")
+    model, info, version = load_published(FLAGS.publish_dir)
+    server = _build_server(model)
+    logger.info("serve: watching %r from v%d (probation %d requests)",
+                FLAGS.publish_dir, version, FLAGS.reload_probation)
+    cache = open_cache(
+        bundle=info["bundle"],
+        cache_dir=os.path.join(FLAGS.publish_dir, "ccache"))
+    server.start(preflight=FLAGS.serve_preflight, compile_cache=cache)
+    mgr = HotSwapManager(server, FLAGS.publish_dir,
+                         probation_requests=FLAGS.reload_probation,
+                         preflight=FLAGS.serve_preflight)
+    mgr.attach_current(version, info)
+    print(json.dumps({"ready": server.ready, **server.healthz()},
+                     default=str))
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    prev = {s: signal.signal(s, _stop)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        last_hz = 0.0
+        import time as _time
+
+        while not stop.is_set():
+            stop.wait(1.0)
+            try:
+                mgr.poll()
+            except Exception as e:  # noqa: BLE001 — serving must survive
+                logger.warning("serve watch: %s: %s", type(e).__name__, e)
+            now = _time.monotonic()
+            if now - last_hz >= 10.0:
+                last_hz = now
+                print(json.dumps(server.healthz(), default=str), flush=True)
+            if server._state != server.RUNNING:
+                logger.error("serve: server left RUNNING state; exiting")
+                return 1
+        return 0
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        server.close()
+
+
+def _watch_smoke() -> int:
+    """The ``--serve_watch --serve_smoke=N`` CI self-test: the whole
+    continuous train->publish->reload loop in one process.  Train a tiny
+    model, publish v1, boot the watcher from it, publish v2 from a later
+    checkpoint, and stream N requests ACROSS the reload.  Exits 0 only
+    if every request resolved (zero shed, zero drops), the server ended
+    up serving v2, and the reload paid ZERO fresh compiles
+    (``compile_cache_misses`` unchanged — the publish-time warmup plus
+    the architecture fingerprint make the swap pure deserialization)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.config.compile_cache import open_cache
+    from paddle_tpu.param.optimizers import Adam
+    from paddle_tpu.publish import publish_from_checkpoints
+    from paddle_tpu.serving.feeds import example_feed
+    from paddle_tpu.serving.reload import HotSwapManager, load_published
+    from paddle_tpu.trainer import SGDTrainer
+    from paddle_tpu.utils import FLAGS, logger
+
+    root = tempfile.mkdtemp(prefix="serve-watch-smoke-")
+    save_dir = os.path.join(root, "ckpt")
+    pub = FLAGS.publish_dir or os.path.join(root, "publish")
+
+    x = nn.data("x", size=6, is_seq=True)
+    pool = nn.pooling(nn.fc(x, 8, act="relu", name="h"),
+                      pooling_type="max", name="pool")
+    logits = nn.fc(pool, 3, act="linear", name="logits")
+    label = nn.data("label", size=1, dtype="int32")
+    cost = nn.classification_cost(logits, label, name="cost")
+    tr = SGDTrainer(cost, Adam(learning_rate=0.05), seed=0)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 5, 6).astype(np.float32)
+    lens = np.array([5, 3, 4, 5], np.int32)
+    batch = {"x": (xs, lens), "label": np.zeros((4, 1), np.int32)}
+    feed = example_feed(tr.topology)  # covers every data layer
+
+    tr.train_batch(batch)
+    tr.save(save_dir, 0)
+    publish_from_checkpoints(pub, tr.topology, save_dir, example_feed=feed,
+                             warm_max_batch=FLAGS.serve_max_batch)
+    model, info, v1 = load_published(pub)
+    server = _build_server(model)
+    server.start(preflight=FLAGS.serve_preflight,
+                 compile_cache=open_cache(
+                     bundle=info["bundle"],
+                     cache_dir=os.path.join(pub, "ccache")))
+    n = FLAGS.serve_smoke
+    mgr = HotSwapManager(server, pub,
+                         probation_requests=min(FLAGS.reload_probation,
+                                                max(1, n // 4)))
+    mgr.attach_current(v1, info)
+    print(json.dumps({"ready": server.ready, **server.healthz()},
+                     default=str))
+    try:
+        # train on -> publish v2 while v1 serves
+        tr.train_batch(batch)
+        tr.save(save_dir, 1)
+        publish_from_checkpoints(pub, tr.topology, save_dir,
+                                 example_feed=feed,
+                                 warm_max_batch=FLAGS.serve_max_batch)
+        miss0 = server.metrics.count("compile_cache_misses")
+        failures = 0
+        for i in range(n):
+            try:
+                server.infer(feed, deadline_ms=FLAGS.serve_deadline_ms)
+            except Exception as e:  # noqa: BLE001 — typed reply counts
+                failures += 1
+                logger.warning("watch smoke request %d failed: %s", i, e)
+            mgr.poll()  # reload + probation ride the request stream
+        for _ in range(64):  # drain probation if the stream was short
+            if mgr.poll() is None and not mgr.in_probation:
+                break
+            server.infer(feed, deadline_ms=FLAGS.serve_deadline_ms)
+        hz = server.healthz()
+        print(json.dumps(hz, default=str))
+        miss_delta = server.metrics.count("compile_cache_misses") - miss0
+        problems = []
+        if failures:
+            problems.append(f"{failures} request(s) failed")
+        if hz["counters"]["shed"]:
+            problems.append(f"shed={hz['counters']['shed']}")
+        if (hz.get("model") or {}).get("version") != 2:
+            problems.append(f"still serving {hz.get('model')}")
+        if mgr.current_version != 2:
+            problems.append(f"v2 not committed (at v{mgr.current_version})")
+        if miss_delta:
+            problems.append(f"reload paid {miss_delta} fresh compile(s)")
+        for p in problems:
+            logger.error("watch smoke: %s", p)
+        return 1 if problems else 0
+    finally:
+        server.close()
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    from paddle_tpu.config.deploy import load_inference_model
+    from paddle_tpu.serving.feeds import example_feed
+    from paddle_tpu.utils import FLAGS, logger
+    from paddle_tpu.utils.devices import init
+    from paddle_tpu.utils.error import ConfigError
+
+    rest = init(list(argv or []))
+    if rest:
+        raise ConfigError(f"serve: unrecognized arguments: {rest}")
+    # --metrics_port exposes the shared registry ServerMetrics now lives
+    # in (docs/observability.md): /metrics + /metrics.json
+    from paddle_tpu.obs import ensure_metrics_server
+
+    ensure_metrics_server()
+    if FLAGS.serve_watch:
+        # continuous publishing consumer (docs/publish.md): smoke mode is
+        # the CI self-test of the whole train->publish->reload loop
+        return _watch_smoke() if FLAGS.serve_smoke > 0 else _watch_serve()
+    if FLAGS.serve_continuous:
+        if FLAGS.serve_smoke <= 0:
+            raise ConfigError(
+                "serve: --serve_continuous is a smoke-only CLI surface "
+                "(pass --serve_smoke=N); production continuous serving "
+                "builds InferenceServer(mode='generation') over a "
+                "SlotBackend in-process — docs/serving.md")
+        return _continuous_smoke()
+    if not FLAGS.serve_bundle:
+        raise ConfigError("serve: --serve_bundle=<model.ptz> is required")
+
+    model = load_inference_model(FLAGS.serve_bundle)  # BundleCorruptError is typed
+    server = _build_server(model)
     logger.info("serve: warming up %r (batch buckets up to %d)",
                 FLAGS.serve_bundle, FLAGS.serve_max_batch)
     # persistent compiled executables (docs/deploy.md): bundle-embedded

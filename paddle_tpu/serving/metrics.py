@@ -70,6 +70,7 @@ class ServerMetrics:
             for name in _COUNTERS
         }
         self._registry = reg
+        self._gauges = {}
         self._latency_hist = reg.histogram(
             "serving_latency_seconds",
             "completed-request latency", labels=("server",),
@@ -79,6 +80,21 @@ class ServerMetrics:
         self._batch_rows = deque(maxlen=window)
         self._occupancy = deque(maxlen=window)  # occupied/capacity per step
         self._req_steps = deque(maxlen=window)  # decode steps per request
+
+    def gauge(self, name: str):
+        """Per-server registry gauge ``serving_<name>{server=...}`` —
+        the model-freshness / version surface of the hot-reload path
+        (docs/publish.md).  Created on first use; retired with the
+        counters by ``unregister``."""
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.get(name)
+                if g is None:
+                    g = self._gauges[name] = self._registry.gauge(
+                        "serving_" + name, "serving gauge (docs/serving.md)",
+                        labels=("server",), server=self._label)
+        return g
 
     def _counter(self, name: str):
         c = self._counters.get(name)
@@ -135,7 +151,11 @@ class ServerMetrics:
         ``healthz()`` still reads its final numbers."""
         with self._lock:
             names = list(self._counters)
+            gnames = list(self._gauges)
         for name in names:
+            self._registry.remove_series("serving_" + name,
+                                         server=self._label)
+        for name in gnames:
             self._registry.remove_series("serving_" + name,
                                          server=self._label)
         self._registry.remove_series("serving_latency_seconds",

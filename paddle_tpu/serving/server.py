@@ -194,6 +194,8 @@ class InferenceServer:
         self.cold_start_s: Optional[float] = None
         self._compile_cache = None
         self._feeder = None   # attach_feeder(): healthz surfaces its drops
+        self._model_info: Optional[dict] = None   # set_model_info()
+        self._model_loaded_at: Optional[float] = None
         self._gang = None     # healthz(): resolved once, lazily
         self._state = self.RUNNING
         self._ready = False
@@ -303,13 +305,24 @@ class InferenceServer:
         self.cold_start_s = self._clock() - t_start
         return self
 
-    def _warmup(self, feeds: List[Dict[str, Any]]) -> None:
-        if not feeds and hasattr(self.model, "topology"):
+    def prime_model(self, model,
+                    feeds: Optional[List[Dict[str, Any]]] = None
+                    ) -> Optional[dict]:
+        """Prime ``model``'s bucket compile surfaces — every batch bucket
+        of every feed's canonical shape — against this server's compile
+        cache.  This is the warmup gate of ``start()``, and the OFF-hot-
+        path warm step of the hot-swap reload (serving/reload.py): the
+        incoming model is primed here, in the caller's thread, while the
+        worker keeps serving the current model; with a warm cache and an
+        architecture-keyed fingerprint every executable loads instead of
+        compiling.  Returns prime counts, or None when there is nothing
+        to prime (plain callable without an example feed)."""
+        if not feeds and hasattr(model, "topology"):
             from paddle_tpu.serving.feeds import example_feed
 
-            feeds = [example_feed(self.model.topology)]
+            feeds = [example_feed(model.topology)]
         if not feeds:
-            return  # plain callable without an example: nothing to prime
+            return None  # plain callable without an example
         from paddle_tpu.serving.batching import (batch_bucket,
                                                  warmup_bucket_feeds)
 
@@ -318,12 +331,12 @@ class InferenceServer:
         # can produce for any row count
         buckets = sorted({batch_bucket(r, self.max_batch)
                           for r in range(1, self.max_batch + 1)})
-        t0 = self._clock()
         compiled = hits = 0
         # InferenceModel warms through prime(): the cache can swap the
         # compile for a deserialize, and the warmed AOT executables ARE
         # what infer() serves.  Plain callables keep the execute-once path.
-        prime = getattr(self.model, "prime", None)
+        prime = getattr(model, "prime", None)
+        runner = self._runner if model is self.model else None
         for feed in feeds:
             for padded in warmup_bucket_feeds(feed, buckets):
                 if prime is not None:
@@ -340,12 +353,23 @@ class InferenceServer:
                         if r == "miss":
                             self.metrics.inc("compile_cache_misses")
                 else:
-                    self._runner(padded, {})
+                    if runner is None:
+                        runner = self._make_runner(model)
+                    runner(padded, {})
                     compiled += 1
                     self.metrics.inc("warmup_compiles")
+        return {"compiled": compiled, "hits": hits,
+                "feeds": len(feeds), "buckets": len(buckets)}
+
+    def _warmup(self, feeds: List[Dict[str, Any]]) -> None:
+        t0 = self._clock()
+        counts = self.prime_model(self.model, feeds)
+        if counts is None:
+            return
         logger.info("serving warmup: %d bucket shape(s) over %d feed(s) — "
                     "%d compiled, %d cache-loaded in %.2fs",
-                    compiled + hits, len(feeds), compiled, hits,
+                    counts["compiled"] + counts["hits"], counts["feeds"],
+                    counts["compiled"], counts["hits"],
                     self._clock() - t0)
 
     def _warmup_generation(self, feeds: List[Dict[str, Any]]) -> None:
@@ -416,6 +440,46 @@ class InferenceServer:
     @property
     def ready(self) -> bool:
         return self._ready and self._state == self.RUNNING
+
+    # ------------------------------------------------------------------
+    # zero-downtime hot-swap (docs/publish.md; serving/reload.py)
+    # ------------------------------------------------------------------
+
+    def swap_model(self, model, *, info: Optional[dict] = None):
+        """Replace the serving backend between batches — the reload
+        path's commit point.  The worker reads ``self._runner`` once per
+        popped batch, so every batch is served entirely by exactly one
+        model generation: a batch in flight finishes on the old version,
+        the next pop serves the new one — no request is dropped or
+        served by a half-loaded model.  Prime the incoming model FIRST
+        (``prime_model``) or its first buckets pay cold compiles on the
+        hot path.  Returns the previous model; the caller keeps it
+        resident until the probation window passes (rollback swaps it
+        straight back)."""
+        if self.mode != "bucket":
+            raise ServingError(
+                "hot-swap reload is a bucket-mode path — a generation "
+                "backend owns resident decode state; boot a fresh server "
+                "for a new generation model")
+        runner = self._make_runner(model)
+        prev = self.model
+        self.model = model
+        self._runner = runner   # atomic attribute store: the swap point
+        self.set_model_info(info)
+        self.metrics.inc("model_swaps")
+        return prev
+
+    def set_model_info(self, info: Optional[dict]) -> None:
+        """Attach the served artifact's identity to the health surface:
+        ``healthz()['model']`` plus the registry gauges
+        ``serving_model_version`` / ``serving_model_freshness_seconds``
+        (the freshness SLO instrument — docs/publish.md)."""
+        self._model_info = dict(info) if info else None
+        self._model_loaded_at = time.time() if info else None
+        if self._model_info is not None:
+            v = self._model_info.get("version")
+            if v is not None:
+                self.metrics.gauge("model_version").set(float(v))
 
     def close(self, join_timeout: float = 2.0) -> None:
         if self._state == self.CLOSED:
@@ -1090,6 +1154,25 @@ class InferenceServer:
         if self._feeder is not None:
             out["dropped_features"] = int(
                 getattr(self._feeder, "dropped_features", 0))
+        info = self._model_info
+        if info is not None:
+            # the served artifact's identity + the freshness SLO
+            # (docs/publish.md): wall-clock age of the train commit the
+            # served weights came from.  Schema pinned by
+            # tests/test_serving.py; the gauge mirrors healthz so a
+            # --metrics_port scrape tells the same story.
+            tct = info.get("train_commit_time")
+            fresh = (round(time.time() - float(tct), 3)
+                     if tct is not None else None)
+            self.metrics.gauge("model_freshness_seconds").set(fresh)
+            out["model"] = {
+                "bundle": info.get("bundle"),
+                "version": info.get("version"),
+                "fingerprint": info.get("fingerprint"),
+                "quantize": info.get("quantize"),
+                "loaded_at": self._model_loaded_at,
+                "freshness_s": fresh,
+            }
         if self._gang is None:
             # resolved ONCE and cached: for an elastic-joiner replica
             # (epoch env > 0) GangContext.__init__ re-validates world.json

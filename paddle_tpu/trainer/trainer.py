@@ -302,6 +302,8 @@ class SGDTrainer:
                                      "guard-skipped non-finite steps"),
             "checkpoints": reg.counter("train_checkpoints_total",
                                        "checkpoint commits published"),
+            "publishes": reg.counter("train_publishes_total",
+                                     "gated deploy bundles published"),
             "resizes": reg.counter("train_resizes_total",
                                    "elastic resizes adopted"),
             "sdc_checks": reg.counter("train_sdc_checks_total",
@@ -1236,6 +1238,17 @@ class SGDTrainer:
                             # end-of-pass checkpoint
                             self._gang_resize(gang, e.world, pass_id,
                                               None, handler)
+                if (FLAGS.publish_dir and FLAGS.publish_every
+                        and FLAGS.save_dir
+                        and (pass_id + 1) % FLAGS.publish_every == 0
+                        and (gang is None or gang.is_coordinator)):
+                    # continuous publication (docs/publish.md): export a
+                    # gated deploy bundle from the newest VERIFIED
+                    # checkpoint bytes — never from live memory, so an
+                    # unverified or quarantined pass is unpublishable by
+                    # construction; a refusal is journaled, never fatal
+                    with self._ph("checkpoint"):
+                        self.publish(FLAGS.publish_dir, FLAGS.save_dir)
                 if tl is not None:
                     if FLAGS.enable_timers:
                         logger.info("step timeline (pass %d):\n%s",
@@ -1925,6 +1938,28 @@ class SGDTrainer:
                                  saved_pass=pass_id, dir=d,
                                  preempted=bool(meta.get("preempted")))
         return d
+
+    def publish(self, publish_dir: str, save_dir: str, *,
+                pass_id: Optional[int] = None) -> Optional[str]:
+        """Export a gated deploy bundle into the versioned publish dir
+        (paddle_tpu.publish; docs/publish.md) from the newest VERIFIED
+        checkpoint under ``save_dir`` — the train side of the continuous
+        train->publish->reload loop.  A gate refusal (no verified pass,
+        quarantined pass, corrupt checkpoint, quantize error budget) is
+        journaled as ``publish_refused`` and returns None; it never
+        fails training."""
+        from paddle_tpu.publish import (PublishRefused,
+                                        publish_from_checkpoints)
+
+        try:
+            vdir = publish_from_checkpoints(
+                publish_dir, self.topology, save_dir, pass_id=pass_id,
+                quantize=FLAGS.deploy_quantize or None)
+        except PublishRefused as e:
+            logger.warning("publish refused (%s): %s", e.reason, e)
+            return None
+        self._obs_counters["publishes"].inc()
+        return vdir
 
     def load(self, save_dir: str, pass_id: int, *,
              validate: bool = True) -> Dict[str, Any]:

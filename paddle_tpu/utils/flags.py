@@ -213,6 +213,18 @@ define_flag("checkgrad_eps", 1e-3, "epsilon for finite-difference gradient check
 define_flag("save_dir", "", "checkpoint root; pass dirs saved under it ('' = no saving)")
 define_flag("start_pass", 0, "resume training from this pass")
 define_flag("saving_period", 1, "save checkpoint every N passes")
+# Continuous publication (paddle_tpu/publish; docs/publish.md)
+define_flag("publish_dir", "", "versioned publish directory for gated "
+            "deploy bundles (v-%05d dirs + shared compile cache); '' "
+            "disables publication")
+define_flag("publish_every", 0, "publish a deploy bundle every N passes "
+            "(coordinator only, from the newest VERIFIED checkpoint "
+            "under --save_dir; 0 = never)",
+            validator=lambda v: v >= 0)
+define_flag("reload_probation", 32, "hot-reload probation window in "
+            "completed requests before a swapped-in version is committed "
+            "and its predecessor released (docs/publish.md)",
+            validator=lambda v: v >= 1)
 
 # Fault tolerance (paddle_tpu/resilience; docs/resilience.md)
 define_flag("resume", "", "'' = --start_pass behavior; 'auto' = resume from the "
@@ -320,6 +332,11 @@ define_flag("serve_nonfinite", "error", "serving: 'error' fails requests "
             "whose outputs contain NaN/Inf (counts toward the breaker); "
             "'allow' passes them through",
             validator=lambda v: v in ("error", "allow"))
+define_flag("serve_watch", False, "serving CLI: serve from the newest "
+            "valid version under --publish_dir and hot-reload newer "
+            "publishes as they land (zero-downtime swap + probation "
+            "rollback; docs/publish.md); with --serve_smoke=N runs the "
+            "publish->reload self-test instead")
 define_flag("serve_continuous", False, "serving: continuous slot-based "
             "batching for generation backends — finished requests' decode "
             "slots are recycled to queued requests between fused steps "

@@ -50,6 +50,7 @@ _UNITS = [
     ("cold_start_ab", "s (warm boot; vs = ×cold)"),
     ("trace_overhead_ab", "tok/s (tracing armed; vs = ×off)"),
     ("sdc_overhead_ab", "ms (fp every step; vs = ×off)"),
+    ("publish_reload_ab", "s (hot-swap to ready; vs = ×restart)"),
 ]
 
 

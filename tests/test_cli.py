@@ -26,7 +26,8 @@ def small_mnist(monkeypatch):
              "test_pass", "time_batches", "log_period", "serve_bundle",
              "serve_smoke", "serve_max_batch", "serve_deadline_ms",
              "serve_preflight", "serve_continuous", "serve_slots",
-             "compile_cache_dir", "deploy_quantize")}
+             "compile_cache_dir", "deploy_quantize", "serve_watch",
+             "publish_dir", "publish_every", "reload_probation")}
     yield
     for k, v in keep.items():
         setattr(FLAGS, k, v)
@@ -112,6 +113,42 @@ def test_cli_serve_smoke_roundtrip(tmp_path, capsys):
     assert last["counters"]["completed"] == 3
     assert last["counters"]["worker_crashed"] == 0
     assert last["breaker"]["state"] == "closed"
+
+
+def test_cli_serve_watch_smoke_publish_reload_roundtrip(tmp_path, capsys):
+    """`serve --serve_watch --serve_smoke=N`: the CI self-test of the
+    whole continuous train->publish->reload loop in one process —
+    publish v1, boot the watcher warm from the publish cache, publish
+    v2, stream N requests across the hot swap.  Exit 0 requires: every
+    request replied (zero shed/dropped), the server ended on v2, and
+    the reload paid ZERO fresh compiles (compile_cache_misses
+    unchanged — warm shared cache + architecture-fingerprint keys)."""
+    import json
+
+    import paddle_tpu.nn as nn
+
+    nn.reset_naming()
+    rc = main(["serve", "--serve_watch", "--serve_smoke=8",
+               f"--publish_dir={tmp_path / 'pub'}",
+               "--serve_deadline_ms=60000"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["ready"] is True
+    assert first["model"]["version"] == 1
+    # the boot itself was warm: the publisher primed the shared cache
+    assert first["counters"]["compile_cache_misses"] == 0
+    assert last["model"]["version"] == 2
+    assert last["counters"]["shed"] == 0
+    assert last["counters"]["completed"] >= 8
+    assert last["counters"]["compile_cache_misses"] == 0
+    assert last["counters"]["model_swaps"] == 1
+    assert (tmp_path / "pub" / "v-00002" / "manifest.json").exists()
+
+
+def test_cli_serve_watch_without_publish_dir_or_smoke_is_config_error():
+    with pytest.raises(ConfigError, match="publish_dir"):
+        main(["serve", "--serve_watch"])
 
 
 def test_cli_serve_continuous_smoke_zero_silent_drops(capsys):

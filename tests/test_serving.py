@@ -661,3 +661,43 @@ def test_healthz_counter_key_set_pinned_for_dashboards():
             for s in get_registry().snapshot()["serving_shed"]["series"]}
     assert m._label not in gone
     assert m.snapshot()["counters"]["shed"] == 1
+
+
+def test_healthz_model_block_schema_pinned(tmp_path, rng):
+    """Regression pin (docs/publish.md): the healthz() ``model`` block —
+    the serving-side freshness/version surface of continuous publishing —
+    carries exactly these keys.  A dashboard alerting on
+    ``freshness_s`` must never find the key renamed by a refactor.  The
+    block is absent entirely on a server that never loaded versioned
+    model info (the plain-bundle path is unchanged)."""
+    import time as _time
+
+    from paddle_tpu.config import load_inference_model
+
+    model = load_inference_model(_train_tiny_bundle(tmp_path, rng))
+    srv = InferenceServer(model, outputs=["logits"], max_batch=2,
+                          max_queue=8)
+    assert "model" not in srv.healthz()
+    t0 = _time.time()
+    srv.set_model_info({
+        "bundle": "/pub/v-00007/model.ptz", "version": 7,
+        "fingerprint": model.fingerprint, "quantize": None,
+        "train_commit_time": t0 - 12.5,
+    })
+    block = srv.healthz()["model"]
+    assert set(block) == {"bundle", "version", "fingerprint", "quantize",
+                          "loaded_at", "freshness_s"}
+    assert block["version"] == 7
+    assert block["fingerprint"] == model.fingerprint
+    assert block["bundle"].endswith("v-00007/model.ptz")
+    assert block["loaded_at"] >= t0
+    assert 12.5 <= block["freshness_s"] < 60.0
+    # freshness also lands on the registry gauge for scraping
+    from paddle_tpu.obs import get_registry
+
+    series = get_registry().snapshot()[
+        "serving_model_freshness_seconds"]["series"]
+    vals = [s["value"] for s in series
+            if s["labels"]["server"] == srv.metrics._label]
+    assert vals and vals[0] >= 12.5
+    srv.metrics.unregister()

@@ -263,13 +263,19 @@ def _audit_slot_step_closure() -> List[Finding]:
     """The continuous-batching half of ``--serve``: audit the compiled
     ``decode_step`` closure over a slot table at a compact flagship shape
     (serving.slots.audit_slot_backend — same check set and contract as
-    ``--decode``).  One audit per lint run, independent of how many
-    bundles were given: the step program is the serving tier's, not a
-    bundle's."""
+    ``--decode``), plus the speculative wide-verify closure over a greedy
+    (``beam_size == 1``) table (docs/decode.md "Speculative decoding").
+    One audit per lint run, independent of how many bundles were given:
+    the step programs are the serving tier's, not a bundle's."""
     try:
-        from paddle_tpu.serving.slots import audit_slot_backend
+        from paddle_tpu.serving.slots import (audit_slot_backend,
+                                              example_slot_backend)
 
-        return audit_slot_backend()
+        findings = list(audit_slot_backend())
+        findings.extend(audit_slot_backend(
+            example_slot_backend(slots=4, beam_size=1),
+            slots=4, label="serve_slots[greedy]", spec_k=4))
+        return findings
     except Exception as e:  # a step that fails to BUILD is a finding
         return [Finding(
             check="serve-build", severity="ERROR", file="serve_slots",

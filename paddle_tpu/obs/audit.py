@@ -12,12 +12,12 @@ enabled vs disabled — and
 2. asserts the two traced programs are equation-for-equation IDENTICAL —
    zero *added* anything, not merely zero transfers.
 
-Request tracing (obs/trace.py) extends the same contract to BOTH hot
-lifecycles: the train step AND the continuous-batching ``decode_step``
-are traced with tracing armed (``--obs_journal`` + ``--trace_sample``)
-vs off and must be equation-identical — spans are host-side bookkeeping
-around calls the loop already makes; tracing adds ZERO compiled
-equations.
+Request tracing (obs/trace.py) extends the same contract to ALL hot
+lifecycles: the train step, the continuous-batching ``decode_step`` AND
+the speculative wide ``spec_verify_step`` are traced with tracing armed
+(``--obs_journal`` + ``--trace_sample``) vs off and must be
+equation-identical — spans are host-side bookkeeping around calls the
+loop already makes; tracing adds ZERO compiled equations.
 """
 
 from __future__ import annotations
@@ -77,6 +77,34 @@ def _tiny_decode_step():
     return fn, carry
 
 
+def _tiny_spec_step():
+    """K=1 variant of :func:`_tiny_decode_step` exercising the fused wide
+    ``spec_verify_step`` — the speculative-decoding hot program must stay
+    equation-identical with tracing armed, same as ``decode_step``."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.decode import (LogitsReadout, init_slot_carry,
+                                       spec_verify_step)
+
+    w = jnp.ones((4, 8), jnp.float32) * 0.1
+
+    def step_fn(tokens, state):
+        logits = state["h"] @ w
+        return logits, {"h": state["h"] * 0.9}
+
+    tpl = {"h": jax.ShapeDtypeStruct((1, 4), jnp.float32)}
+    carry = init_slot_carry(tpl, slots=2, beam_size=1, max_len=4, eos=1)
+    drafts = jnp.zeros((2, 3), jnp.int32)
+    cap = jnp.full((2,), 4, jnp.int32)
+
+    def fn(c):
+        return spec_verify_step(step_fn, LogitsReadout(), c, drafts, cap,
+                                vocab_size=8, eos=1)[0]
+
+    return fn, carry
+
+
 def audit_telemetry_step() -> List[Finding]:
     """Trace the trainer step with telemetry ON, audit it, and diff the
     jaxpr against the telemetry-OFF trace; then diff the train step AND
@@ -122,6 +150,7 @@ def audit_telemetry_step() -> List[Finding]:
         # BOTH hot programs — the train step and the fused decode_step —
         # equation-identical to tracing-off (spans never enter the trace)
         dec_fn, dec_carry = _tiny_decode_step()
+        spec_fn, spec_carry = _tiny_spec_step()
         keep_trace = (FLAGS.obs_journal, FLAGS.trace_sample)
         with tempfile.TemporaryDirectory() as td:
             try:
@@ -129,13 +158,16 @@ def audit_telemetry_step() -> List[Finding]:
                 FLAGS.trace_sample = 1.0
                 step_on = jax.make_jaxpr(tr._step_fn)(*args)
                 dec_on = jax.make_jaxpr(dec_fn)(dec_carry)
+                spec_on = jax.make_jaxpr(spec_fn)(spec_carry)
                 FLAGS.obs_journal = ""
                 step_off = jax.make_jaxpr(tr._step_fn)(*args)
                 dec_off = jax.make_jaxpr(dec_fn)(dec_carry)
+                spec_off = jax.make_jaxpr(spec_fn)(spec_carry)
             finally:
                 FLAGS.obs_journal, FLAGS.trace_sample = keep_trace
         for tag, a, b in (("train_step", step_on, step_off),
-                          ("decode_step", dec_on, dec_off)):
+                          ("decode_step", dec_on, dec_off),
+                          ("spec_verify_step", spec_on, spec_off)):
             if str(a) != str(b):
                 findings.append(Finding(
                     check="obs-trace-drift", severity="ERROR",

@@ -70,10 +70,19 @@ from paddle_tpu.ops.decode import (
     beam_gather,
     decode_kernel_config,
     decode_step,
+    spec_verify_step,
     init_slot_carry,
     write_slot,
     release_slot,
+    extract_slot,
+    restore_slot,
     finalize_slots,
+)
+from paddle_tpu.ops.speculative import (
+    DraftProposer,
+    NGramProposer,
+    CallableDraftProposer,
+    AdversarialProposer,
 )
 from paddle_tpu.ops.embedding import embedding_lookup, one_hot
 from paddle_tpu.ops.sparse import (

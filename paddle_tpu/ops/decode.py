@@ -50,7 +50,7 @@ decode fn is auditable host-transfer-free via
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,9 +64,12 @@ __all__ = [
     "beam_gather",
     "decode_kernel_config",
     "decode_step",
+    "spec_verify_step",
     "init_slot_carry",
     "write_slot",
     "release_slot",
+    "extract_slot",
+    "restore_slot",
     "finalize_slots",
 ]
 
@@ -443,6 +446,245 @@ def release_slot(carry: dict, slot) -> dict:
         active=carry["active"].at[slot].set(False),
         finished=carry["finished"].at[slot].set(jnp.ones((K,), bool)),
     )
+
+
+def spec_verify_step(step_fn: Callable, readout, carry: dict, drafts,
+                     cap, *, vocab_size: int, eos: int = 1,
+                     use_kernel: Optional[bool] = None):
+    """ONE fused wide-verify step for speculative decoding over a GREEDY
+    (``beam_size == 1``) slot table: per active slot, score the current
+    token plus ``k`` host-proposed draft tokens in one call and emit the
+    longest prefix the model itself would have produced — between 1 and
+    ``k + 1`` tokens per slot per dispatch.
+
+    ``drafts`` is ``[S, k] i32`` (host draft proposals per slot —
+    ``ops/speculative.py``); ``cap`` is ``[S] i32``, the per-slot
+    remaining decode budget (``limit - tokens_emitted``), which bounds
+    emission so cumulative scores never accumulate past the request's
+    own ``max_len``.  Returns ``(new_carry, aux)`` with ``aux =
+    {"emitted": [S, k+1] i32, "n": [S] i32, "accepted": [S] i32}`` —
+    the emitted tokens (EOS-filled past ``n``), tokens emitted, and
+    draft tokens accepted.
+
+    Bit-identity with the one-token path is *provable*, not
+    approximate, because greedy verification IS the greedy decode rule:
+
+    - position ``j``'s input is the previous emission in the solo run;
+      a draft position only stays "emitting" while every earlier draft
+      matched the model's own greedy emission (or the row already
+      finished, where emissions are forced EOS at zero cost regardless
+      of state), so every scored-and-accepted position saw exactly the
+      state the solo run would have had — readout and ``step_fn`` are
+      row-independent and batch-size-invariant (the same invariant that
+      makes the slot table itself bit-identical to solo decode);
+    - ``logp`` accumulates sequentially position by position in the
+      same float-addition order as one-token stepping;
+    - the carried state is SELECTED from the scoring sweep itself: the
+      recurrence is row-independent, so row ``r``'s state after chain
+      position ``j`` depends only on row ``r``'s inputs ``x_0..x_j`` —
+      for a row that emitted ``n`` tokens those are exactly the tokens
+      the solo run would have fed, so the sweep state at position
+      ``n - 1`` IS the solo state, bit for bit (positions past ``n``
+      are garbage for that row and are never selected).  One recurrence
+      pass total; the cost is holding ``k + 1`` transient state copies
+      through the select, which XLA frees within the step; the readout
+      (the [D, V] matmul that dominates) runs ONCE per position,
+      batched as a single ``(k+1)·S``-row call.
+
+    Inactive slots are frozen bit-for-bit, as in :func:`decode_step`.
+    Beam search (``beam_size > 1``) has no greedy-verify equivalent —
+    callers fall back to the standard :func:`decode_step` path.
+    """
+    with jax.named_scope("spec_verify_step"):
+        return _spec_verify_inner(step_fn, readout, carry, drafts, cap,
+                                  vocab_size=vocab_size, eos=eos,
+                                  use_kernel=use_kernel)
+
+
+def _spec_verify_inner(step_fn, readout, carry, drafts, cap, *,
+                       vocab_size, eos, use_kernel):
+    tokens, logp = carry["tokens"], carry["logp"]
+    state, finished = carry["state"], carry["finished"]
+    active, step = carry["active"], carry["step"]
+    S, K, Lp1 = tokens.shape
+    if K != 1:
+        raise ValueError(
+            f"spec_verify_step is a greedy path: beam_size must be 1, "
+            f"got K={K} (beam search falls back to decode_step)")
+    drafts = jnp.asarray(drafts, jnp.int32)
+    cap = jnp.asarray(cap, jnp.int32)
+    k = int(drafts.shape[1])
+
+    # position inputs: x_0 = each slot's current token, x_j = draft j-1
+    y0 = jnp.take_along_axis(
+        tokens, jnp.broadcast_to(step[:, None, None], (S, K, 1)).astype(
+            jnp.int32), axis=2)[..., 0].reshape(S)
+    xs = jnp.concatenate([y0[None, :], drafts.T], axis=0)   # [k+1, S]
+
+    # scoring sweep: scan the recurrence through all k+1 positions
+    # collecting readout inputs AND the state after each position, then
+    # ONE wide readout over (k+1)*S rows at k=1 (greedy).  A scan (not
+    # an unrolled loop) keeps the compiled program one step-body deep
+    # regardless of k — at small step shapes the program's instruction
+    # count, not its flops, is what the per-position overhead tracks.
+    # Row independence makes each row's (vals, idx, lse) identical to
+    # the solo per-step readout.
+    #
+    # Pass-through state leaves — ones step_fn returns UNMODIFIED (the
+    # same traced value), e.g. encoder context / attention masks — are
+    # detected by object identity during the single body trace and
+    # excluded from the stacked scan outputs: by induction they equal
+    # the initial state at every position, so the select below would
+    # always return the original anyway, and stacking k+1 copies of an
+    # [S, src_len, D] encoder costs more than the recurrence itself.
+    changed: List[bool] = []
+
+    def _sweep(st_c, x):
+        r_in, st_n = step_fn(x, st_c)
+        in_leaves = jax.tree_util.tree_leaves(st_c)
+        out_leaves = jax.tree_util.tree_leaves(st_n)
+        if not changed:
+            changed.extend(o is not i
+                           for o, i in zip(out_leaves, in_leaves))
+        ys = tuple(o for o, c in zip(out_leaves, changed) if c)
+        return st_n, (r_in, ys)
+
+    _, (r_all, st_stack) = lax.scan(_sweep, state, xs)
+    vals, idx, lse = readout(r_all.reshape((-1,) + r_all.shape[2:]), 1,
+                             use_kernel=use_kernel)
+    # barrier: without it XLA CPU duplicates the (k+1)*S-row argmax /
+    # log-sum-exp reduction into every one of the ~k*S tiny accept-mask
+    # consumers below (producer-fusion), turning one readout into tens —
+    # measured ~8x the whole step.  The barrier pins the readout to run
+    # once; outputs are bit-identical either way.
+    vals, idx, lse = jax.lax.optimization_barrier((vals, idx, lse))
+    g = idx[:, 0].reshape(k + 1, S)            # greedy token per position
+    lp = (vals[:, 0] - lse).reshape(k + 1, S)  # its log-prob
+
+    # accept/emit: 'emitting' is sticky per row — a position emits only
+    # while every earlier draft input matched the row's own emission
+    # (or the row is finished: forced EOS at zero cost, state-independent)
+    # and the budget cap is not exhausted.
+    fin = finished[:, 0]
+    logp_new = logp[:, 0]
+    emitting = active & (cap > 0)
+    n = jnp.zeros((S,), jnp.int32)
+    acc = jnp.zeros((S,), jnp.int32)
+    em = []
+    for j in range(k + 1):
+        if j:
+            matched = drafts[:, j - 1] == em[j - 1]
+            emitting = emitting & (fin | matched) & (n < cap)
+            acc = acc + (emitting & ~fin).astype(jnp.int32)
+        e_j = jnp.where(fin, eos, g[j])
+        # sequential accumulation in solo order (finished rows add the
+        # same 0.0 the one-token path's EOS candidate adds)
+        logp_new = jnp.where(emitting,
+                             logp_new + jnp.where(fin, 0.0, lp[j]),
+                             logp_new)
+        em.append(jnp.where(emitting, e_j, eos))
+        n = n + emitting.astype(jnp.int32)
+        fin = fin | (emitting & (e_j == eos))
+    em_arr = jnp.stack(em, axis=1)                       # [S, k+1]
+
+    # token-buffer epilogue: write the n emitted tokens at each slot's
+    # own position (offsets past n keep the old — EOS-prefilled — buffer)
+    off = jnp.arange(Lp1, dtype=jnp.int32)[None, :] - (step[:, None] + 1)
+    sel = (off >= 0) & (off < n[:, None])                # [S, Lp1]
+    gathered = jnp.take_along_axis(em_arr, jnp.clip(off, 0, k), axis=1)
+    tokens_new = jnp.where(sel[:, None, :], gathered[:, None, :], tokens)
+
+    # state select: fold the sweep states down to each row's own stop
+    # position.  Rows that emitted n tokens keep sweep state n-1 (their
+    # inputs 0..n-1 were exactly the solo inputs — row independence);
+    # rows with n == 0 keep the original state, frozen bit-for-bit.
+    # One gather per CHANGING leaf; pass-through leaves keep the
+    # original untouched (provably equal at every sweep position).
+    pos = jnp.clip(n - 1, 0, k)                          # [S]
+    live = n > 0
+
+    def _pick(stacked, orig):
+        il = pos.reshape((1, S) + (1,) * (orig.ndim - 1))
+        sel = jnp.take_along_axis(stacked, il, axis=0)[0]
+        m = live.reshape((S,) + (1,) * (orig.ndim - 1))
+        return jnp.where(m, sel, orig)
+
+    st_leaves, st_def = jax.tree_util.tree_flatten(state)
+    it = iter(st_stack)
+    st_leaves = [(_pick(next(it), leaf) if ch else leaf)
+                 for leaf, ch in zip(st_leaves, changed)]
+    st = jax.tree_util.tree_unflatten(st_def, st_leaves)
+
+    new_carry = {
+        "tokens": tokens_new,
+        "logp": logp_new[:, None],
+        "state": st,
+        "finished": fin[:, None],
+        "active": active,
+        "step": step + n,
+    }
+    return new_carry, {"emitted": em_arr, "n": n, "accepted": acc}
+
+
+def extract_slot(carry: dict, slot) -> dict:
+    """Page-out: one slot's full decode context — token buffer, scores,
+    state rows, finished mask, step — as a small per-slot pytree ready
+    for a host round-trip (serving/paging.py).  ``slot`` is a traced
+    scalar, mirroring :func:`write_slot`'s one-program-per-table
+    discipline.  The d2h/h2d round trip preserves every bit, so a
+    paged-out-and-restored slot decodes exactly as if it had never
+    left the table (pinned by tests)."""
+    tokens = carry["tokens"]
+    S, K, Lp1 = tokens.shape
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def take(leaf):
+        if leaf.shape[0] == S * K:
+            return lax.dynamic_slice_in_dim(leaf, slot * K, K, axis=0)
+        if leaf.shape[0] == S:
+            return lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
+        raise ValueError(
+            f"extract_slot leaf has no slot axis: shape {leaf.shape} "
+            f"with S={S}, K={K}")
+
+    return {
+        "tokens": lax.dynamic_slice(tokens, (slot, 0, 0), (1, K, Lp1)),
+        "logp": lax.dynamic_slice(carry["logp"], (slot, 0), (1, K)),
+        "state": jax.tree_util.tree_map(take, carry["state"]),
+        "finished": lax.dynamic_slice(carry["finished"], (slot, 0), (1, K)),
+        "step": lax.dynamic_slice(carry["step"], (slot,), (1,)),
+    }
+
+
+def restore_slot(carry: dict, slot, saved: dict) -> dict:
+    """Page-in: write an :func:`extract_slot` snapshot back into slot
+    ``slot`` (traced scalar) and re-activate it at its saved step — the
+    re-admission half of host-paged slot state.  The inverse of
+    :func:`extract_slot` up to bit identity."""
+    tokens = carry["tokens"]
+    S, K, Lp1 = tokens.shape
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def put(table, piece):
+        piece = piece.astype(table.dtype)
+        if table.shape[0] == S * K:
+            return lax.dynamic_update_slice_in_dim(table, piece, slot * K,
+                                                   axis=0)
+        return lax.dynamic_update_slice_in_dim(table, piece, slot, axis=0)
+
+    return {
+        "tokens": lax.dynamic_update_slice(
+            tokens, saved["tokens"].astype(jnp.int32), (slot, 0, 0)),
+        "logp": lax.dynamic_update_slice(
+            carry["logp"], saved["logp"].astype(jnp.float32), (slot, 0)),
+        "state": jax.tree_util.tree_map(put, carry["state"],
+                                        saved["state"]),
+        "finished": lax.dynamic_update_slice(
+            carry["finished"], saved["finished"], (slot, 0)),
+        "active": carry["active"].at[slot].set(True),
+        "step": lax.dynamic_update_slice(
+            carry["step"], saved["step"].astype(jnp.int32), (slot,)),
+    }
 
 
 def _finalize(tokens, logp, *, eos: int, length_penalty: float):

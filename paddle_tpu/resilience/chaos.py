@@ -88,6 +88,8 @@ __all__ = [
     "crash_calls",
     "slow_client",
     "straggler_request",
+    "bad_draft",
+    "corrupt_prefix_cache",
 ]
 
 
@@ -747,6 +749,60 @@ def straggler_request(feed: dict, *, bias: float = -1e9,
     rows = int(np.asarray(arr).shape[0])
     out[key] = np.full((rows, 1), float(bias), np.float32)
     return out
+
+
+def bad_draft(scheduler, *, token: Optional[int] = None):
+    """Sabotage speculative decoding with an ALWAYS-WRONG draft proposer:
+    every draft position gets a constant token (default ``vocab - 1``),
+    so the wide verify rejects essentially every draft and each
+    speculative step degrades to the baseline >= 1 emitted token.  The
+    recovery obligation is the verify step's own proof: a wrong draft can
+    slow decoding but NEVER corrupt it — outputs stay bit-identical to
+    solo decode while throughput drops to the one-token rate (pinned by
+    tests/test_spec_decode.py).  Returns the displaced proposer so the
+    caller can restore it."""
+    from paddle_tpu.ops.speculative import AdversarialProposer
+
+    if scheduler.spec_k <= 0:
+        raise ValueError("bad_draft needs a speculative scheduler "
+                         "(spec_k > 0)")
+    if token is None:
+        token = int(scheduler.backend.vocab_size) - 1
+    prev = scheduler.proposer
+    scheduler.proposer = AdversarialProposer(token)
+    return prev
+
+
+def corrupt_prefix_cache(scheduler, *, key: Optional[str] = None) -> int:
+    """Flip bits inside resident prefix-cache payloads (one entry when
+    ``key`` is given, else every entry) — the bit-rot / torn-write fault
+    for the host-side prefill cache.  The cache's crc32-over-payload+key
+    integrity check MUST detect the corruption at ``get`` time: the
+    poisoned entry is dropped, counted as a miss AND a ``poisoned``
+    detection, and the request prefills fresh — corrupted encoder state
+    is NEVER served (pinned by tests/test_spec_decode.py).  Returns the
+    number of entries corrupted."""
+    cache = scheduler.prefix_cache
+    if cache is None:
+        raise ValueError("corrupt_prefix_cache needs a scheduler with a "
+                         "prefix cache (prefix_cache_mb > 0)")
+    keys = [key] if key is not None else cache.keys()
+    n = 0
+    for k in keys:
+        payload = cache.peek(k)
+        if not payload:
+            continue
+        name = sorted(payload)[0]
+        # cached payloads are often read-only views of device transfers —
+        # damage a writable copy and splice it into the LIVE payload dict
+        # (peek returns the entry's own dict, so the entry now holds bytes
+        # that no longer match its stored crc)
+        arr = np.array(payload[name])
+        flat = arr.reshape(-1).view(np.uint8)
+        flat[: max(1, flat.size // 997)] ^= 0xFF
+        payload[name] = arr
+        n += 1
+    return n
 
 
 def slow_client(feeds: Iterable, *, delay_s: float = 0.05,

@@ -83,6 +83,8 @@ class Request:
     tier: int = 0                 # degradation tier chosen at execution
     max_len: Optional[int] = None  # generation mode: per-request decode
     #                                budget (None = the backend's max_len)
+    session_id: Optional[str] = None  # chat session scope for the prefix
+    #                                   cache (serving/prefix_cache.py)
     # request tracing (obs/trace.py; all None/"" when tracing is off):
     req_id: str = ""              # user-facing id (`obs merge --request=`)
     span: Any = None              # the request trace's root Span

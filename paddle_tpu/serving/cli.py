@@ -87,8 +87,11 @@ def _continuous_smoke() -> int:
     from paddle_tpu.serving.slots import example_slot_backend
     from paddle_tpu.utils import FLAGS, logger
 
-    backend = example_slot_backend(beam_size=2, src_len=8, max_len=16,
-                                   vocab=256, dim=32)
+    # --spec_decode rides the greedy-verify proof: the smoke backend
+    # drops to beam_size=1 so the wide-verify path actually engages
+    backend = example_slot_backend(
+        beam_size=1 if FLAGS.spec_decode else 2, src_len=8, max_len=16,
+        vocab=256, dim=32)
     server = InferenceServer(
         backend,
         mode="generation",
@@ -102,6 +105,9 @@ def _continuous_smoke() -> int:
         restart_backoff_s=FLAGS.serve_backoff_s,
         hang_timeout_s=FLAGS.serve_hang_timeout_s,
         nonfinite=FLAGS.serve_nonfinite,
+        spec_k=FLAGS.spec_k if FLAGS.spec_decode else 0,
+        prefix_cache_mb=FLAGS.prefix_cache_mb,
+        slot_page_pool_mb=FLAGS.slot_page_pool,
     )
     from paddle_tpu.config.compile_cache import open_cache
 

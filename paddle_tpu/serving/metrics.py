@@ -51,6 +51,14 @@ _COUNTERS = (
     "compile_cache_hits",    # warmup executables LOADED from the cache
     "compile_cache_misses",  # warmup executables compiled + stored
     "warmup_compiles",       # XLA compiles paid by the readiness gate
+    # decode raw speed (docs/decode.md "Speculative decoding";
+    # serving/prefix_cache.py; serving/paging.py)
+    "spec_draft_tokens_total",     # draft tokens offered to wide verify
+    "spec_accepted_tokens_total",  # draft tokens the model confirmed
+    "prefix_cache_hits",           # admissions served from cached prefill
+    "prefix_cache_misses",         # admissions that ran the encoder
+    "slots_paged_out",             # slot carries host-evicted to the pool
+    "slots_paged_in",              # parked carries restored bit-for-bit
 )
 
 #: distinguishes the registry children of servers sharing one process

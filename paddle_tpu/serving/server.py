@@ -141,6 +141,10 @@ class InferenceServer:
         degrade: Optional[List[dict]] = None,
         degrade_at: Optional[List[int]] = None,
         nonfinite: str = "error",
+        spec_k: int = 0,
+        draft=None,
+        prefix_cache_mb: float = 0.0,
+        slot_page_pool_mb: float = 0.0,
         clock=time.monotonic,
         sleep=time.sleep,
     ) -> None:
@@ -173,7 +177,10 @@ class InferenceServer:
                     "mode='generation' needs a SlotBackend (prefill/"
                     "step_fn/readout — serving/slots.py), got "
                     f"{type(model).__name__}")
-            self._scheduler = SlotScheduler(model, slots=slots, clock=clock)
+            self._scheduler = SlotScheduler(
+                model, slots=slots, clock=clock, spec_k=spec_k,
+                draft=draft, prefix_cache_mb=prefix_cache_mb,
+                page_pool_mb=slot_page_pool_mb)
             self._runner = None
         else:
             self._runner = self._make_runner(model)
@@ -202,6 +209,9 @@ class InferenceServer:
         self._fail_reason: Optional[str] = None
         self._in_flight: List[Request] = []
         self._kill_worker = False
+        #: generation-mode hot-swap staging: (scheduler, model, info),
+        #: flipped by the worker once the current table fully drains
+        self._swap_next = None
         self.supervisor = WorkerSupervisor(
             (self._serve_generation_once if mode == "generation"
              else self._serve_once),
@@ -213,9 +223,11 @@ class InferenceServer:
             on_give_up=self._on_worker_give_up,
             # a relaunched generation worker starts from a FRESH table: the
             # crash may have left the carry poisoned, and its resident
-            # requests were already failed typed by on_crash
-            on_relaunch=(self._scheduler.reset if self._scheduler is not None
-                         else None),
+            # requests were already failed typed by on_crash.  Late-bound
+            # — a generation hot-swap replaces self._scheduler, and a
+            # relaunch must reset the CURRENT table, not the retired one
+            on_relaunch=((lambda: self._scheduler.reset())
+                         if self._scheduler is not None else None),
             clock=clock,
             sleep=sleep,
         )
@@ -285,7 +297,8 @@ class InferenceServer:
                 from paddle_tpu.serving.slots import audit_slot_backend
 
                 bad = errors_summary(audit_slot_backend(
-                    self.model, slots=self._scheduler.slots))
+                    self.model, slots=self._scheduler.slots,
+                    spec_k=self._scheduler.spec_k))
                 if bad:
                     raise ServingError(
                         f"slot decode_step failed the preflight audit: {bad}")
@@ -423,9 +436,20 @@ class InferenceServer:
         sched.admit(synth(1))
         sched.step()
         sched.harvest()
+        # gating routes step() by proposer confidence, so the cycle
+        # above proved only one of the two step programs — warm both
+        sched.prime_step_programs()
         sched.reset()
         # the synthetic traffic must not read as served traffic on healthz
         sched.admitted = sched.recycled = sched.steps_run = 0
+        sched.spec_drafted = sched.spec_accepted = 0
+        sched.last_spec = None
+        if sched.prefix_cache is not None:
+            # the synthetic feed's cache entry + its hit/miss counts are
+            # warmup noise, not traffic
+            sched.prefix_cache.clear()
+            sched.prefix_cache.hits = sched.prefix_cache.misses = 0
+            sched.prefix_cache.evictions = 0
         # report the compiles the jit closures ACTUALLY paid during the
         # cycle, not an estimate — warmup_compiles is the cold-start
         # acceptance surface.  On a fully-primed boot this is zero (the
@@ -455,12 +479,36 @@ class InferenceServer:
         (``prime_model``) or its first buckets pay cold compiles on the
         hot path.  Returns the previous model; the caller keeps it
         resident until the probation window passes (rollback swaps it
-        straight back)."""
+        straight back).
+
+        Generation mode drains instead of cutting over: the incoming
+        :class:`~paddle_tpu.serving.slots.SlotBackend` gets a fresh slot
+        table built (and primed, when a compile cache is attached) in
+        THIS caller's thread, then the swap is staged — the worker stops
+        admitting, lets resident requests finish on the old table, and
+        flips scheduler + model atomically once it is empty.  The old
+        scheduler's prefix cache is cleared at the flip (its keys embed
+        the old fingerprint; clearing frees the bytes immediately)."""
         if self.mode != "bucket":
-            raise ServingError(
-                "hot-swap reload is a bucket-mode path — a generation "
-                "backend owns resident decode state; boot a fresh server "
-                "for a new generation model")
+            from paddle_tpu.serving.slots import SlotScheduler
+
+            if not (hasattr(model, "prefill") and hasattr(model, "step_fn")):
+                raise TypeError(
+                    "generation swap needs a SlotBackend (prefill/step_fn/"
+                    f"readout), got {type(model).__name__}")
+            old = self._scheduler
+            sched = SlotScheduler(
+                model, slots=old.slots, clock=self._clock,
+                spec_k=old.spec_k, draft=old.proposer,
+                prefix_cache_mb=(0.0 if old.prefix_cache is None else
+                                 old.prefix_cache.max_bytes / (1 << 20)),
+                page_pool_mb=(0.0 if old.pager is None else
+                              old.pager.max_bytes / (1 << 20)))
+            if self._compile_cache is not None:
+                sched.prime(self._compile_cache, [model.example_feed(1)])
+            prev = self.model
+            self._swap_next = (sched, model, info)
+            return prev
         runner = self._make_runner(model)
         prev = self.model
         self.model = model
@@ -509,13 +557,17 @@ class InferenceServer:
 
     def submit(self, feed: Dict[str, Any],
                deadline_ms: Optional[float] = None,
-               max_len: Optional[int] = None) -> ServingFuture:
+               max_len: Optional[int] = None,
+               session_id: Optional[str] = None) -> ServingFuture:
         """Admit one request (a dict feed with a leading batch dim on
         every part) or raise a typed rejection immediately.  Returns a
         :class:`ServingFuture` that is *guaranteed* to resolve.
 
         ``max_len`` (generation mode) is the request's own decode budget;
         it must fit the slot table's depth (the backend's ``max_len``).
+        ``session_id`` scopes the request's prefix-cache entry to a chat
+        session (docs/serving.md "Prefix/session caching"); without a
+        prefix cache it is carried but unused.
 
         With request tracing armed (``--obs_journal``; obs/trace.py) the
         call opens a request trace whose child spans decompose the whole
@@ -526,12 +578,14 @@ class InferenceServer:
         deadline rejections are retained by tail sampling."""
         tracer = get_tracer()
         if not tracer.enabled:
-            return self._submit(feed, deadline_ms, max_len, None, "", 0.0)
+            return self._submit(feed, deadline_ms, max_len, session_id,
+                                None, "", 0.0)
         rid = f"req-{os.getpid()}-{next(_REQ_SEQ):06d}"
         t0 = time.time()
         root = tracer.start_trace("request", request=rid, mode=self.mode)
         try:
-            fut = self._submit(feed, deadline_ms, max_len, root, rid, t0)
+            fut = self._submit(feed, deadline_ms, max_len, session_id,
+                               root, rid, t0)
         except ServingError as e:
             status = _REJECT_STATUS.get(type(e).__name__,
                                         type(e).__name__)
@@ -551,6 +605,7 @@ class InferenceServer:
     def _submit(self, feed: Dict[str, Any],
                 deadline_ms: Optional[float],
                 max_len: Optional[int],
+                session_id: Optional[str],
                 root, rid: str, t_trace: float) -> ServingFuture:
         self.metrics.inc("submitted")
         if self._state != self.RUNNING:
@@ -647,7 +702,8 @@ class InferenceServer:
         req = Request(feed=canon, rows=rows, signature=sig,
                       future=ServingFuture(), deadline=deadline,
                       t_submit=now, deadline_ms=deadline_ms,
-                      max_len=max_len, req_id=rid, span=root)
+                      max_len=max_len, req_id=rid, span=root,
+                      session_id=session_id)
         if root is not None:
             # every root mutation happens BEFORE offer(): the worker may
             # pop, serve, and FLUSH the trace the instant the request is
@@ -840,6 +896,20 @@ class InferenceServer:
         fused decode step for every occupied slot.  Every phase keeps the
         bucket path's reply-or-typed-error guarantees."""
         sched = self._scheduler
+        # staged hot-swap: admission is paused while a swap is pending
+        # (free=0 below), so the table drains; once empty — host page
+        # pool included — flip scheduler + model atomically and clear
+        # the old prefix cache (its keys embed the retired fingerprint)
+        if (self._swap_next is not None and sched.occupied() == 0
+                and (sched.pager is None or len(sched.pager) == 0)):
+            new_sched, new_model, info = self._swap_next
+            self._swap_next = None
+            if sched.prefix_cache is not None:
+                sched.prefix_cache.clear()
+            self.model = new_model
+            self._scheduler = sched = new_sched
+            self.set_model_info(info)
+            self.metrics.inc("model_swaps")
         live = lambda: self.supervisor.current(gen)  # noqa: E731
         # deadline plane first: an expired resident can never reply in
         # time, and its slot is capacity short requests are waiting on
@@ -885,7 +955,14 @@ class InferenceServer:
         # nothing): its sweep must keep evicting already-expired queued
         # requests, or dead work occupies the bounded queue and sheds
         # live traffic for up to a straggler's whole decode
-        free = sched.free_count()
+        if sched.pager is not None and self._swap_next is None:
+            # re-admit parked slots FIRST — paged work predates anything
+            # in the queue and must not be overtaken indefinitely
+            paged_in = sched.page_in(commit=live)
+            if paged_in:
+                self.metrics.inc("slots_paged_in", paged_in)
+        free = (0 if self._swap_next is not None
+                else sched.free_count())  # draining: admission paused
         occupied = sched.occupied()
         batch, expired = self.queue.pop_batch(
             max_rows=free,
@@ -960,6 +1037,13 @@ class InferenceServer:
                 self._fail_requests(batch, _mk, "inference_failed")
             finally:
                 self.supervisor.note_idle(gen)
+        # paging: with the table full and work still queued, host-evict
+        # ONE cold victim per cycle so next cycle's admission has a slot
+        # (one per cycle bounds the d2h cost and self-limits churn)
+        if (sched.pager is not None and self._swap_next is None
+                and self.queue.depth() > 0 and sched.free_count() == 0):
+            if sched.page_out_victim(commit=live):
+                self.metrics.inc("slots_paged_out")
         # the table's residents are the in-flight set: a worker death past
         # this point must fail exactly these futures (WorkerCrashed)
         self._in_flight = sched.resident_requests()
@@ -1007,11 +1091,21 @@ class InferenceServer:
                 # sharing the table at 0.9 occupancy behind a straggler".
                 sw1 = time.time()
                 occ = round(occupied / sched.slots, 3)
+                spec = sched.last_spec if sched.spec_k > 0 else None
                 for req, slots_, nsteps in sched.resident_view():
                     if req.span is not None:
+                        attrs = dict(slots=slots_, step=nsteps,
+                                     occupancy=occ)
+                        if spec is not None:
+                            # the speculation win, attributed per
+                            # request: tokens this wide step emitted
+                            # for it, and how many were accepted drafts
+                            attrs["spec_emitted"] = int(
+                                sum(spec[0][s] for s in slots_))
+                            attrs["spec_accepted"] = int(
+                                sum(spec[1][s] for s in slots_))
                         req.span.child_at("decode_step", sw0, sw1,
-                                          slots=slots_, step=nsteps,
-                                          occupancy=occ)
+                                          **attrs)
 
     def _execute(self, gen: int, batch: List[Request], merged, slices,
                  rows: int, tier_opts: dict) -> None:
@@ -1124,6 +1218,27 @@ class InferenceServer:
         # the registry view FIRST so healthz, /metrics, and
         # worker.restarts can never disagree
         self.metrics.set_count("worker_restarts", self.supervisor.restarts)
+        if self._scheduler is not None:
+            # the scheduler owns the decode-speed counters (speculation,
+            # prefix cache, paging) — mirror them into the registry
+            # BEFORE the snapshot so healthz and /metrics agree
+            _s = self._scheduler
+            if _s.pager is not None:
+                _p = _s.pager.stats()
+                self.metrics.set_count("slots_paged_out", _p["paged_out"])
+                self.metrics.set_count("slots_paged_in", _p["paged_in"])
+            if _s.spec_k > 0:
+                self.metrics.set_count("spec_draft_tokens_total",
+                                       _s.spec_drafted)
+                self.metrics.set_count("spec_accepted_tokens_total",
+                                       _s.spec_accepted)
+                self.metrics.gauge("spec_accept_rate").set(round(
+                    _s.spec_accepted / _s.spec_drafted
+                    if _s.spec_drafted else 0.0, 4))
+            if _s.prefix_cache is not None:
+                _c = _s.prefix_cache.stats()
+                self.metrics.set_count("prefix_cache_hits", _c["hits"])
+                self.metrics.set_count("prefix_cache_misses", _c["misses"])
         snap = self.metrics.snapshot()
         out = {
             "ready": self.ready,
@@ -1210,6 +1325,24 @@ class InferenceServer:
                 "recycled": sched.recycled,
                 "steps": sched.steps_run,
             }
+            if sched.pager is not None:
+                pstats = sched.pager.stats()
+                out["slots"]["paged_out"] = pstats["paged_out"]
+                out["slots"]["paged_in"] = pstats["paged_in"]
+                out["slots"]["parked"] = pstats["parked"]
+            if sched.spec_k > 0:
+                # speculation efficiency: accepted drafts / offered
+                # drafts — the knob to tune --spec_k against
+                rate = (sched.spec_accepted / sched.spec_drafted
+                        if sched.spec_drafted else 0.0)
+                out["spec"] = {
+                    "k": sched.spec_k,
+                    "draft_tokens_total": sched.spec_drafted,
+                    "accepted_tokens_total": sched.spec_accepted,
+                    "accept_rate": round(rate, 4),
+                }
+            if sched.prefix_cache is not None:
+                out["prefix_cache"] = sched.prefix_cache.stats()
         return out
 
     def __enter__(self) -> "InferenceServer":

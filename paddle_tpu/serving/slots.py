@@ -204,6 +204,15 @@ class _SlotEntry:
     t_admit: float
     admit_step: int = 0   # steps_run at admission: per-request step
     #                       participation stays host-side (no device sync)
+    history: List[int] = field(default_factory=list)
+    #                       emission history (BOS-seeded) — the draft
+    #                       proposer's input; maintained on the spec path
+    tokens_done: int = 0  # emissions so far: the spec budget cap, and
+    #                       the pager's remaining-work victim ranking
+    pages: int = 0        # page-out round trips (anti-thrash bound)
+    corpus_key: Optional[str] = None
+    #                       request content hash scoping the draft
+    #                       proposer's positional completion corpus
 
 
 @dataclass
@@ -233,12 +242,17 @@ class SlotScheduler:
     """
 
     def __init__(self, backend: SlotBackend, *, slots: int,
-                 clock=time.monotonic):
+                 clock=time.monotonic, spec_k: int = 0,
+                 draft: Optional[Any] = None,
+                 prefix_cache_mb: float = 0.0,
+                 page_pool_mb: float = 0.0):
         import jax
 
-        from paddle_tpu.ops.decode import (decode_step, finalize_slots,
-                                           init_slot_carry, release_slot,
-                                           write_slot)
+        from paddle_tpu.ops.decode import (decode_step, extract_slot,
+                                           finalize_slots, init_slot_carry,
+                                           release_slot, restore_slot,
+                                           spec_verify_step, write_slot)
+        from paddle_tpu.utils.log import logger
 
         if slots < 1:
             raise ValueError("slot table needs at least 1 slot")
@@ -246,6 +260,42 @@ class SlotScheduler:
         self.slots = int(slots)
         self._clock = clock
         self._lock = threading.Lock()
+
+        # speculative decoding rides the greedy-verify proof: beam>1 has
+        # no greedy-verify equivalent, so it silently falls back to the
+        # standard one-token step path (docs/decode.md)
+        if spec_k > 0 and backend.beam_size != 1:
+            logger.info("speculative decoding disabled: beam_size=%d "
+                        "(greedy verify needs beam_size=1)",
+                        backend.beam_size)
+            spec_k = 0
+        self.spec_k = int(spec_k)
+        self.proposer = None
+        if self.spec_k > 0:
+            from paddle_tpu.ops.speculative import NGramProposer
+
+            self.proposer = draft if draft is not None else NGramProposer()
+        self.spec_drafted = 0    # draft tokens offered to verification
+        self.spec_accepted = 0   # draft tokens the model confirmed
+        self.last_spec: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        #: the dispatched-but-unsynced wide step: (aux, entry snapshot).
+        #: The spec path pipelines one step deep — the device crunches
+        #: wide step N while the host does harvest/admit/drafting for
+        #: N+1; N's aux lands in host accounting at the top of the next
+        #: step (by then the transfer is a no-wait read).  See
+        #: _drain_spec for why every consumer of host accounting is
+        #: sound against the one-step lag.
+        self._spec_pending: Optional[Tuple[Any, List[Any]]] = None
+        self.prefix_cache = None
+        if prefix_cache_mb > 0:
+            from paddle_tpu.serving.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_cache_mb)
+        self.pager = None
+        if page_pool_mb > 0:
+            from paddle_tpu.serving.paging import SlotPager
+
+            self.pager = SlotPager(page_pool_mb)
 
         # step NEVER donates its carry: the commit-rejected (abandoned
         # worker) path discards the result and keeps the input.  Write and
@@ -279,8 +329,28 @@ class SlotScheduler:
                          "release": self._release_jit,
                          "final": self._final_jit,
                          "prefill": self._prefill_jit}
+        if self.spec_k > 0:
+            # the wide-verify step is a step: it must never donate (the
+            # commit-rejected path keeps the input carry)
+            self._spec_jit = jax.jit(lambda c, d, cap: spec_verify_step(
+                backend.step_fn, backend.readout, c, d, cap,
+                vocab_size=backend.vocab_size, eos=backend.eos,
+                use_kernel=backend.use_kernel))
+            self._jit_src["spec"] = self._spec_jit
+        if self.pager is not None:
+            # extract must NOT donate — the table survives a page-out;
+            # restore commits unconditionally once called, so it donates
+            # like write
+            self._extract_jit = jax.jit(
+                lambda c, slot: extract_slot(c, slot))
+            self._restore_jit = jax.jit(
+                lambda c, slot, saved: restore_slot(c, slot, saved),
+                donate_argnums=donate)
+            self._jit_src["extract"] = self._extract_jit
+            self._jit_src["restore"] = self._restore_jit
 
         tpl = jax.eval_shape(backend.prefill, backend.example_feed(1))
+        self._state_treedef = jax.tree_util.tree_structure(tpl)
         self._init_carry = lambda: init_slot_carry(
             tpl, slots=self.slots, beam_size=backend.beam_size,
             max_len=backend.max_len, eos=backend.eos)
@@ -391,6 +461,26 @@ class SlotScheduler:
             "release", self._jit_src["release"], (self._init_carry(), 0))
         self._final_jit = load_or_compile(
             "final", self._jit_src["final"], (self._init_carry(),))
+        if self.spec_k > 0:
+            # the wide-verify step joins the precompiled surface so the
+            # first speculative step after boot never compiles
+            self._spec_jit = load_or_compile(
+                "spec", self._jit_src["spec"],
+                (self._init_carry(),
+                 jnp.zeros((self.slots, self.spec_k), jnp.int32),
+                 jnp.zeros((self.slots,), jnp.int32)),
+                extra_sig=f"k={self.spec_k}")
+        if self.pager is not None:
+            self._extract_jit = load_or_compile(
+                "extract", self._jit_src["extract"],
+                (self._init_carry(), 0))
+            saved0 = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(self._jit_src["extract"],
+                               self._init_carry(), 0))
+            self._restore_jit = load_or_compile(
+                "restore", self._jit_src["restore"],
+                (self._init_carry(), 0, saved0))
         if buckets is None:
             buckets = sorted({batch_bucket(r, self.slots)
                               for r in range(1, self.slots + 1)})
@@ -418,6 +508,23 @@ class SlotScheduler:
         self.cache_hits += counts["hits"]
         self.cache_misses += counts["misses"]
         return counts
+
+    def prime_step_programs(self) -> None:
+        """Warm BOTH step programs against the live carry — the plain
+        one-token step and, when speculation is armed, the wide verify.
+        Speculation GATING picks between them per step from host-side
+        proposer confidence, so a traffic-driven warmup can prove only
+        whichever path its synthetic history happens to trigger; this
+        makes zero-compiles-on-the-hot-path unconditional.  Results are
+        discarded (neither step program donates its carry)."""
+        import jax
+
+        jax.block_until_ready(self._step_jit(self.carry))
+        if self.spec_k > 0:
+            jax.block_until_ready(self._spec_jit(
+                self.carry,
+                np.zeros((self.slots, self.spec_k), np.int32),
+                np.zeros((self.slots,), np.int32)))
 
     def compiled_programs(self) -> int:
         """Distinct programs the ORIGINAL jit closures actually compiled
@@ -477,9 +584,71 @@ class SlotScheduler:
             self._entries = [None] * self.slots
             self._free = list(range(self.slots - 1, -1, -1))
             self._pending.clear()
+            if self.pager is not None:
+                self.pager.clear()  # parked requests are in _pending too
+            self.last_spec = None
+            self._spec_pending = None  # aux of a pre-reset carry: stale
             return dropped
 
     # -- admission ---------------------------------------------------------
+
+    def _cache_key(self, req: Request) -> Optional[str]:
+        """Prefix-cache key for a request, or None when uncacheable:
+        content hash over the model fingerprint + the canonical feed
+        bytes (+ the chat ``session_id`` when present, scoping chat
+        turns to their own session).  Multi-row requests are not cached
+        (their rows would need per-row keys for marginal benefit)."""
+        if self.prefix_cache is None:
+            return None
+        if getattr(req, "rows", 1) != 1:
+            return None
+        fp = self.backend.fingerprint()
+        if fp is None:
+            return None
+        parts: List[Any] = [fp]
+        sid = getattr(req, "session_id", None)
+        if sid is not None:
+            parts.append(f"session:{sid}")
+        for name in sorted(req.feed):
+            v = req.feed[name]
+            parts.append(name)
+            if isinstance(v, (tuple, list)):
+                parts.extend(np.asarray(x) for x in v)
+            else:
+                parts.append(np.asarray(v))
+        return self.prefix_cache.key(*parts)
+
+    def _corpus_key(self, req: Request, row: int) -> Optional[str]:
+        """Content key scoping the draft proposer's positional
+        completion corpus: model fingerprint + canonical feed bytes
+        (+ ``session_id``) + the request row.  Greedy decode is
+        deterministic, so a request with the same key emits the same
+        sequence — the proposer replays an earlier completion
+        positionally (acceptance ~1.0 on repeat/template traffic).
+        The fingerprint scopes learned completions to the live model
+        generation: a hot-swap changes every key, so stale-model
+        trajectories can never be replayed (and the proposer's prefix
+        check backstops even that).  Independent of the prefix cache —
+        speculation is worth keying with or without cached prefills."""
+        if self.spec_k <= 0:
+            return None
+        fp = self.backend.fingerprint()
+        if fp is None:
+            return None
+        from paddle_tpu.serving.prefix_cache import feed_key
+
+        parts: List[Any] = [fp, f"row:{row}"]
+        sid = getattr(req, "session_id", None)
+        if sid is not None:
+            parts.append(f"session:{sid}")
+        for name in sorted(req.feed):
+            v = req.feed[name]
+            parts.append(name)
+            if isinstance(v, (tuple, list)):
+                parts.extend(np.asarray(x) for x in v)
+            else:
+                parts.append(np.asarray(v))
+        return feed_key(*parts)
 
     def admit(self, reqs: List[Request], *,
               limit_cap: Optional[int] = None,
@@ -494,21 +663,70 @@ class SlotScheduler:
         prefill — an abandoned worker must not write into the fresh
         worker's table; its requests were already failed by the crash
         handler).  Raises on prefill failure (a model fault — nothing was
-        admitted; the caller fails the batch typed)."""
+        admitted; the caller fails the batch typed).
+
+        With a :class:`~paddle_tpu.serving.prefix_cache.PrefixCache`
+        attached, single-row requests whose content key was prefilled
+        before skip the encoder entirely: their cached state rows are
+        written straight into slots (prefill is row-independent and
+        batch-size-invariant, so a cached row is bit-identical to a
+        fresh one).  Cache-missing rows are prefilled as one merged
+        call and their state rows populate the cache post-commit."""
         if not reqs:
             return 0
-        merged, slices, rows = merge_feeds(reqs, self.slots)
-        state0 = self._prefill(merged)
+        import jax
+
+        hits: List[Tuple[Request, Dict[str, np.ndarray]]] = []
+        misses: List[Request] = []
+        keys: Dict[int, Optional[str]] = {}
+        if self.prefix_cache is not None:
+            for req in reqs:
+                key = self._cache_key(req)
+                keys[id(req)] = key
+                payload = self.prefix_cache.get(key) if key else None
+                if payload is not None:
+                    hits.append((req, payload))
+                else:
+                    misses.append(req)
+        else:
+            misses = list(reqs)
+
+        state0 = slices = None
+        if misses:
+            merged, slices, _rows = merge_feeds(misses, self.slots)
+            state0 = self._prefill(merged)
+        state_h = None
+        if hits:
+            from paddle_tpu.serving.batching import batch_bucket
+
+            # stack cached rows and pad by replication up to the batch
+            # bucket — the same primed _write_aot bucket surface the
+            # merged-prefill path lands on, so a hit never recompiles
+            nleaf = len(hits[0][1])
+            cols = [np.concatenate([p[f"leaf{i}"] for _, p in hits],
+                                   axis=0) for i in range(nleaf)]
+            bucket = batch_bucket(len(hits), self.slots)
+            if bucket > len(hits):
+                cols = [np.concatenate(
+                    [c] + [c[-1:]] * (bucket - len(hits)), axis=0)
+                    for c in cols]
+            state_h = jax.tree_util.tree_unflatten(
+                self._state_treedef, cols)
+
         now = self._clock()
         n = 0
         with self._lock:
             if not commit():
                 return 0
-            if sum(b - a for a, b in slices) > len(self._free):
+            need = ((sum(b - a for a, b in slices) if slices else 0)
+                    + len(hits))
+            if need > len(self._free):
                 raise RuntimeError(
-                    f"admit overflow: {rows} rows into "
+                    f"admit overflow: {need} rows into "
                     f"{len(self._free)} free slots")
-            for req, (a, b) in zip(reqs, slices):
+
+            def _admit_rows(req, a, b, state):
+                nonlocal n
                 limit = min(req.max_len or self.backend.max_len,
                             self.backend.max_len,
                             limit_cap or self.backend.max_len)
@@ -516,14 +734,36 @@ class SlotScheduler:
                 self._pending[id(req)] = _PendingRequest(
                     request=req, rows=b - a,
                     results=[None] * (b - a))
+                # the helper is defined AND only ever called inside the
+                # enclosing `with self._lock` block — the lock is held
+                # for every access below (static race lint can't see
+                # through the nested scope, hence the annotations)
                 for row in range(a, b):
-                    slot = self._free.pop()
-                    self.carry = self._write(self.carry, slot, state0,
-                                             row)
-                    self._entries[slot] = _SlotEntry(req, row - a, limit,
-                                                     now, self.steps_run)
+                    slot = self._free.pop()  # tpu-lint: guarded-by=_lock - called only from the admit() lock block
+                    self.carry = self._write(self.carry, slot, state, row)
+                    self._entries[slot] = _SlotEntry(  # tpu-lint: guarded-by=_lock - called only from the admit() lock block
+                        req, row - a, limit, now, self.steps_run,  # tpu-lint: guarded-by=_lock - called only from the admit() lock block
+                        history=[self.backend.bos],
+                        corpus_key=self._corpus_key(req, row - a))
                     n += 1
+
+            if misses:
+                for req, (a, b) in zip(misses, slices):
+                    _admit_rows(req, a, b, state0)
+            for i, (req, _) in enumerate(hits):
+                _admit_rows(req, i, i + 1, state_h)
             self.admitted += n
+        # populate the cache from the rows just prefilled — post-commit,
+        # so an abandoned worker's prefill can never seed the cache
+        if self.prefix_cache is not None and misses and n:
+            leaves = jax.tree_util.tree_leaves(state0)
+            for req, (a, b) in zip(misses, slices):
+                key = keys.get(id(req))
+                if key is None or b - a != 1:
+                    continue
+                self.prefix_cache.put(key, {
+                    f"leaf{i}": np.asarray(leaf[a:a + 1])
+                    for i, leaf in enumerate(leaves)})
         return n
 
     # -- the fused step ----------------------------------------------------
@@ -531,13 +771,136 @@ class SlotScheduler:
     def step(self, commit: Callable[[], bool] = lambda: True) -> bool:
         """Run one fused decode step for every occupied slot.  The new
         carry is committed only if ``commit()`` still holds after the
-        device call returns (abandoned-worker discipline)."""
+        device call returns (abandoned-worker discipline).  With
+        speculative decoding armed (``spec_k > 0`` over a greedy table)
+        this is the wide-verify step: up to ``spec_k + 1`` tokens per
+        slot per call, bit-identical to one-token stepping."""
+        if self.spec_k > 0:
+            return self._spec_step(commit)
         new = self._step_jit(self.carry)
         with self._lock:
             if not commit():
                 return False
             self.carry = new
             self.steps_run += 1
+            for e in self._entries:
+                if e is not None:
+                    e.tokens_done += 1
+        return True
+
+    def _spec_step(self, commit: Callable[[], bool]) -> bool:
+        """One speculative step: host-propose ``spec_k`` drafts per
+        occupied slot from its emission history, verify all of them in
+        ONE fused :func:`~paddle_tpu.ops.decode.spec_verify_step` call,
+        and sync the per-slot emissions back into the histories the
+        next round of drafting reads.  The per-slot ``cap`` (remaining
+        request budget) keeps wide emission from stepping past each
+        request's own ``max_len`` — the in-op form of the harvest-
+        before-step bound the one-token path gets for free.
+
+        Speculation is GATED per step: when no occupied slot has a
+        *confident* draft (learned corpus / suffix match / draft model
+        — see ``DraftProposer.propose_with_confidence``), the wide
+        verify would pay ``k + 1`` recurrence positions for a
+        guaranteed single emission, so the table runs the plain
+        one-token step instead.  Both programs are compiled at prime
+        time, so gating never triggers a new XLA compile on the hot
+        path.  Gated steps offer no drafts, so they leave the
+        acceptance-rate accounting untouched.
+
+        The wide step is dispatched ASYNC and its aux outputs are NOT
+        read back here: the sync is deferred to the top of the next
+        step (``_drain_spec``), so the device computes wide step N
+        while the host runs harvest / admission / drafting for N+1 —
+        the same one-step overlap the plain path gets from jax's async
+        dispatch for free.  Draining first means drafts and caps below
+        are always computed from fully-synced accounting."""
+        k = self.spec_k
+        if not self._drain_spec(commit):
+            return False
+        with self._lock:
+            entries = list(self._entries)
+        drafts = np.zeros((self.slots, k), np.int32)
+        cap = np.zeros((self.slots,), np.int32)
+        any_conf = False
+        for slot, e in enumerate(entries):
+            if e is None:
+                continue
+            cap[slot] = max(0, e.limit - e.tokens_done)
+            d, conf = self.proposer.propose_with_confidence(
+                e.history, k, key=e.corpus_key)
+            drafts[slot] = d
+            any_conf = any_conf or conf
+        if not any_conf:
+            # cold table: nothing worth verifying — one-token step.
+            # Histories are NOT extended here (that would cost a host
+            # sync, the thing the wide step amortizes); the proposer
+            # learns completed trajectories at harvest instead, so a
+            # stale in-flight history only lowers acceptance, never
+            # correctness.
+            new = self._step_jit(self.carry)
+            with self._lock:
+                if not commit():
+                    return False
+                self.carry = new
+                self.steps_run += 1
+                for slot, e in enumerate(self._entries):
+                    if e is not None and e is entries[slot]:
+                        e.tokens_done += 1
+                self.last_spec = None
+            return True
+        new, aux = self._spec_jit(self.carry, drafts, cap)
+        with self._lock:
+            if not commit():
+                return False
+            self.carry = new
+            self.steps_run += 1
+            self._spec_pending = (aux, entries)
+        return True
+
+    def _drain_spec(self, commit: Callable[[], bool] = lambda: True
+                    ) -> bool:
+        """Land the pending wide step's aux outputs (accepted counts,
+        emitted tokens) into host accounting: histories, ``tokens_done``,
+        the acceptance counters, ``last_spec``.  Called at the top of
+        the next step — by then the device has finished the step, so
+        the read-back costs a transfer, not a stall — and by any
+        consumer that snapshots per-slot device state host-side
+        (``page_out_victim``: its parked record must not be one step
+        behind the carry it extracts).
+
+        Every other consumer is sound against the one-step lag:
+        ``done_slots``'s host fast path under-claims at worst (a slot
+        looks unfinished for one extra cycle), harvest reads device
+        truth for tokens/scores, and a finished slot is a fixed point
+        of the wide step (its remaining cap is 0, so the pending step
+        emits nothing into it).  A reset between dispatch and drain
+        fails ``commit()`` and the stale aux is discarded — its entry
+        snapshot no longer matches the table either way."""
+        p = self._spec_pending  # tpu-lint: guarded-by=_lock - popped only by the single driving worker (step/page_out); a racing reset() fails commit() below and the stale aux is discarded
+        if p is None:
+            return True
+        self._spec_pending = None  # tpu-lint: guarded-by=_lock - same single-driver discipline as the read above
+        aux, entries = p
+        k = self.spec_k
+        n_arr = np.asarray(aux["n"])
+        em = np.asarray(aux["emitted"])
+        acc = np.asarray(aux["accepted"])
+        with self._lock:
+            if not commit():
+                return False
+            for slot, e in enumerate(self._entries):
+                # identity check: a slot released (harvest/evict) and
+                # possibly re-admitted since dispatch must not receive
+                # the old request's emissions
+                if e is None or e is not entries[slot]:
+                    continue
+                ni = int(n_arr[slot])
+                e.history.extend(int(t) for t in em[slot, :ni])
+                e.tokens_done += ni
+                self.spec_drafted += k
+            self.spec_accepted += int(acc.sum())
+            self.last_spec = (n_arr, acc)
         return True
 
     # -- harvest + eviction ------------------------------------------------
@@ -549,15 +912,105 @@ class SlotScheduler:
         self._free.append(slot)
         self.recycled += 1
 
+    def _park(self, slot: int) -> None:
+        # callers hold _lock: free the slot WITHOUT counting a recycle —
+        # a paged-out request is still in flight, not completed, so the
+        # recycled counter (one per finished/evicted slot, pinned by the
+        # CLI smoke test) must not move
+        self.carry = self._release_jit(self.carry, slot)
+        self._entries[slot] = None
+        self._free.append(slot)
+
     def _drop_request(self, req: Request) -> int:
-        # callers hold _lock: release EVERY slot the request occupies
+        # callers hold _lock: release EVERY slot the request occupies,
+        # resident or parked in the host page pool
         n = 0
         for slot, e in enumerate(self._entries):
             if e is not None and e.request is req:
                 self._release(slot)
                 n += 1
+        if self.pager is not None:
+            self.pager.drop_request(req)
         self._pending.pop(id(req), None)
         return n
+
+    # -- host paging -------------------------------------------------------
+
+    def page_out_victim(self,
+                        commit: Callable[[], bool] = lambda: True) -> bool:
+        """Host-evict the coldest occupied slot — the one with the MOST
+        remaining decode budget (it will hold its slot longest), at
+        least one step old (never page what was just admitted) and under
+        the anti-thrash bound of 2 round trips.  Its full decode context
+        d2h-copies into the pager pool and the slot frees for an
+        admission; :meth:`page_in` restores it bit-for-bit later."""
+        if self.pager is None:
+            return False
+        import jax
+
+        from paddle_tpu.serving.paging import PagedSlot
+
+        # land any in-flight wide step first: the parked record's
+        # history/tokens_done must describe the same step the extracted
+        # payload reflects, or the restored slot re-drafts stale
+        if self.spec_k > 0 and not self._drain_spec(commit):
+            return False
+        with self._lock:
+            best, best_rem = None, -1
+            for slot, e in enumerate(self._entries):
+                if (e is None or e.pages >= 2
+                        or self.steps_run - e.admit_step <= 0):
+                    continue
+                rem = e.limit - e.tokens_done
+                if rem > best_rem:
+                    best_rem, best = rem, slot
+            if best is None:
+                return False
+            ent = self._entries[best]
+        saved = self._extract_jit(self.carry, best)
+        payload = jax.tree_util.tree_map(np.asarray, saved)  # d2h copy
+        rec = PagedSlot(request=ent.request, row=ent.row, limit=ent.limit,
+                        t_admit=ent.t_admit, history=list(ent.history),
+                        tokens_done=ent.tokens_done, payload=payload,
+                        pages=ent.pages + 1, admit_step=ent.admit_step)
+        with self._lock:
+            if not commit() or self._entries[best] is not ent:
+                return False
+            if not self.pager.park(rec):
+                return False  # pool full: the slot stays resident
+            self._park(best)
+        return True
+
+    def page_in(self, commit: Callable[[], bool] = lambda: True) -> int:
+        """Re-admit parked slots (FIFO — no starvation) while free slots
+        remain, restoring each snapshot bit-for-bit via
+        :func:`~paddle_tpu.ops.decode.restore_slot`.  Returns slots
+        restored.  Runs BEFORE new admissions each cycle so parked work
+        is never overtaken indefinitely by fresh arrivals."""
+        if self.pager is None:
+            return 0
+        n = 0
+        while True:
+            with self._lock:
+                if not self._free:
+                    return n
+            rec = self.pager.pop()
+            if rec is None:
+                return n
+            with self._lock:
+                if not commit():
+                    # a reset is in flight — it clears the pager and
+                    # fails every pending request, this record included
+                    return n
+                slot = self._free.pop()
+                self.carry = self._restore_jit(self.carry, slot,
+                                               rec.payload)
+                self._entries[slot] = _SlotEntry(
+                    rec.request, rec.row, rec.limit, rec.t_admit,
+                    self.steps_run, history=list(rec.history),
+                    tokens_done=rec.tokens_done, pages=rec.pages,
+                    corpus_key=self._corpus_key(rec.request, rec.row))
+                n += 1
 
     def evict_expired(self, now: float,
                       commit: Callable[[], bool] = lambda: True
@@ -577,16 +1030,44 @@ class SlotScheduler:
                         and now > e.request.deadline
                         and not any(r is e.request for r, _ in expired)):
                     expired.append((e.request, 0))
+            if self.pager is not None:
+                # the paged half of the sweep: a parked request's
+                # deadline keeps ticking in the host pool
+                for rec in self.pager.sweep_expired(
+                        lambda r: r.request.deadline is not None
+                        and now > r.request.deadline):
+                    if not any(r is rec.request for r, _ in expired):
+                        expired.append((rec.request, 0))
             return [(req, self._drop_request(req)) for req, _ in expired]
 
     def done_slots(self) -> List[int]:
         """Slots whose request finished: all beams EOS, or the request's
         own ``max_len`` reached.  One host sync over two tiny arrays —
         skipped entirely on an empty table (the sync would otherwise
-        block on the previous step's async dispatch every idle cycle)."""
+        block on the previous step's async dispatch every idle cycle).
+
+        On the speculative path the answer comes from HOST accounting
+        alone — no device read.  ``tokens_done`` mirrors the device step
+        counter exactly for every occupied slot (wide steps advance both
+        by the emitted count, gated plain steps by one — including the
+        EOS-padding emissions of finished rows), and an EOS in the
+        drained emission history implies the device ``finished`` flag.
+        Host evidence therefore never over-claims; it can lag device
+        truth by at most the one undrained in-flight step, which only
+        delays a harvest by a cycle (a done slot is a fixed point of the
+        wide step: its cap is 0 once accounting catches up).  Skipping
+        the read matters because this runs every serve cycle: a device
+        sync here would stall the pipelined wide step ``_spec_step``
+        just dispatched."""
         with self._lock:
             if not any(e is not None for e in self._entries):
                 return []
+            if self.spec_k > 0:
+                eos = self.backend.eos
+                return [i for i, e in enumerate(self._entries)
+                        if e is not None
+                        and (e.tokens_done >= e.limit
+                             or eos in e.history[1:])]
         fin = np.asarray(self.carry["finished"]).all(axis=1)
         stepc = np.asarray(self.carry["step"])
         with self._lock:
@@ -615,6 +1096,18 @@ class SlotScheduler:
                 if e is None:       # raced with an eviction
                     continue
                 pend = self._pending.get(id(e.request))
+                if self.spec_k > 0 and stepc[slot] > 0:
+                    # feed the completed trajectory back to the draft
+                    # proposer: session/template traffic drafts the next
+                    # identical request from this one (host dict insert,
+                    # never touches the compiled surface).  Learned from
+                    # the FINALIZED host tokens, not e.history — history
+                    # is only maintained on wide steps, so gated (plain)
+                    # steps would leave it stale
+                    seq = [self.backend.bos] + [
+                        int(t) for t in
+                        toks[slot][0][:min(int(stepc[slot]), e.limit)]]
+                    self.proposer.learn(seq, key=e.corpus_key)
                 self._release(slot)
                 if pend is None:
                     continue
@@ -656,18 +1149,23 @@ def example_slot_backend(*, slots: int = 4, beam_size: int = 4,
 
 
 def audit_slot_backend(backend: Optional[SlotBackend] = None, *,
-                       slots: int = 4, label: str = "serve_slots"):
+                       slots: int = 4, label: str = "serve_slots",
+                       spec_k: int = 0):
     """Audit the compiled ``decode_step`` closure over a slot table —
     same contract as ``analysis.audit_decode`` (host transfers inside the
     step are an ERROR: one per token per request at serving rates), used
     by ``python -m paddle_tpu lint --serve`` and the generation-mode
     server preflight.  Both readout variants are traced where the kernel
-    gate admits the shape (the kernel in interpret mode off-TPU)."""
+    gate admits the shape (the kernel in interpret mode off-TPU).  With
+    ``spec_k > 0`` over a greedy (``beam_size == 1``) backend the
+    compiled wide-verify closure is audited under the same contract —
+    a host transfer inside the speculative step would fire once per
+    wide step, exactly the hazard the one-token audit guards."""
     import jax
 
     from paddle_tpu.analysis import Finding, audit_decode
     from paddle_tpu.ops.decode import (_forced_kernel_config, decode_step,
-                                       init_slot_carry)
+                                       init_slot_carry, spec_verify_step)
 
     backend = backend or example_slot_backend(slots=slots)
     tpl = jax.eval_shape(backend.prefill, backend.example_feed(1))
@@ -694,5 +1192,23 @@ def audit_slot_backend(backend: Optional[SlotBackend] = None, *,
                 check="serve-build", severity="ERROR",
                 file=f"{label}[{tag}]",
                 message=f"slot decode_step failed to trace: "
+                        f"{type(e).__name__}: {e}"))
+    if spec_k > 0 and backend.beam_size == 1:
+        import jax.numpy as jnp
+
+        drafts = jnp.zeros((slots, spec_k), jnp.int32)
+        cap = jnp.full((slots,), backend.max_len, jnp.int32)
+        try:
+            findings.extend(audit_decode(
+                lambda c: spec_verify_step(
+                    backend.step_fn, backend.readout, c, drafts, cap,
+                    vocab_size=backend.vocab_size, eos=backend.eos,
+                    use_kernel=backend.use_kernel)[0],
+                carry, label=f"{label}[spec_verify]"))
+        except Exception as e:
+            findings.append(Finding(
+                check="serve-build", severity="ERROR",
+                file=f"{label}[spec_verify]",
+                message=f"spec_verify_step failed to trace: "
                         f"{type(e).__name__}: {e}"))
     return findings

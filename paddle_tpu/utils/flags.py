@@ -346,6 +346,25 @@ define_flag("serve_slots", 8, "serving: decode slot capacity of the "
             "continuous-batching table (each slot holds one request's "
             "beams; also the admission row bound in generation mode)",
             validator=lambda v: v >= 1)
+define_flag("spec_decode", False, "serving: speculative decoding over the "
+            "slot table — a host draft proposer offers --spec_k candidate "
+            "tokens per slot and ONE fused wide-verify step accepts the "
+            "longest prefix the model itself would emit; greedy "
+            "(beam_size=1) backends only, outputs stay bit-identical to "
+            "one-token stepping (docs/decode.md)")
+define_flag("spec_k", 4, "serving: draft tokens per slot per speculative "
+            "step (the wide verify scores k+1 positions; tune against "
+            "healthz spec_accept_rate)", validator=lambda v: v >= 1)
+define_flag("prefix_cache_mb", 0.0, "serving: host MiB budget for the "
+            "prefix/session cache — requests repeating a source (or chat "
+            "session) reuse the cached encoder state as slot prefill, "
+            "keyed by content hash with LRU eviction (0 = off; "
+            "docs/serving.md)", validator=lambda v: v >= 0.0)
+define_flag("slot_page_pool", 0.0, "serving: host MiB budget for paged "
+            "slot state — with the table full and work queued, cold slot "
+            "carries are host-evicted and later restored bit-for-bit, so "
+            "capacity stops being bounded by HBM (0 = off; "
+            "docs/serving.md)", validator=lambda v: v >= 0.0)
 
 # Deterministic sharded data pipeline (paddle_tpu/datapipe; docs/data.md)
 define_flag("data_pack", False, "sequence packing: several short "

@@ -51,6 +51,8 @@ _UNITS = [
     ("trace_overhead_ab", "tok/s (tracing armed; vs = ×off)"),
     ("sdc_overhead_ab", "ms (fp every step; vs = ×off)"),
     ("publish_reload_ab", "s (hot-swap to ready; vs = ×restart)"),
+    ("spec_decode_ab", "tok/s (speculative; vs = ×plain)"),
+    ("prefix_cache_ab", "tok/s (cache on; vs = ×off)"),
 ]
 
 

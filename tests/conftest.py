@@ -24,6 +24,22 @@ if os.environ.get("PADDLE_TPU_TEST_BACKEND") != "tpu":
 import numpy as np
 import pytest
 
+# Persistent XLA compilation cache for the suite.  The scheduler/server
+# tiers deliberately build FRESH jit closures per instance (so per-table
+# compile counters can't cross-talk), which means hundreds of tests
+# recompile byte-identical XLA programs (same seeded weights folded in
+# as constants).  The disk cache serves those recompiles — both across
+# test runs AND across closures within one run — without touching any
+# in-process jit-cache counter the tests pin (tracing still happens;
+# only the XLA backend compile is skipped).  Honors an explicit
+# JAX_COMPILATION_CACHE_DIR from the environment.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/paddle_tpu_test_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

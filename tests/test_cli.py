@@ -173,6 +173,30 @@ def test_cli_serve_continuous_smoke_zero_silent_drops(capsys):
     assert last["mean_slot_occupancy"] is not None
 
 
+def test_cli_serve_continuous_smoke_spec_decode_arm(capsys):
+    """The same continuous smoke with ``--spec_decode``: the backend
+    drops to beam_size=1, the scheduler arms the wide-verify step, and
+    every request must still resolve (speculation is bit-identical, so
+    the pass/fail surface is unchanged) with the spec health block —
+    k, draft/accept totals, accept_rate — reported in healthz."""
+    rc = main(["serve", "--serve_continuous", "--serve_smoke=11",
+               "--serve_slots=3", "--serve_deadline_ms=60000",
+               "--spec_decode"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    import json
+
+    first, last = json.loads(out[0]), json.loads(out[-1])
+    assert first["ready"] is True and first["mode"] == "generation"
+    assert last["counters"]["completed"] == 11
+    assert last["counters"]["worker_crashed"] == 0
+    assert last["slots"]["recycled"] == 11
+    spec = last["spec"]
+    assert spec["k"] > 0
+    assert spec["draft_tokens_total"] >= spec["accepted_tokens_total"] >= 0
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+
+
 def test_cli_serve_smoke_int8_bundle_warm_cache(tmp_path, capsys):
     """CI acceptance (docs/deploy.md): `serve --serve_smoke` over an
     int8-QUANTIZED bundle with a shared --compile_cache_dir.  First boot

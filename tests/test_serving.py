@@ -635,6 +635,9 @@ def test_healthz_counter_key_set_pinned_for_dashboards():
         "server_closed", "worker_restarts", "degraded", "batches",
         "gen_steps", "slot_recycled", "slot_evicted",
         "compile_cache_hits", "compile_cache_misses", "warmup_compiles",
+        "spec_draft_tokens_total", "spec_accepted_tokens_total",
+        "prefix_cache_hits", "prefix_cache_misses",
+        "slots_paged_out", "slots_paged_in",
     }
     m = ServerMetrics()
     snap = m.snapshot()

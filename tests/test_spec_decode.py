@@ -1,0 +1,393 @@
+"""Decode raw speed: speculative decoding, prefix/session caching, and
+host-paged slot state (paddle_tpu/ops/speculative.py, ops/decode.py
+spec_verify_step, serving/slots.py, serving/prefix_cache.py,
+serving/paging.py; docs/decode.md "Speculative decoding").
+
+The acceptance bar:
+
+- **bit-identity** — with speculation ON (any proposer, any acceptance
+  rate), with the prefix cache ON, and across a host page-out/page-in
+  round trip, every request's tokens AND scores are bit-identical to a
+  solo ``beam_decode`` run and to the plain (spec OFF) scheduler, under
+  both admission orders.  Greedy verify accepts exactly the tokens the
+  model itself would have emitted — drafts only control throughput.
+- **acceptance learns** — on a repetitive trace the proposer's keyed
+  positional replay reaches ~ceiling acceptance from the second
+  encounter of a prompt onward.
+- **chaos** — ``bad_draft`` (adversarial proposer) degrades throughput
+  to the standard >= 1 token/step, never output; a corrupted prefix
+  cache entry is detected (crc), counted ``poisoned``, dropped, and the
+  request served correctly from a fresh prefill.
+- **zero compiles on the hot path** — after ``prime_step_programs()``
+  a full repetitive drive (gated plain steps AND wide steps) compiles
+  nothing new.
+
+Every test runs under a hard ``signal.alarm`` like test_serving_slots.
+"""
+
+import signal
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.decode import beam_decode
+from paddle_tpu.ops.speculative import (AdversarialProposer,
+                                        CallableDraftProposer,
+                                        DraftProposer, NGramProposer)
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import SlotScheduler
+from paddle_tpu.serving.batching import (Request, ServingFuture,
+                                         canonicalize_feed)
+from paddle_tpu.serving.slots import example_slot_backend
+
+HARD_TIMEOUT_S = 300
+
+SRC, L, V, D = 8, 12, 48, 16
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout():
+    def _abort(signum, frame):
+        raise RuntimeError(f"spec test exceeded {HARD_TIMEOUT_S}s")
+
+    prev = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(HARD_TIMEOUT_S)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, prev)
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return example_slot_backend(beam_size=1, src_len=SRC, max_len=L,
+                                vocab=V, dim=D)
+
+
+def _request(feed, *, max_len=L):
+    canon, rows, sig = canonicalize_feed(feed)
+    return Request(feed=canon, rows=rows, signature=sig,
+                   future=ServingFuture(), deadline=None,
+                   t_submit=0.0, max_len=max_len)
+
+
+def _feeds(n, distinct, seed=0):
+    """n single-row requests over `distinct` repeated sources — the
+    template/session traffic speculation and the prefix cache target."""
+    rng = np.random.RandomState(seed)
+    motifs = [rng.randint(3, V, (1, SRC)).astype(np.int32)
+              for _ in range(distinct)]
+    return [{"src": (motifs[i % distinct],
+                     np.asarray([SRC], np.int32))} for i in range(n)]
+
+
+def _solo(backend, feed, max_len=L):
+    """The oracle: the same request through the whole-batch engine."""
+    state0 = backend.prefill(feed)
+    toks, scores = beam_decode(
+        backend.step_fn, backend.readout, state0, batch_size=1,
+        beam_size=1, vocab_size=backend.vocab_size, max_len=max_len,
+        bos=backend.bos, eos=backend.eos)
+    return np.asarray(toks), np.asarray(scores)
+
+
+def _drive(sched, reqs, hook=None):
+    """The continuous loop: harvest / admit / step until drained.
+    ``hook(sched, cycle)`` runs once per cycle (chaos injection)."""
+    results = {}
+    pending = list(reqs)
+    cycle = 0
+    while (pending or sched.occupied()
+           or (sched.pager is not None and len(sched.pager))):
+        if hook is not None:
+            hook(sched, cycle)
+        cycle += 1
+        if sched.pager is not None:
+            sched.page_in()
+        for req, out, _steps in sched.harvest():
+            results[id(req)] = out
+        while pending and sched.free_count() >= pending[0].rows:
+            sched.admit([pending.pop(0)])
+        if sched.occupied():
+            sched.step()
+    return results
+
+
+def _assert_same(results_a, results_b, reqs_a, reqs_b):
+    for ra, rb in zip(reqs_a, reqs_b):
+        np.testing.assert_array_equal(results_a[id(ra)]["tokens"],
+                                      results_b[id(rb)]["tokens"])
+        np.testing.assert_array_equal(results_a[id(ra)]["scores"],
+                                      results_b[id(rb)]["scores"])
+
+
+# ---------------------------------------------------------------------------
+# bit-identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", ["forward", "reversed"],
+                         ids=["admit_in_order", "admit_reversed"])
+def test_spec_outputs_bit_identical_to_plain_and_solo(backend, order):
+    """Spec ON vs spec OFF over the identical repetitive trace, both
+    admission orders: tokens and scores bit-equal, and equal to a solo
+    beam_decode of each distinct prompt."""
+    feeds = _feeds(6, 2)
+    if order == "reversed":
+        feeds = feeds[::-1]
+    reqs_p = [_request(f) for f in feeds]
+    reqs_s = [_request(f) for f in feeds]
+
+    plain = SlotScheduler(backend, slots=2)
+    got_p = _drive(plain, reqs_p)
+    spec = SlotScheduler(backend, slots=2, spec_k=4)
+    got_s = _drive(spec, reqs_s)
+
+    _assert_same(got_p, got_s, reqs_p, reqs_s)
+    for f, r in zip(feeds, reqs_s):
+        canon, _, _ = canonicalize_feed(f)
+        solo_t, solo_s = _solo(backend, canon)
+        np.testing.assert_array_equal(got_s[id(r)]["tokens"], solo_t)
+        np.testing.assert_array_equal(got_s[id(r)]["scores"], solo_s)
+
+
+def test_spec_with_prefix_cache_and_paging_bit_identical(backend):
+    """All three prongs at once — speculation, prefix cache, and a host
+    page-out forced mid-drive — must still reproduce the plain arm
+    bit-for-bit."""
+    feeds = _feeds(8, 2)
+    reqs_p = [_request(f) for f in feeds]
+    reqs_s = [_request(f) for f in feeds]
+
+    plain = SlotScheduler(backend, slots=2)
+    got_p = _drive(plain, reqs_p)
+
+    spec = SlotScheduler(backend, slots=2, spec_k=4,
+                         prefix_cache_mb=8.0, page_pool_mb=8.0)
+    paged = []
+
+    def hook(s, cycle):
+        # park a mid-generation slot every few cycles, restore via the
+        # drive loop's page_in
+        if cycle % 3 == 2 and s.page_out_victim():
+            paged.append(cycle)
+
+    got_s = _drive(spec, reqs_s, hook=hook)
+    assert paged, "chaos hook never parked a slot — test lost its teeth"
+    _assert_same(got_p, got_s, reqs_p, reqs_s)
+    assert spec.prefix_cache.hits > 0
+
+
+def test_page_out_readmit_round_trip_bit_exact(backend):
+    """A request parked to the host pool mid-generation and re-admitted
+    finishes bit-identical to one that never left the device."""
+    feeds = _feeds(2, 2, seed=3)
+    reqs_a = [_request(f) for f in feeds]
+    reqs_b = [_request(f) for f in feeds]
+
+    base = SlotScheduler(backend, slots=2, spec_k=4)
+    got_a = _drive(base, reqs_a)
+
+    sched = SlotScheduler(backend, slots=2, spec_k=4, page_pool_mb=8.0)
+    sched.admit(reqs_b)
+    sched.step()
+    sched.step()
+    assert sched.page_out_victim()          # one resident goes to host
+    assert len(sched.pager) == 1
+    got_b = _drive(sched, [])               # page_in + finish both
+    assert len(got_b) == 2
+    _assert_same(got_a, got_b, reqs_a, reqs_b)
+
+
+# ---------------------------------------------------------------------------
+# acceptance + gating
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_positive_and_near_ceiling_on_repeat_trace(backend):
+    """Repetitive traffic must actually speculate: after one warm pass
+    (the proposer learns each completed trajectory under its request
+    content key), a second identical pass drafts by positional replay —
+    acceptance > 0 overall and ~1.0 on the warm pass."""
+    sched = SlotScheduler(backend, slots=2, spec_k=3)
+    _drive(sched, [_request(f) for f in _feeds(4, 2)])
+    warm_base = (sched.spec_drafted, sched.spec_accepted)
+    _drive(sched, [_request(f) for f in _feeds(4, 2)])
+    drafted = sched.spec_drafted - warm_base[0]
+    accepted = sched.spec_accepted - warm_base[1]
+    assert sched.spec_accepted > 0
+    assert drafted > 0
+    # positional replay accepts every draft inside the budget; the loss
+    # against 1.0 is structural, not predictive — the deferred drain
+    # means a just-finished slot is detected done one cycle late, so
+    # each request pays ~one zero-cap wide step of drafted-not-accepted
+    # accounting (the flagship bench pins the true ~1.0 ceiling)
+    assert accepted / drafted > 0.5
+
+
+def test_cold_table_gates_to_plain_step(backend):
+    """First step of a fresh request with an empty corpus: the n-gram
+    proposer has nothing predictive (history is just BOS), so the
+    scheduler must take the plain one-token path — no drafts counted,
+    ``last_spec`` None."""
+    sched = SlotScheduler(backend, slots=2, spec_k=4)
+    sched.admit([_request(_feeds(1, 1)[0])])
+    sched.step()
+    assert sched.last_spec is None
+    assert sched.spec_drafted == 0
+    assert sched.steps_run == 1
+
+
+def test_proposer_positional_replay_and_fallbacks():
+    """NGramProposer keyed behavior: exact-prefix positional replay wins
+    and is confident; a diverged history falls back; learn() without a
+    key still feeds the shared n-gram table."""
+    p = NGramProposer(order=3)
+    seq = [0, 5, 6, 7, 8, 9, 10]
+    p.learn(seq, key="req-A")
+    # positional: history == seq prefix -> the stored continuation
+    drafts, conf = p.propose_with_confidence([0, 5, 6], 3, key="req-A")
+    assert (drafts, conf) == ([7, 8, 9], True)
+    # k runs past the stored sequence: padded by repetition, still k long
+    drafts, conf = p.propose_with_confidence([0, 5, 6], 8, key="req-A")
+    assert len(drafts) == 8 and drafts[:4] == [7, 8, 9, 10] and conf
+    # diverged history: the prefix check rejects replay; n-gram corpus
+    # still matches the (5, 6) suffix learned from seq
+    drafts, conf = p.propose_with_confidence([0, 99, 5, 6], 2, key="req-A")
+    assert (drafts, conf) == ([7, 8], True)
+    # unknown key, unseen suffix, no in-history repeat: blind fallback
+    drafts, conf = p.propose_with_confidence([0, 41, 42], 2, key="nope")
+    assert conf is False and len(drafts) == 2
+    # base-class learn is a no-op and never confident
+    base = DraftProposer()
+    base.learn(seq, key="x")
+    assert base.propose_with_confidence([0, 1], 2, key="x")[1] is False
+
+
+def test_callable_proposer_is_draft_model_hook(backend):
+    """A CallableDraftProposer (the small-model hook) drives wide steps
+    (always confident) and stays bit-identical even when its drafts are
+    nonsense."""
+    feeds = _feeds(3, 1, seed=5)
+    reqs_p = [_request(f) for f in feeds]
+    reqs_s = [_request(f) for f in feeds]
+    plain = SlotScheduler(backend, slots=2)
+    got_p = _drive(plain, reqs_p)
+
+    calls = []
+
+    def tiny_model(history, k):
+        calls.append(len(history))
+        return [(history[-1] + 1) % V] * k
+
+    spec = SlotScheduler(backend, slots=2, spec_k=3,
+                         draft=CallableDraftProposer(tiny_model))
+    got_s = _drive(spec, reqs_s)
+    assert calls, "draft callable never consulted"
+    assert spec.spec_drafted > 0
+    _assert_same(got_p, got_s, reqs_p, reqs_s)
+
+
+# ---------------------------------------------------------------------------
+# chaos
+# ---------------------------------------------------------------------------
+
+
+def test_bad_draft_chaos_degrades_throughput_not_output(backend):
+    """chaos.bad_draft: adversarial always-wrong drafts force the wide
+    verify to reject every position — each wide step still emits >= 1
+    token (the model's own), and outputs stay bit-identical."""
+    feeds = _feeds(4, 2, seed=7)
+    reqs_p = [_request(f) for f in feeds]
+    reqs_s = [_request(f) for f in feeds]
+    plain = SlotScheduler(backend, slots=2)
+    got_p = _drive(plain, reqs_p)
+
+    # pick a draft token that appears NOWHERE in the true outputs (EOS
+    # padding included): greedy verify then provably accepts nothing
+    used = {int(t) for r in reqs_p
+            for t in np.asarray(got_p[id(r)]["tokens"]).ravel()}
+    token = next(t for t in range(V - 1, -1, -1) if t not in used)
+
+    spec = SlotScheduler(backend, slots=2, spec_k=4)
+    displaced = chaos.bad_draft(spec, token=token)
+    assert isinstance(displaced, NGramProposer)
+    assert isinstance(spec.proposer, AdversarialProposer)
+    got_s = _drive(spec, reqs_s)
+
+    assert spec.spec_drafted > 0            # wide steps actually ran
+    assert spec.spec_accepted == 0          # every draft rejected
+    # >= 1 token per step: 4 requests x L tokens emitted in <= that many
+    # steps (each wide dispatch emits at least the model's own token)
+    assert spec.steps_run <= 4 * L
+    _assert_same(got_p, got_s, reqs_p, reqs_s)
+
+
+def test_corrupt_prefix_cache_detected_quarantined_served(backend):
+    """chaos.corrupt_prefix_cache: a bit-flipped cached prefill must be
+    caught by the entry crc on the next hit — counted ``poisoned``,
+    treated as a miss, and the request re-prefilled correctly (the
+    poisoned payload is NEVER admitted)."""
+    feeds = _feeds(4, 1, seed=9)
+    sched = SlotScheduler(backend, slots=2, prefix_cache_mb=8.0)
+    reqs = [_request(feeds[0])]
+    got_a = _drive(sched, reqs)
+    assert sched.prefix_cache.stats()["entries"] == 1
+
+    n = chaos.corrupt_prefix_cache(sched)
+    assert n == 1
+
+    reqs_b = [_request(feeds[1])]           # same source: would be a hit
+    got_b = _drive(sched, reqs_b)
+    st = sched.prefix_cache.stats()
+    assert st["poisoned"] == 1
+    np.testing.assert_array_equal(got_a[id(reqs[0])]["tokens"],
+                                  got_b[id(reqs_b[0])]["tokens"])
+    np.testing.assert_array_equal(got_a[id(reqs[0])]["scores"],
+                                  got_b[id(reqs_b[0])]["scores"])
+
+
+# ---------------------------------------------------------------------------
+# sessions, swaps, compiles
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_and_cache_keys_scope_to_model_fingerprint(backend):
+    """Hot-swap invalidation at the key level: the draft corpus key and
+    the prefix cache key both embed the model fingerprint, so a new
+    generation can never replay or re-admit the old model's state.
+    ``session_id`` additionally scopes chat turns to their session."""
+    sched = SlotScheduler(backend, slots=2, spec_k=2,
+                          prefix_cache_mb=8.0)
+    req = _request(_feeds(1, 1)[0])
+    k_corpus = sched._corpus_key(req, 0)
+    k_cache = sched._cache_key(req)
+    assert k_corpus and k_cache
+
+    real_fp = backend.fingerprint()
+    try:
+        backend._fingerprint = "other-model-generation"
+        assert sched._corpus_key(req, 0) != k_corpus
+        assert sched._cache_key(req) != k_cache
+    finally:
+        backend._fingerprint = real_fp
+
+    # a session-scoped request keys separately from the same feed
+    # without a session (chat turns never cross sessions)
+    req_sess = _request(_feeds(1, 1)[0])
+    req_sess.session_id = "chat-1"
+    assert sched._corpus_key(req_sess, 0) != k_corpus
+    assert sched._cache_key(req_sess) != k_cache
+
+
+def test_zero_new_compiles_on_warm_spec_path(backend):
+    """After prime_step_programs() + one warm drive, a second drive —
+    gated steps, wide steps, admissions, harvests — must compile ZERO
+    new XLA programs: speculation gating swaps between two already-warm
+    executables, never traces on the hot path."""
+    sched = SlotScheduler(backend, slots=2, spec_k=3,
+                          prefix_cache_mb=8.0)
+    sched.prime_step_programs()
+    _drive(sched, [_request(f) for f in _feeds(4, 2, seed=11)])
+    warm = sched.compiled_programs()
+    _drive(sched, [_request(f) for f in _feeds(4, 2, seed=11)])
+    assert sched.compiled_programs() == warm

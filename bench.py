@@ -1834,6 +1834,99 @@ def bench_publish_reload_ab(rtt, peak):
     }
 
 
+def bench_fleet_isolation_ab(rtt, peak):
+    """A/B the tenancy tier under a noisy neighbor (docs/serving.md
+    "Fleet serving"): a flooding tenant hammers the fleet while a victim
+    tenant streams steady traffic — WITHOUT tenancy (both tenants share
+    one entry's queue raw) vs WITH token-bucket quotas + weighted fair
+    share in front.  ``value`` is the victim's p99 with fair share ON;
+    ``vs_baseline`` the off/on p99 ratio.  Fair share only wins if the
+    victim's p99 improves AND the victim was shed less — quota-rejecting
+    the FLOODER is the mechanism, shedding the victim would be the
+    disease.  ``default_flag`` mirrors --tenant_spec (tenancy is opt-in
+    per deployment)."""
+    import time as _t
+
+    from paddle_tpu.serving.errors import ServingError
+    from paddle_tpu.serving.fleet import ModelFleet
+    from paddle_tpu.serving.tenancy import TenantSpec
+    from paddle_tpu.utils.flags import FLAGS
+
+    def runner(feed, *rest):
+        _t.sleep(0.0015)             # a real forward's worth of service time
+        return {"y": feed["x"] + 1}
+
+    feed = {"x": np.zeros((1, 8), np.float32)}
+    opts = dict(max_batch=1, batch_delay_ms=0.0, max_queue=8,
+                default_deadline_ms=60000.0, restart_backoff_s=0.01)
+    VICTIM_N, FLOOD_PER = 60, 6
+
+    def run_arm(tenants):
+        fleet = ModelFleet(tenants=tenants)
+        try:
+            fleet.add_model("m", runner, server_opts=opts,
+                            warmup_feed=feed)
+            kw_v = {"tenant": "victim"} if tenants else {}
+            kw_f = {"tenant": "flood"} if tenants else {}
+            lat, victim_shed, flood_rejected = [], 0, 0
+            for _ in range(VICTIM_N):
+                flood_futs = []
+                for _ in range(FLOOD_PER):   # the neighbor bursts first
+                    try:
+                        flood_futs.append(
+                            fleet.submit(feed, model="m", **kw_f))
+                    except ServingError:
+                        flood_rejected += 1
+                t0 = _t.perf_counter()
+                try:
+                    fleet.infer(feed, model="m", timeout=60.0, **kw_v)
+                    lat.append(_t.perf_counter() - t0)
+                except ServingError:
+                    victim_shed += 1
+                for f in flood_futs:
+                    try:
+                        f.result(60.0)
+                    except ServingError:
+                        pass
+            lat.sort()
+            p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat \
+                else float("inf")
+            return p99, victim_shed, flood_rejected
+        finally:
+            fleet.close()
+
+    # A) tenancy OFF: the flooder and the victim share the raw entry
+    #    queue — the victim eats queue delay and shed alike
+    p99_off, shed_off, _ = run_arm(None)
+    # B) tenancy ON: the flooder's burst blows its own bucket at
+    #    admission; the victim's lane stays clear
+    p99_on, shed_on, rejected = run_arm(
+        [TenantSpec("victim", weight=3.0, rate=1000.0, burst=100.0),
+         TenantSpec("flood", weight=1.0, rate=50.0, burst=10.0)])
+
+    if rejected and shed_on <= shed_off and p99_on < 0.95 * p99_off:
+        winner = "fair_share"
+    elif p99_on > 1.05 * p99_off or shed_on > shed_off:
+        winner = "no_tenancy"
+    else:
+        winner = "tie"
+    return {
+        "metric": "fleet_isolation_ab_victim_p99_ms(flooding_neighbor)",
+        "short": "fleet_isolation_ab",
+        "value": round(p99_on * 1e3, 3),
+        "unit": "ms",
+        "mfu": None,
+        "vs_baseline": round(p99_off / max(p99_on, 1e-9), 3),
+        "victim_p99_off_ms": round(p99_off * 1e3, 3),
+        "victim_p99_on_ms": round(p99_on * 1e3, 3),
+        "victim_shed_off": shed_off,
+        "victim_shed_on": shed_on,
+        "flood_rejected_on": rejected,
+        "winner": winner,
+        "default_flag": bool(FLAGS.tenant_spec),
+    }
+
+
 # ---------------------------------------------------------------------------
 # --check: regression gate against the newest BENCH_r*.json capture
 # ---------------------------------------------------------------------------
@@ -1864,6 +1957,7 @@ ROWS = {
     "publish_reload_ab": bench_publish_reload_ab,
     "spec_decode_ab": bench_spec_decode_ab,
     "prefix_cache_ab": bench_prefix_cache_ab,
+    "fleet_isolation_ab": bench_fleet_isolation_ab,
 }
 
 

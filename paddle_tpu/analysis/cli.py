@@ -208,6 +208,38 @@ def _audit_serving_bundle(bundle: str) -> List[Finding]:
                     f"{type(e).__name__}: {e}")]
 
 
+def _audit_serving_fleet(bundles: List[str]) -> List[Finding]:
+    """``lint --serve A.ptz --serve B.ptz ...`` with SEVERAL bundles:
+    the fleet preflight.  The bundles are loaded into a model table
+    exactly as ``ModelFleet`` would serve them (one entry per bundle,
+    servers never started) and ``ModelFleet.audit()`` traces the
+    compiled serving closure of EVERY entry — each finding labeled
+    ``fleet:<name>@v<version>``, so one bad entry in a fleet rollout
+    is named, not averaged away.  A bundle that fails to load is an
+    ERROR finding, and the remaining entries are still audited."""
+    from paddle_tpu.serving.fleet import ModelFleet
+
+    fleet = ModelFleet()
+    findings: List[Finding] = []
+    try:
+        for bundle in bundles:
+            name = os.path.splitext(os.path.basename(bundle))[0] or bundle
+            try:
+                from paddle_tpu.config.deploy import load_inference_model
+
+                model = load_inference_model(bundle)
+                fleet.add_model(name, model, start=False)
+            except Exception as e:  # noqa: BLE001 — audit the rest
+                findings.append(Finding(
+                    check="serve-build", severity="ERROR", file=bundle,
+                    message=f"bundle failed to load: "
+                            f"{type(e).__name__}: {e}"))
+        findings.extend(fleet.audit())
+    finally:
+        fleet.close()
+    return findings
+
+
 def _audit_deploy_bundle(bundle: str) -> List[Finding]:
     """``lint --deploy BUNDLE.ptz`` — the offline preflight extended to
     QUANTIZED bundles (docs/deploy.md): the dequantized forward is traced
@@ -324,7 +356,9 @@ def run(argv: Optional[List[str]] = None) -> int:
                    metavar="BUNDLE.ptz",
                    help="serving preflight: audit a deploy bundle's "
                         "serving closure (host-transfer/constant-bloat; "
-                        "repeatable)")
+                        "repeatable — several bundles audit as a FLEET "
+                        "model table, every entry traced and labeled "
+                        "fleet:<name>@v<version>)")
     p.add_argument("--deploy", action="append", default=[],
                    metavar="BUNDLE.ptz",
                    help="deploy preflight incl. QUANTIZED bundles: audit "
@@ -437,8 +471,13 @@ def run(argv: Optional[List[str]] = None) -> int:
         from paddle_tpu.analysis.static import run_hbm
 
         findings.extend(run_hbm())
-    for bundle in ns.serve:
-        findings.extend(_audit_serving_bundle(bundle))
+    if len(ns.serve) > 1:
+        # several bundles = a fleet: every model-table entry's closure
+        # is audited, findings labeled fleet:<name>@v<version>
+        findings.extend(_audit_serving_fleet(ns.serve))
+    else:
+        for bundle in ns.serve:
+            findings.extend(_audit_serving_bundle(bundle))
     if ns.serve or ns.all:
         # --serve also gates the continuous path's fused step (once);
         # --all runs the bundle-independent half even with no bundle

@@ -11,7 +11,8 @@ requests (serving/reload.py).
 
 from paddle_tpu.publish.publisher import (PublishRefused, Publisher,
                                           freshness_from_journal,
-                                          latest_version, list_versions,
+                                          latest_version, list_model_dirs,
+                                          list_versions, model_publish_dir,
                                           publish_cache_dir,
                                           publish_from_checkpoints,
                                           read_version_manifest,
@@ -19,7 +20,8 @@ from paddle_tpu.publish.publisher import (PublishRefused, Publisher,
 
 __all__ = [
     "PublishRefused", "Publisher", "freshness_from_journal",
-    "latest_version", "list_versions", "publish_cache_dir",
+    "latest_version", "list_model_dirs", "list_versions",
+    "model_publish_dir", "publish_cache_dir",
     "publish_from_checkpoints", "read_version_manifest",
     "validate_version", "version_dir",
 ]

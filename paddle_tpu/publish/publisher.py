@@ -39,7 +39,8 @@ import numpy as np
 from paddle_tpu.utils.log import logger
 
 __all__ = ["PublishRefused", "Publisher", "freshness_from_journal",
-           "latest_version", "list_versions", "publish_cache_dir",
+           "latest_version", "list_model_dirs", "list_versions",
+           "model_publish_dir", "publish_cache_dir",
            "publish_from_checkpoints", "read_version_manifest",
            "validate_version", "version_dir"]
 
@@ -88,6 +89,41 @@ def latest_version(publish_dir: str) -> int:
     """Newest published version number, or 0 when none exist."""
     vs = list_versions(publish_dir)
     return vs[-1] if vs else 0
+
+
+#: model names must be safe as directory components AND unambiguous
+#: against version dirs / the shared cache
+_MODEL_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def model_publish_dir(publish_root: str, name: str) -> str:
+    """One model's watch dir under a fleet publish root
+    (``<root>/<name>/v-NNNNN/...``): each fleet model gets its own
+    version sequence, manifest chain, and shared compile cache, so
+    publishing model A can never perturb model B's rollout
+    (docs/serving.md "Fleet serving")."""
+    if not _MODEL_RE.fullmatch(name or "") or _VERSION_RE.fullmatch(name) \
+            or name == CACHE_SUBDIR or name.startswith(_TMP_PREFIX):
+        raise ValueError(f"invalid publish model name {name!r}")
+    return os.path.join(publish_root, name)
+
+
+def list_model_dirs(publish_root: str) -> List[str]:
+    """Model names under a fleet publish root, sorted — a directory
+    counts as a model iff it holds at least one version dir (stray
+    dirs and the flat single-model layout are never misread)."""
+    try:
+        names = os.listdir(publish_root)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in sorted(names):
+        if not _MODEL_RE.fullmatch(n) or _VERSION_RE.fullmatch(n) \
+                or n == CACHE_SUBDIR or n.startswith(_TMP_PREFIX):
+            continue
+        if list_versions(os.path.join(publish_root, n)):
+            out.append(n)
+    return out
 
 
 def read_version_manifest(vdir: str) -> Dict[str, Any]:

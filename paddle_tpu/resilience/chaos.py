@@ -90,6 +90,9 @@ __all__ = [
     "straggler_request",
     "bad_draft",
     "corrupt_prefix_cache",
+    "tenant_flood",
+    "poison_tenant",
+    "kill_canary",
 ]
 
 
@@ -803,6 +806,99 @@ def corrupt_prefix_cache(scheduler, *, key: Optional[str] = None) -> int:
         payload[name] = arr
         n += 1
     return n
+
+
+def tenant_flood(fleet, feed: dict, *, tenant: str,
+                 model: Optional[str] = None, factor: float = 2.5,
+                 requests: Optional[int] = None,
+                 timeout_s: float = 30.0) -> dict:
+    """Flood ONE tenant of a :class:`~paddle_tpu.serving.fleet
+    .ModelFleet` with more than ``factor``× its configured capacity
+    (burst + one second of rate), submitted back-to-back — the noisy-
+    neighbor fault the tenancy tier exists to contain.  The isolation
+    obligation (docs/resilience.md): the flooding tenant's overflow is
+    rejected with a typed ``QuotaExceeded`` naming it (counted here),
+    and EVERY OTHER tenant's traffic is untouched — same replies, same
+    latency guard, zero induced errors (pinned by tests/test_fleet.py).
+    Returns the flood's outcome counts."""
+    from paddle_tpu.serving.errors import QuotaExceeded, ServingError
+
+    spec = fleet.admission.specs[tenant]
+    n = requests if requests is not None else \
+        max(2, int(factor * (spec.burst + spec.rate)))
+    out = {"submitted": n, "completed": 0, "quota_rejected": 0,
+           "fair_share_shed": 0, "other_errors": 0}
+    futs = []
+    for _ in range(n):
+        try:
+            futs.append(fleet.submit(feed, model=model, tenant=tenant))
+        except QuotaExceeded as e:
+            out["fair_share_shed" if e.fair_share
+                else "quota_rejected"] += 1
+        except ServingError:
+            out["other_errors"] += 1
+    for f in futs:
+        try:
+            f.result(timeout_s)
+            out["completed"] += 1
+        except ServingError:
+            out["other_errors"] += 1
+    return out
+
+
+def poison_tenant(fleet, tenant: str):
+    """NaN-poison ONE tenant's traffic: every feed that ``tenant``
+    submits through the fleet is replaced with ``nan_feed`` before
+    admission, all other tenants' feeds pass through untouched — the
+    scoped-numeric-poison fault.  With ``nonfinite='error'`` the entry
+    serving that tenant fails its requests typed (``InferenceFailed``)
+    and trips ITS OWN breaker; entries serving other tenants must keep
+    serving bit-identical outputs (pinned by tests/test_fleet.py).
+    Returns a restore() callable that removes the poison."""
+    orig = fleet.submit
+
+    def poisoned(feed, **kw):
+        if kw.get("tenant") == tenant:
+            feed = nan_feed(feed)
+        return orig(feed, **kw)
+
+    fleet.submit = poisoned
+
+    def restore():
+        fleet.submit = orig
+
+    return restore
+
+
+def kill_canary(fleet, model: str, *, mode: str = "nan"):
+    """Corrupt a model's CANARY mid-rollout: the candidate entry's
+    weights go bad under live traffic — ``mode="nan"`` swaps in a
+    forward that emits NaN (the poisoned-weights model; with
+    ``nonfinite='error'`` every canary request fails typed),
+    ``mode="crash"`` swaps in a forward that raises (the wedged-
+    executable model; trips the canary's breaker).  The rollout
+    obligation: the fleet auto-rolls-back within probation, journaling
+    ``publish_rollback`` naming the entry, while the INCUMBENT arm is
+    never interrupted and no request is silently dropped (pinned by
+    tests/test_fleet.py).  Returns the displaced model."""
+    route = fleet.route(model)
+    if route["candidate"] is None:
+        raise ValueError(f"model {model!r} has no canary/shadow candidate "
+                         f"to kill")
+    entry = fleet.entry(model, route["candidate"])
+    prev = entry.server.model
+
+    def bad_forward(feed, *rest):
+        if mode == "crash":
+            raise RuntimeError("chaos: canary executable wedged")
+        outs = (prev.infer(feed) if hasattr(prev, "infer")
+                else prev(feed, *rest))
+        return {k: (np.full_like(v, np.nan)
+                    if np.asarray(v).dtype.kind == "f" else v)
+                for k, v in outs.items()}
+
+    entry.server.swap_model(bad_forward)
+    return prev
 
 
 def slow_client(feeds: Iterable, *, delay_s: float = 0.05,

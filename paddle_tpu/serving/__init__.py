@@ -26,7 +26,12 @@ package gives the inference tier the same treatment (docs/serving.md):
 - **observability** — rolling p50/p99, queue depth, shed/timeout/breaker
   counters behind ``InferenceServer.healthz()``;
 - **preflight** — the jaxpr auditor's host-transfer/constant-bloat
-  checks over the serving closure at startup (``lint --serve``).
+  checks over the serving closure at startup (``lint --serve``);
+- **fleet** — a model table keyed ``(name, version)`` with the whole
+  stack above instantiated PER ENTRY, multi-tenant token-bucket quotas
+  + weighted fair-share admission (tenancy.py), canary/shadow rollout
+  with per-entry probation and automatic rollback (fleet.py), and a
+  tenant-sharded, health-gated router over N servers (router.py).
 
 Chaos-proven by tests/test_serving.py: worker kill mid-batch, NaN poison
 batches, latency injection, and overload bursts all resolve every request
@@ -35,8 +40,8 @@ with a reply or a typed error.  CLI: ``python -m paddle_tpu serve``.
 
 from paddle_tpu.serving.errors import (CircuitOpenError, DeadlineExceeded,
                                        InferenceFailed, InvalidRequestError,
-                                       ServerClosed, ServingError, ShedError,
-                                       WorkerCrashed)
+                                       QuotaExceeded, ServerClosed,
+                                       ServingError, ShedError, WorkerCrashed)
 from paddle_tpu.serving.batching import (BatchQueue, Request, ServingFuture,
                                          batch_bucket, canonicalize_feed,
                                          merge_feeds, split_outputs)
@@ -48,6 +53,11 @@ from paddle_tpu.serving.preflight import (SERVING_CHECKS, audit_serving,
                                           check_serving)
 from paddle_tpu.serving.slots import (Seq2SeqSlotBackend, SlotBackend,
                                       SlotScheduler, audit_slot_backend)
+from paddle_tpu.serving.tenancy import (TenantAdmission, TenantSpec,
+                                        TokenBucket)
+from paddle_tpu.serving.fleet import ModelFleet, canary_arm
+from paddle_tpu.serving.router import (FleetRouter, RouterDrainingError,
+                                       rendezvous_rank)
 from paddle_tpu.serving import feeds
 
 __all__ = [
@@ -59,6 +69,15 @@ __all__ = [
     "WorkerCrashed",
     "InferenceFailed",
     "ServerClosed",
+    "QuotaExceeded",
+    "TenantSpec",
+    "TokenBucket",
+    "TenantAdmission",
+    "ModelFleet",
+    "canary_arm",
+    "FleetRouter",
+    "RouterDrainingError",
+    "rendezvous_rank",
     "ServingFuture",
     "Request",
     "BatchQueue",

@@ -85,6 +85,8 @@ class Request:
     #                                budget (None = the backend's max_len)
     session_id: Optional[str] = None  # chat session scope for the prefix
     #                                   cache (serving/prefix_cache.py)
+    tenant: Optional[str] = None  # fleet tenancy attribution
+    #                               (serving/fleet.py; None = untenanted)
     # request tracing (obs/trace.py; all None/"" when tracing is off):
     req_id: str = ""              # user-facing id (`obs merge --request=`)
     span: Any = None              # the request trace's root Span

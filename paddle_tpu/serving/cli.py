@@ -5,6 +5,7 @@ Usage:
     python -m paddle_tpu serve --serve_bundle=model.ptz [--serve_* ...]
     python -m paddle_tpu serve --serve_bundle=model.ptz --serve_smoke=16
     python -m paddle_tpu serve --serve_continuous --serve_smoke=16
+    python -m paddle_tpu serve --serve_fleet --serve_smoke=16
 
 Loads a deploy bundle (quantized bundles dequantize on load —
 docs/deploy.md), builds an :class:`InferenceServer` from the
@@ -143,6 +144,113 @@ def _continuous_smoke() -> int:
         return 1 if (failures or dropped) else 0
     finally:
         server.close()
+
+
+def _parse_tenant_spec(s: str):
+    """``--tenant_spec`` grammar: ``name:weight:rate:burst`` entries,
+    comma-separated; trailing fields optional (defaults from TenantSpec).
+    Bad entries are ConfigError — a misconfigured tenant table must
+    never boot into silent starvation."""
+    from paddle_tpu.serving.tenancy import TenantSpec
+    from paddle_tpu.utils.error import ConfigError
+
+    specs = []
+    for item in filter(None, (p.strip() for p in s.split(","))):
+        parts = item.split(":")
+        try:
+            kw = {}
+            if len(parts) > 1:
+                kw["weight"] = float(parts[1])
+            if len(parts) > 2:
+                kw["rate"] = float(parts[2])
+            if len(parts) > 3:
+                kw["burst"] = float(parts[3])
+            specs.append(TenantSpec(parts[0], **kw))
+        except ValueError as e:
+            raise ConfigError(
+                f"--tenant_spec entry {item!r} is not "
+                f"name:weight:rate:burst ({e})") from None
+    return specs
+
+
+def _fleet_smoke() -> int:
+    """The ``--serve_fleet --serve_smoke=N`` CI self-test: two models,
+    two tenants, one deliberate flood.  A 'gold' tenant streams N
+    requests against model A while a 'free' tenant (tiny quota) floods
+    model B past its bucket.  Exits 0 only if BOTH models served, the
+    flood was rejected TYPED (QuotaExceeded observed — quotas are real),
+    and the gold tenant took zero errors (cross-tenant isolation is
+    real).  Pinned by tests/test_cli.py."""
+    import numpy as np
+
+    from paddle_tpu.serving.errors import QuotaExceeded
+    from paddle_tpu.serving.fleet import ModelFleet
+    from paddle_tpu.serving.tenancy import TenantSpec
+    from paddle_tpu.utils import FLAGS, logger
+
+    n = FLAGS.serve_smoke
+    tenants = (_parse_tenant_spec(FLAGS.tenant_spec)
+               if FLAGS.tenant_spec else
+               [TenantSpec("gold", weight=3.0, rate=1000.0, burst=4 * n),
+                TenantSpec("free", weight=1.0, rate=0.5, burst=2.0)])
+    fleet = ModelFleet(
+        tenants=tenants,
+        probation_requests=FLAGS.serve_probation_requests,
+        clock=__import__("time").monotonic)
+    server_opts = dict(max_batch=FLAGS.serve_max_batch,
+                       batch_delay_ms=FLAGS.serve_batch_delay_ms,
+                       max_queue=FLAGS.serve_queue_depth,
+                       default_deadline_ms=FLAGS.serve_deadline_ms,
+                       restart_backoff_s=FLAGS.serve_backoff_s,
+                       nonfinite=FLAGS.serve_nonfinite)
+    feed = {"x": np.ones((1, 4), np.float32)}
+    try:
+        fleet.add_model("add1", lambda f, *r: {"y": f["x"] + 1},
+                        server_opts=server_opts, warmup_feed=feed)
+        fleet.add_model("mul2", lambda f, *r: {"y": f["x"] * 2},
+                        server_opts=server_opts, warmup_feed=feed)
+        gold_name, free_name = tenants[0].name, tenants[-1].name
+        gold_errors = quota_rejections = served_a = served_b = 0
+        for i in range(n):
+            try:
+                out = fleet.infer(feed, model="add1", tenant=gold_name,
+                                  timeout=30.0)
+                if np.allclose(out["y"], 2.0):
+                    served_a += 1
+            except Exception as e:  # noqa: BLE001 — every error indicts
+                gold_errors += 1
+                logger.warning("fleet smoke gold request %d failed: %s",
+                               i, e)
+            # the free tenant floods: 3 submits per gold request blows
+            # its 2-token bucket — overflow must come back typed
+            for _ in range(3):
+                try:
+                    out = fleet.infer(feed, model="mul2", tenant=free_name,
+                                      timeout=30.0)
+                    if np.allclose(out["y"], 2.0):
+                        served_b += 1
+                except QuotaExceeded:
+                    quota_rejections += 1
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("fleet smoke free request failed: %s", e)
+        hz = fleet.healthz()
+        print(json.dumps(hz, default=str))
+        problems = []
+        if not served_a:
+            problems.append("model add1 never served its tenant")
+        if not served_b:
+            problems.append("model mul2 never served its tenant")
+        if not quota_rejections:
+            problems.append("the flood was never quota-rejected — "
+                            "tenancy is not enforcing")
+        if gold_errors:
+            problems.append(f"gold tenant took {gold_errors} error(s) "
+                            f"from the free tenant's flood")
+        for p in problems:
+            logger.error("fleet smoke: %s", p)
+        return 1 if problems else 0
+    finally:
+        fleet.close()
 
 
 def _build_server(model):
@@ -339,6 +447,14 @@ def run(argv: Optional[List[str]] = None) -> int:
     from paddle_tpu.obs import ensure_metrics_server
 
     ensure_metrics_server()
+    if FLAGS.serve_fleet:
+        if FLAGS.serve_smoke <= 0:
+            raise ConfigError(
+                "serve: --serve_fleet is a smoke-only CLI surface "
+                "(pass --serve_smoke=N); production fleets build "
+                "ModelFleet/FleetRouter in-process — docs/serving.md "
+                "'Fleet serving'")
+        return _fleet_smoke()
     if FLAGS.serve_watch:
         # continuous publishing consumer (docs/publish.md): smoke mode is
         # the CI self-test of the whole train->publish->reload loop

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 __all__ = ["ServingError", "InvalidRequestError", "ShedError",
            "DeadlineExceeded", "CircuitOpenError", "WorkerCrashed",
-           "InferenceFailed", "ServerClosed"]
+           "InferenceFailed", "ServerClosed", "QuotaExceeded"]
 
 
 class ServingError(RuntimeError):
@@ -44,6 +44,23 @@ class DeadlineExceeded(ServingError):
     estimated_service_time`` already exceeds the deadline (infeasible —
     rejected before queuing), or delivered as the reply when the deadline
     expired while queued or in flight."""
+
+
+class QuotaExceeded(ServingError):
+    """The tenancy tier rejected the request: the tenant's own
+    token-bucket quota is exhausted, or — under aggregate contention —
+    the tenant is past its weighted fair share (``fair_share=True``).
+    Like :class:`ShedError` it is a load condition, not a model failure;
+    unlike a shed it names exactly ONE tenant, so a flooding tenant can
+    never read as a whole-fleet incident.  ``tenant`` carries the name;
+    a tenant at its quota gets this error, never silent starvation of
+    others (docs/serving.md "Fleet serving")."""
+
+    def __init__(self, message: str, *, tenant: str = "",
+                 fair_share: bool = False) -> None:
+        super().__init__(message)
+        self.tenant = tenant
+        self.fair_share = fair_share
 
 
 class CircuitOpenError(ServingError):

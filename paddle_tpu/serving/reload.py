@@ -38,7 +38,27 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from paddle_tpu.utils.log import logger
 
-__all__ = ["HotSwapManager", "load_published"]
+__all__ = ["HotSwapManager", "error_baseline", "load_published"]
+
+
+def error_baseline(server) -> Dict[str, float]:
+    """Snapshot one server's error counters as a probation baseline.
+
+    Shared by the single-server :class:`HotSwapManager` probation and
+    the per-entry fleet probation (serving/fleet.py): each candidate is
+    judged against a baseline captured at ITS swap/rollout moment, so a
+    fleet of entries can run independent probation windows."""
+    m = server.metrics
+    baseline = {
+        "completed": m.count("completed"),
+        "inference_failed": m.count("inference_failed"),
+        "worker_crashed": m.count("worker_crashed"),
+        "breaker_trips": server.breaker.trips,
+    }
+    done = baseline["completed"] + baseline["inference_failed"]
+    baseline["error_rate"] = (baseline["inference_failed"] / done
+                              if done else 0.0)
+    return baseline
 
 
 def _version_info(model, manifest: Dict[str, Any], vdir: str) -> dict:
@@ -226,16 +246,7 @@ class HotSwapManager:
             stop = getattr(self.table_reader, "last_stop", None)
             if stop is not None:
                 return self._refuse(v, "table_reload_stalled", str(stop))
-        m = self.server.metrics
-        baseline = {
-            "completed": m.count("completed"),
-            "inference_failed": m.count("inference_failed"),
-            "worker_crashed": m.count("worker_crashed"),
-            "breaker_trips": self.server.breaker.trips,
-        }
-        done = baseline["completed"] + baseline["inference_failed"]
-        baseline["error_rate"] = (baseline["inference_failed"] / done
-                                  if done else 0.0)
+        baseline = error_baseline(self.server)
         prev_info = self.server._model_info
         info = _version_info(model, manifest, vdir)
         prev_model = self.server.swap_model(model, info=info)

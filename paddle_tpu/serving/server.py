@@ -558,7 +558,9 @@ class InferenceServer:
     def submit(self, feed: Dict[str, Any],
                deadline_ms: Optional[float] = None,
                max_len: Optional[int] = None,
-               session_id: Optional[str] = None) -> ServingFuture:
+               session_id: Optional[str] = None,
+               trace_attrs: Optional[Dict[str, Any]] = None
+               ) -> ServingFuture:
         """Admit one request (a dict feed with a leading batch dim on
         every part) or raise a typed rejection immediately.  Returns a
         :class:`ServingFuture` that is *guaranteed* to resolve.
@@ -583,6 +585,12 @@ class InferenceServer:
         rid = f"req-{os.getpid()}-{next(_REQ_SEQ):06d}"
         t0 = time.time()
         root = tracer.start_trace("request", request=rid, mode=self.mode)
+        if trace_attrs:
+            # fleet routing identity (tenant / model / version —
+            # serving/fleet.py): attached BEFORE _submit so even a typed
+            # rejection's trace names who was rejected, and before
+            # offer() so the worker can never flush an unlabeled root
+            root.set(**trace_attrs)
         try:
             fut = self._submit(feed, deadline_ms, max_len, session_id,
                                root, rid, t0)

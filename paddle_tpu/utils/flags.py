@@ -365,6 +365,42 @@ define_flag("slot_page_pool", 0.0, "serving: host MiB budget for paged "
             "carries are host-evicted and later restored bit-for-bit, so "
             "capacity stops being bounded by HBM (0 = off; "
             "docs/serving.md)", validator=lambda v: v >= 0.0)
+define_flag("serve_fleet", False, "serving CLI: multi-model fleet mode — "
+            "a model table keyed (name, version) with the whole "
+            "breaker/ladder/warmup stack instantiated per entry, tenant "
+            "quotas + weighted fair share in front, canary/shadow rollout "
+            "with per-entry auto-rollback (docs/serving.md 'Fleet "
+            "serving'); with --serve_smoke=N runs the two-model "
+            "two-tenant isolation self-test")
+define_flag("serve_canary_pct", 0.0, "fleet: percentage of a model's "
+            "traffic routed to its canary candidate over the "
+            "deterministic hash-of-request split (same request key -> "
+            "same arm across retries)",
+            validator=lambda v: 0.0 <= v <= 100.0)
+define_flag("serve_probation_requests", 32, "fleet: resolved requests a "
+            "canary must serve cleanly before it is promoted to "
+            "incumbent; a breaker trip or error-rate regression inside "
+            "the window auto-rolls it back (journaled publish_rollback "
+            "naming the entry)", validator=lambda v: v >= 1)
+define_flag("serve_shadow", False, "fleet: mirror traffic to the rollout "
+            "candidate while every reply still comes from the incumbent; "
+            "output divergence is counted and journaled "
+            "(shadow_divergence), never served")
+define_flag("tenant_spec", "", "fleet tenancy: comma-separated "
+            "'name:weight:rate:burst' tenant contracts, e.g. "
+            "'gold:3:100:20,free:1:10:5' — weight shares the fleet under "
+            "contention, rate/burst bound the tenant's own token bucket "
+            "(empty = untenanted); a zero weight is refused typed at "
+            "construction")
+define_flag("tenant_capacity_rate", 0.0, "fleet tenancy: aggregate "
+            "requests/s the fleet admits before weighted fair-share "
+            "shedding kicks in (0 = the sum of tenant rates)",
+            validator=lambda v: v >= 0.0)
+define_flag("tenant_credit", 1.0, "fleet tenancy: fair-queuing slack in "
+            "weighted request units a tenant may run ahead of the global "
+            "virtual clock before it is shed "
+            "(QuotaExceeded(fair_share=True))",
+            validator=lambda v: v > 0.0)
 
 # Deterministic sharded data pipeline (paddle_tpu/datapipe; docs/data.md)
 define_flag("data_pack", False, "sequence packing: several short "

@@ -53,6 +53,7 @@ _UNITS = [
     ("publish_reload_ab", "s (hot-swap to ready; vs = ×restart)"),
     ("spec_decode_ab", "tok/s (speculative; vs = ×plain)"),
     ("prefix_cache_ab", "tok/s (cache on; vs = ×off)"),
+    ("fleet_isolation_ab", "ms (victim p99, fair share on; vs = ×off)"),
 ]
 
 

@@ -27,7 +27,10 @@ def small_mnist(monkeypatch):
              "serve_smoke", "serve_max_batch", "serve_deadline_ms",
              "serve_preflight", "serve_continuous", "serve_slots",
              "compile_cache_dir", "deploy_quantize", "serve_watch",
-             "publish_dir", "publish_every", "reload_probation")}
+             "publish_dir", "publish_every", "reload_probation",
+             "serve_fleet", "serve_canary_pct", "serve_probation_requests",
+             "serve_shadow", "tenant_spec", "tenant_capacity_rate",
+             "tenant_credit")}
     yield
     for k, v in keep.items():
         setattr(FLAGS, k, v)
@@ -335,6 +338,26 @@ def test_cli_lint_serve_preflight(tmp_path, capsys):
     assert "serve-build" in capsys.readouterr().out
 
 
+def test_cli_lint_serve_fleet_multi_bundle(tmp_path, capsys):
+    """`lint --serve A.ptz --serve B.ptz`: several bundles audit as a
+    FLEET model table — every entry's closure traced; one corrupt entry
+    fails the run with a finding naming ITS bundle, while the healthy
+    entries are still audited."""
+    import shutil
+
+    bundle = _serve_bundle(tmp_path)
+    a, b = str(tmp_path / "ranker.ptz"), str(tmp_path / "scorer.ptz")
+    shutil.copy(bundle, a)
+    shutil.copy(bundle, b)
+    assert main(["lint", "--serve", a, "--serve", b]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "broken.ptz"
+    bad.write_bytes(b"garbage")
+    assert main(["lint", "--serve", a, "--serve", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "serve-build" in out and "broken" in out
+
+
 def test_cli_help_lists_serve_flags(capsys):
     """The serve subcommand's knobs ride the registered flag table —
     including `serve --help` itself (the advertised invocation must print
@@ -348,6 +371,51 @@ def test_cli_help_lists_serve_flags(capsys):
                  "--serve_deadline_ms", "--serve_breaker_threshold",
                  "--serve_preflight", "--serve_smoke", "--serve_continuous",
                  "--serve_slots"):
+        assert flag in out, flag
+
+
+def test_cli_serve_fleet_smoke_two_models_two_tenants(capsys):
+    """`serve --serve_fleet --serve_smoke=N`: the two-model two-tenant
+    CI self-test — a gold tenant streams against one model while a free
+    tenant floods the other past its quota.  Exit 0 requires both models
+    served, the flood rejected TYPED, and zero cross-tenant errors; the
+    printed healthz carries the per-entry models table and the
+    per-tenant quota counters."""
+    import json
+
+    rc = main(["serve", "--serve_fleet", "--serve_smoke=4",
+               "--serve_deadline_ms=60000"])
+    assert rc == 0
+    hz = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert hz["ready"] is True
+    assert set(hz["models"]) == {"add1@v1", "mul2@v1"}
+    assert hz["models"]["add1@v1"]["state"] == "serving"
+    assert hz["routes"]["add1"]["incumbent"] == 1
+    assert hz["tenants"]["gold"]["admitted"] >= 4
+    assert hz["tenants"]["gold"]["quota_rejected"] == 0
+    assert hz["tenants"]["free"]["quota_rejected"] > 0
+
+
+def test_cli_serve_fleet_requires_smoke():
+    """--serve_fleet without --serve_smoke must fail fast with the
+    pointer to the in-process API, never half-serve."""
+    with pytest.raises(ConfigError, match="serve_fleet|smoke"):
+        main(["serve", "--serve_fleet"])
+
+
+def test_cli_serve_fleet_rejects_malformed_tenant_spec():
+    with pytest.raises(ConfigError, match="tenant_spec"):
+        main(["serve", "--serve_fleet", "--serve_smoke=1",
+              "--tenant_spec=gold:notanumber"])
+
+
+def test_cli_help_lists_fleet_flags(capsys):
+    """The fleet/tenancy knobs ride the auto-generated flag table."""
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    for flag in ("--serve_fleet", "--serve_canary_pct", "--serve_shadow",
+                 "--serve_probation_requests", "--tenant_spec",
+                 "--tenant_capacity_rate", "--tenant_credit"):
         assert flag in out, flag
 
 

@@ -704,3 +704,24 @@ def test_healthz_model_block_schema_pinned(tmp_path, rng):
             if s["labels"]["server"] == srv.metrics._label]
     assert vals and vals[0] >= 12.5
     srv.metrics.unregister()
+
+
+def test_fleet_healthz_keeps_model_block_schema_compatible():
+    """Regression pin (docs/serving.md "Fleet serving"): a ModelFleet
+    grows a per-entry ``models`` table, but its ``healthz()`` still
+    carries the single-model ``model`` block with EXACTLY the keys the
+    single-server surface pins above — a dashboard built against
+    ``InferenceServer.healthz()`` reads a fleet unchanged."""
+    from paddle_tpu.serving import ModelFleet
+
+    with ModelFleet() as fleet:
+        fleet.add_model(
+            "m", _echo_model(),
+            info={"bundle": "/pub/m/v-00003/model.ptz", "version": 3,
+                  "fingerprint": "abc123", "quantize": None},
+            server_opts=dict(max_batch=2, max_queue=8))
+        h = fleet.healthz()
+        assert set(h["models"]) == {"m@v1"}
+        assert set(h["model"]) == {"bundle", "version", "fingerprint",
+                                   "quantize", "loaded_at", "freshness_s"}
+        assert h["model"]["version"] == 3

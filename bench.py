@@ -1927,6 +1927,94 @@ def bench_fleet_isolation_ab(rtt, peak):
     }
 
 
+def bench_dcn_hierarchy_ab(rtt, peak):
+    """A/B the hierarchical gradient allreduce
+    (paddle_tpu/parallel/hierarchical.py, ``--dcn_axis``) vs the flat
+    single-axis psum on a 2-pod virtual mesh: flat reduces the FULL
+    gradient over every device pair — each pod's whole payload crosses
+    DCN — while the hierarchical form reduce-scatters over ICI first, so
+    only 1/ici_size of the payload rides the expensive tier (then one
+    ICI all-gather).  Same payload, outputs asserted close before
+    timing; ``vs_baseline`` = flat_ms / hier_ms (>1 = hierarchical
+    faster).  NOTE a CPU/single-host virtual mesh prices both tiers
+    identically (in-process memcpys), so this row UNDERSELLS the
+    hierarchy — the delta it exists to price is the ICI/DCN bandwidth
+    ratio; judge the winner from a real multi-pod TPU capture and keep
+    the flag decision there."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import compat
+    from paddle_tpu.parallel.hierarchical import hierarchical_psum
+    from paddle_tpu.utils.devices import make_mesh
+    from paddle_tpu.utils.flags import FLAGS
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        raise RuntimeError(
+            "dcn_hierarchy_ab needs >= 2 devices for a 2-pod mesh")
+    m, k = 2, n_dev // 2
+    mesh = make_mesh((m, k), ("dcn", "data"))
+    rs = np.random.RandomState(0)
+    grads = [jnp.asarray(rs.randn(*s).astype(np.float32))
+             for s in ((1024, 512), (512, 512), (1 << 20,), (512,))]
+
+    def flat_body(*gs):
+        return tuple(lax.psum(g, ("dcn", "data")) for g in gs)
+
+    def hier_body(*gs):
+        return tuple(
+            hierarchical_psum(g, "data", "dcn", ici_size=k, dcn_size=m)
+            for g in gs)
+
+    specs = tuple(P() for _ in grads)
+    flat_fn = jax.jit(compat.shard_map(
+        flat_body, mesh=mesh, in_specs=specs, out_specs=specs,
+        check_vma=False))
+    hier_fn = jax.jit(compat.shard_map(
+        hier_body, mesh=mesh, in_specs=specs, out_specs=specs,
+        check_vma=False))
+
+    ref = jax.block_until_ready(flat_fn(*grads))
+    out = jax.block_until_ready(hier_fn(*grads))
+    for a, b in zip(ref, out):
+        assert np.allclose(np.asarray(a), np.asarray(b),
+                           rtol=1e-5, atol=1e-5)
+
+    def timeit(fn, reps=20):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*grads))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    flat_s = timeit(flat_fn)
+    hier_s = timeit(hier_fn)
+    if hier_s < 0.95 * flat_s:
+        winner = "hierarchical"
+    elif flat_s < 0.95 * hier_s:
+        winner = "flat"
+    else:
+        winner = "tie"
+    nbytes = sum(int(g.size) * 4 for g in grads)
+    return {
+        "metric": f"dcn_hierarchy_ab_ms({nbytes >> 20}MiB,pods{m}x{k})",
+        "short": "dcn_hierarchy_ab",
+        "value": round(hier_s * 1e3, 3),
+        "unit": "ms",
+        "mfu": None,
+        "vs_baseline": round(flat_s / hier_s, 3),
+        "flat_ms": round(flat_s * 1e3, 3),
+        "winner": winner,
+        "default_flag": bool(FLAGS.dcn_axis),
+    }
+
+
 # ---------------------------------------------------------------------------
 # --check: regression gate against the newest BENCH_r*.json capture
 # ---------------------------------------------------------------------------
@@ -1958,6 +2046,7 @@ ROWS = {
     "spec_decode_ab": bench_spec_decode_ab,
     "prefix_cache_ab": bench_prefix_cache_ab,
     "fleet_isolation_ab": bench_fleet_isolation_ab,
+    "dcn_hierarchy_ab": bench_dcn_hierarchy_ab,
 }
 
 
@@ -2166,6 +2255,7 @@ def main(argv=None) -> int:
         safe(bench_publish_reload_ab),
         safe(bench_spec_decode_ab),
         safe(bench_prefix_cache_ab),
+        safe(bench_dcn_hierarchy_ab),
     ]
     # the driver's capture keeps only the TAIL of this line — repeat the
     # headline as the final extra row so truncation can never lose it

@@ -15,7 +15,14 @@ were fixed by hand and are exactly the shapes this pass detects:
 - **exception edge** (``protocol-exception``): an ``except`` handler that
   swallows (never re-raises) around — or returning past — collectives its
   peers still block on: the abandoned-worker commit shape (PR 6), one
-  rank silently leaving the protocol mid-step.
+  rank silently leaving the protocol mid-step;
+- **two-level inversion** (``protocol-pod-order``, ERROR): a function
+  that introduces a pod-LOCAL rendezvous (``pod_barrier``) reaches a
+  GLOBAL collective before it.  The two-tier protocol (multi-pod elastic
+  resize, ``_gang_resize``) must settle the cheap tier first — drain
+  pod-local traffic, then commit globally — or a pod whose members are
+  split across the two tiers deadlocks against the other pods' global
+  barrier.
 
 The checker parses the protocol modules (trainer, cluster, checkpoint_io,
 integrity by default), builds a call graph (``self.m()`` within a class,
@@ -45,13 +52,14 @@ from paddle_tpu.analysis.findings import (Finding, line_suppressions,
                                           suppressed)
 
 __all__ = ["run_protocol", "scan_modules", "DEFAULT_PROTOCOL_TARGETS",
-           "COLLECTIVES"]
+           "COLLECTIVES", "POD_LOCAL"]
 
 DEFAULT_PROTOCOL_TARGETS = (
     "trainer/trainer.py",
     "resilience/cluster.py",
     "resilience/checkpoint_io.py",
     "resilience/integrity.py",
+    "resilience/dcn.py",
 )
 
 #: blocking collective ops every rank must reach together.  One-sided ops
@@ -61,7 +69,12 @@ DEFAULT_PROTOCOL_TARGETS = (
 COLLECTIVES = frozenset({
     "barrier", "exchange_json", "broadcast_json", "allgather",
     "all_gather", "process_allgather", "broadcast_one_to_all",
+    "pod_barrier",
 })
+
+#: the pod-LOCAL tier of the two-level protocol; every other collective
+#: is global.  ``protocol-pod-order`` pins local-before-global.
+POD_LOCAL = frozenset({"pod_barrier"})
 
 # no \b guards: 'is_coordinator' / 'local_rank' must match, and an
 # underscore is a word character, so word boundaries would miss them
@@ -304,6 +317,39 @@ class _Checker:
             ops.extend(self._expr_ops(s, mod, cls))
         return ops, False
 
+    def check_pod_order(self) -> None:
+        """``protocol-pod-order``: in any function that DIRECTLY calls a
+        pod-local collective (note == "" — inlined callee ops do not make
+        a caller part of the two-level sequence), no GLOBAL collective
+        may precede it.  The two-tier resize protocol settles the pod
+        tier first; a global barrier reached earlier on the same path
+        deadlocks pods whose members are split across the tiers."""
+        by_path = {mod.path: mod for mod in self.modules}
+        for (path, _cls, name), ops in sorted(
+                self._summaries.items(),
+                key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2])):
+            mod = by_path.get(path)
+            if mod is None:
+                continue
+            first_local = next(
+                (i for i, (op, _ln, note) in enumerate(ops)
+                 if op in POD_LOCAL and not note), None)
+            if first_local is None:
+                continue
+            before = [op for op in ops[:first_local]
+                      if op[0] not in POD_LOCAL]
+            lcl = ops[first_local]
+            if before and not suppressed(
+                    "protocol-pod-order", lcl[1], mod.sup, mod.func_ranges):
+                self.findings.append(Finding(
+                    check="protocol-pod-order", severity="ERROR",
+                    file=path, line=lcl[1],
+                    message=f"{name}() reaches the GLOBAL collective "
+                    f"{before[0][0]} (line {before[0][1]}) before the "
+                    f"pod-LOCAL {lcl[0]} — the two-level protocol must "
+                    f"drain the pod tier first, then commit globally, or "
+                    f"a pod split across the tiers deadlocks the gang"))
+
     def _compare(self, node: ast.If, side_a: List[_Op], side_b: List[_Op],
                  mod: _Module) -> None:
         a, b = _first_order(side_a), _first_order(side_b)
@@ -385,6 +431,7 @@ def scan_modules(paths: Sequence[str]) -> List[Finding]:
         for cls, meths in mod.classes.items():
             for m in meths:
                 checker.summary(mod, cls, m)
+    checker.check_pod_order()
     findings.extend(checker.findings)
     return findings
 

@@ -60,6 +60,7 @@ DEFAULT_RACE_TARGETS = (
     "obs/journal.py",
     "obs/trace.py",
     "resilience/cluster.py",
+    "resilience/dcn.py",
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",

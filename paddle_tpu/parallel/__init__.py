@@ -24,6 +24,10 @@ from paddle_tpu.parallel.sharding import (
     P,
 )
 from paddle_tpu.parallel.api import make_parallel_train_step, shard_batch
+from paddle_tpu.parallel.hierarchical import (hierarchical_psum,
+                                              hierarchical_psum_compressed,
+                                              init_dcn_residuals,
+                                              make_hierarchical_train_step)
 from paddle_tpu.parallel.pipeline import (
     stack_stage_params,
     shard_stage_params,

@@ -84,7 +84,24 @@ def make_parallel_train_step(
     with ``shard_params(mesh, params, rules)`` and the batch with
     ``shard_batch`` — jit then infers all collectives (grad all-reduce over
     'data', activation collectives over 'model') from the operand shardings.
+
+    A ``MeshConfig`` that binds a ``dcn_axis`` (``--dcn_axis``) routes the
+    pure data-parallel case (``rules is None``) through the two-level
+    ICI-reduce-scatter / DCN-allreduce / ICI-allgather schedule
+    (``parallel/hierarchical.py``) — same signature, same sum (bit-equal
+    to flat on a single pod).  The bf16-compressed DCN variant changes
+    the signature (it threads error-feedback residuals), so it is only
+    available via ``make_hierarchical_train_step`` directly.
     """
+    from paddle_tpu.parallel.mesh import MeshConfig
+
+    if (rules is None and isinstance(mesh, MeshConfig) and mesh.dcn_axis
+            and mesh.dcn_axis in mesh.shape):
+        from paddle_tpu.parallel.hierarchical import \
+            make_hierarchical_train_step
+
+        return make_hierarchical_train_step(loss_fn, optimizer, mesh,
+                                            compress=False, donate=donate)
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

@@ -7,13 +7,17 @@ launcher is the TPU runtime itself: every host runs the same program,
 ``jax.distributed.initialize`` wires the DCN control plane, and
 ``jax.devices()`` becomes the global chip list.
 
-Failure model: no elastic scale-up, but recovery is AUTOMATIC — the gang
-supervisor (``paddle_tpu.resilience.cluster.GangSupervisor``; docs/
-resilience.md "Multi-host recovery") detects rank death and heartbeat
-stalls, kills the whole gang, and relaunches it with the same world size;
-the relaunched ranks call ``shutdown_distributed``-fresh
+Failure model: recovery is AUTOMATIC — the gang supervisor
+(``paddle_tpu.resilience.cluster.GangSupervisor``; docs/resilience.md
+"Multi-host recovery") detects rank death and heartbeat stalls and heals
+by elastic shrink/grow (whole-gang relaunch is the fallback); the
+relaunched ranks call ``shutdown_distributed``-fresh
 ``initialize_distributed`` and resume from the newest gang-consistent
 checkpoint via ``--resume=auto`` (rank-0 publish + all-ranks barrier).
+With ``--dcn_axis`` bound the POD (one ICI domain) is the failure unit:
+the world shrinks/grows by whole pods, gradient reduction goes
+hierarchical (``parallel/hierarchical.py``), and cross-pod exchanges ride
+the partition-tolerant DCN transport (``resilience/dcn.py``).
 """
 
 from __future__ import annotations

@@ -61,6 +61,13 @@ class MeshConfig:
     #: the axis elastic resize rescales (host loss shrinks the world along
     #: this axis; grow-back restores it).  Defaults to ``data_axis``.
     elastic_axis: Optional[str] = None
+    #: the DCN-crossing (pod-boundary) axis.  Non-None makes the POD the
+    #: failure unit: ``fit_world`` shrinks/grows this axis by whole pods,
+    #: gradient allreduce goes hierarchical (parallel/hierarchical.py),
+    #: and the pserver a2a routes in two hops.  Keep it FIRST in ``axes``
+    #: so pods are contiguous rank blocks (the docstring's multi-slice
+    #: device-assignment rule).
+    dcn_axis: Optional[str] = None
 
     def __post_init__(self):
         object.__setattr__(self, "axes",
@@ -115,7 +122,8 @@ class MeshConfig:
 
         cfg = cls.named(_parse_mesh_shape(FLAGS.mesh_shape, n_devices),
                         FLAGS.mesh_axes.split(","))
-        return replace(cfg, pserver_axis=FLAGS.pserver_axis)
+        return replace(cfg, pserver_axis=FLAGS.pserver_axis,
+                       dcn_axis=FLAGS.dcn_axis or None)
 
     @classmethod
     def from_mesh(cls, mesh) -> "MeshConfig":
@@ -141,6 +149,28 @@ class MeshConfig:
         """Size of axis ``name``; 1 when the axis is absent (a missing
         axis IS a size-1 axis for every sharding purpose)."""
         return self.shape.get(name, 1)
+
+    # -- pod (DCN) topology ----------------------------------------------
+
+    @property
+    def dcn_size(self) -> int:
+        """Number of pods (size of the dcn axis; 1 when no dcn axis is
+        bound — a single-pod world IS a dcn_size-1 world)."""
+        return self.axis_size(self.dcn_axis) if self.dcn_axis else 1
+
+    @property
+    def pod_size(self) -> int:
+        """Ranks/devices per pod: everything that is NOT the dcn axis."""
+        return self.size // self.dcn_size
+
+    def pod_of(self, rank: int) -> int:
+        """Pod index of ``rank``.  Pods are contiguous rank blocks — the
+        dcn axis is first in ``axes`` (device-assignment order), so rank
+        ``r`` lives in pod ``r // pod_size``."""
+        if not 0 <= rank < self.size:
+            raise ConfigError(f"rank {rank} outside mesh of size "
+                              f"{self.size}")
+        return rank // self.pod_size
 
     def role_axis(self, role: str) -> str:
         """Axis name bound to ``role`` ('data'|'model'|'pipe'|'seq'|
@@ -169,8 +199,15 @@ class MeshConfig:
         """Rescale the ELASTIC axis so the mesh fits ``n_devices``: the
         other axes are fixed (model/pipe shards are topology, not
         capacity), the elastic axis becomes ``n_devices // prod(others)``.
-        This is the one-call shrink/grow of elastic gang recovery."""
-        el = self.elastic_axis or self.data_axis
+        This is the one-call shrink/grow of elastic gang recovery.
+
+        With a ``dcn_axis`` bound, the DCN axis is the elastic one — the
+        failure unit is the POD, so the world shrinks/grows by whole pods
+        (``n_devices // pod_size`` pods survive; a partial pod's stragglers
+        are dropped with their pod, never resharded across pods)."""
+        el = (self.dcn_axis if self.dcn_axis and
+              self.dcn_axis in self.shape else
+              self.elastic_axis or self.data_axis)
         others = math.prod(s for n, s in self.axes if n != el)
         new = n_devices // others
         if new < 1:
@@ -209,6 +246,7 @@ class MeshConfig:
             "seq_axis": self.seq_axis,
             "pserver_axis": self.pserver_axis,
             "elastic_axis": self.elastic_axis,
+            "dcn_axis": self.dcn_axis,
         }
 
     @classmethod
@@ -219,7 +257,8 @@ class MeshConfig:
                    pipe_axis=d.get("pipe_axis", "stage"),
                    seq_axis=d.get("seq_axis", "seq"),
                    pserver_axis=d.get("pserver_axis"),
-                   elastic_axis=d.get("elastic_axis"))
+                   elastic_axis=d.get("elastic_axis"),
+                   dcn_axis=d.get("dcn_axis"))
 
     def __repr__(self) -> str:
         body = ",".join(f"{n}={s}" for n, s in self.axes)

@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel import compat
-from paddle_tpu.pserver.lookup import bucket_by_owner
+from paddle_tpu.pserver.lookup import _bucket_by_key, bucket_by_owner
 
 __all__ = ["sharded_row_update"]
 
@@ -72,18 +72,66 @@ def _push_apply_body(opt, shard, slot_leaves, dirty, ids, rows, lr_eff,
             *jax.tree_util.tree_leaves(new_slots))
 
 
+def _push_apply_body2(opt, shard, slot_leaves, dirty, ids, rows, lr_eff,
+                      step, *, dcn: str, axis: str, m: int, k: int,
+                      decay: float, slot_treedef):
+    """Two-level push twin of ``_push_apply_body`` for a multi-pod mesh
+    (same routing as ``lookup._a2a2_body``): each (id, row-grad) segment
+    hops over ICI to the owner's column, then over DCN to the owner's
+    pod — the expensive tier carries each segment once, column-
+    aggregated.  A push has no return path, so it is exactly the two
+    forward hops."""
+    p = lax.axis_index(dcn)
+    c = lax.axis_index(axis)
+    n = m * k
+    g = p * k + c
+    vs, d = shard.shape
+    per = ids.shape[0] // n
+    my_ids = lax.dynamic_slice(ids, (g * per,), (per,))
+    my_rows = lax.dynamic_slice(rows, (g * per, 0), (per, d))
+    sentinel = n * vs
+    og1 = jnp.clip(my_ids // vs, 0, n - 1)
+    b1, order1, col1, pos1 = _bucket_by_key(my_ids, og1 % k, k, sentinel)
+    p1 = jnp.zeros((k, per, d), rows.dtype)
+    p1 = p1.at[col1, pos1].set(my_rows[order1])
+    ids1 = lax.all_to_all(b1, axis, 0, 0).reshape(-1)
+    rows1 = lax.all_to_all(p1, axis, 0, 0).reshape(-1, d)
+    og2 = jnp.clip(ids1 // vs, 0, n - 1)
+    b2, order2, pod2, pos2 = _bucket_by_key(ids1, og2 // k, m, sentinel)
+    p2 = jnp.zeros((m, k * per, d), rows.dtype)
+    p2 = p2.at[pod2, pos2].set(rows1[order2])
+    recv_ids = lax.all_to_all(b2, dcn, 0, 0).reshape(-1)
+    recv_rows = lax.all_to_all(p2, dcn, 0, 0).reshape(-1, d)
+    local = recv_ids - g * vs
+    local = jnp.where((local >= 0) & (local < vs), local, vs)
+    slots = jax.tree_util.tree_unflatten(slot_treedef, slot_leaves)
+    new_shard, new_slots = opt.sparse_apply_rows(
+        shard, local, recv_rows, slots, lr_eff=lr_eff, step=step,
+        decay=decay)
+    touched = (local < vs) & jnp.any(recv_rows != 0, axis=1)
+    safe = jnp.where(touched, local, vs)       # untouched -> OOB, dropped
+    new_dirty = dirty.at[safe].set(True, mode="drop")
+    return (new_shard, new_dirty,
+            *jax.tree_util.tree_leaves(new_slots))
+
+
 def sharded_row_update(mesh, opt, table, slots, dirty, ids, row_grads, *,
                        axis: str = "model", lr_eff, step,
-                       decay: float = 0.0) -> Tuple[Any, Any, Any]:
+                       decay: float = 0.0,
+                       dcn_axis: str = None) -> Tuple[Any, Any, Any]:
     """Apply (ids, row-grads) segments to a sharded table.
 
     ``table``: [V_pad, D] sharded ``P(axis, None)``; ``slots``: the
     optimizer slot pytree for this table (table-shaped leaves sharded like
     the table); ``dirty``: bool [V_pad] sharded ``P(axis)``; ``ids``
     [N] int (global row ids; sentinels >= V_pad allowed), ``row_grads``
-    [N, D].  Returns ``(new_table, new_slots, new_dirty)``.
+    [N, D].  Returns ``(new_table, new_slots, new_dirty)``.  ``dcn_axis``
+    shards the table over ``(dcn_axis, axis)`` jointly and routes each
+    segment in two hops — pod-local column, then cross-pod
+    (``_push_apply_body2``) — so segments cross DCN at most once.
     """
-    n = int(mesh.shape[axis])
+    m = int(mesh.shape[dcn_axis]) if dcn_axis else 1
+    n = int(mesh.shape[axis]) * m
     v_pad, d = table.shape
     flat_ids = ids.reshape(-1).astype(jnp.int32)
     flat_g = row_grads.reshape(-1, d)
@@ -103,17 +151,23 @@ def sharded_row_update(mesh, opt, table, slots, dirty, ids, row_grads, *,
         return new_table, new_slots, new_dirty
 
     slot_leaves, slot_treedef = jax.tree_util.tree_flatten(slots)
-    tbl_spec = P(axis, None)
+    row_axes = (dcn_axis, axis) if m > 1 else axis
+    tbl_spec = P(row_axes, None)
     leaf_specs = tuple(
         tbl_spec if getattr(l, "shape", None) == table.shape else P()
         for l in slot_leaves)
-    body = functools.partial(
-        _push_apply_body, opt, axis=axis, n=n, decay=decay,
-        slot_treedef=slot_treedef)
+    if m > 1:
+        body = functools.partial(
+            _push_apply_body2, opt, dcn=dcn_axis, axis=axis, m=m,
+            k=n // m, decay=decay, slot_treedef=slot_treedef)
+    else:
+        body = functools.partial(
+            _push_apply_body, opt, axis=axis, n=n, decay=decay,
+            slot_treedef=slot_treedef)
     mapped = compat.shard_map(
         body, mesh=mesh,
-        in_specs=(tbl_spec, leaf_specs, P(axis), P(), P(), P(), P()),
-        out_specs=(tbl_spec, P(axis)) + leaf_specs,
+        in_specs=(tbl_spec, leaf_specs, P(row_axes), P(), P(), P(), P()),
+        out_specs=(tbl_spec, P(row_axes)) + leaf_specs,
         check_vma=False)
     out = mapped(table, tuple(slot_leaves), dirty, flat_ids, flat_g,
                  jnp.asarray(lr_eff, table.dtype), jnp.asarray(step))

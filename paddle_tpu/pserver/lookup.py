@@ -41,26 +41,33 @@ from paddle_tpu.parallel import compat
 __all__ = ["all_to_all_lookup", "bucket_by_owner", "TableProxy"]
 
 
-def bucket_by_owner(ids, n_shards: int, shard_rows: int, fill_id: int):
-    """Stable-bucket a flat id slice by owning shard.
+def _bucket_by_key(vals, key, n_buckets: int, fill):
+    """Stable-bucket ``vals`` by ``key`` (ints in ``[0, n_buckets)``).
 
-    Returns ``(buckets [n, cap], order, owner_sorted, bucket_pos)`` where
-    ``cap`` = len(ids) (worst case: one owner takes everything), ``order``
-    is the stable owner sort permutation and ``(owner_sorted, bucket_pos)``
-    addresses each sorted id's cell — the coordinates the caller reuses to
-    route payloads back to requesting positions.  Unused cells hold
-    ``fill_id``.
-    """
-    per = ids.shape[0]
+    Returns ``(buckets [n_buckets, cap], order, key_sorted, bucket_pos)``
+    where ``cap`` = len(vals) (worst case: one bucket takes everything),
+    ``order`` is the stable key-sort permutation and ``(key_sorted,
+    bucket_pos)`` addresses each sorted value's cell — the coordinates
+    the caller reuses to route payloads back to requesting positions.
+    Unused cells hold ``fill``.  Stability is the bit-exactness lever:
+    it is what lets the backward scatter-add reproduce the single-host
+    accumulation order."""
+    per = vals.shape[0]
+    order = jnp.argsort(key, stable=True)
+    svals = vals[order]
+    skey = key[order]
+    starts = jnp.searchsorted(skey, jnp.arange(n_buckets))
+    bucket_pos = jnp.arange(per) - starts[skey]
+    buckets = jnp.full((n_buckets, per), fill, vals.dtype)
+    buckets = buckets.at[skey, bucket_pos].set(svals)
+    return buckets, order, skey, bucket_pos
+
+
+def bucket_by_owner(ids, n_shards: int, shard_rows: int, fill_id: int):
+    """Stable-bucket a flat id slice by owning shard (the
+    :func:`_bucket_by_key` special case keyed on ``id // shard_rows``)."""
     owner = jnp.clip(ids // shard_rows, 0, n_shards - 1)
-    order = jnp.argsort(owner, stable=True)
-    sids = ids[order]
-    sowner = owner[order]
-    starts = jnp.searchsorted(sowner, jnp.arange(n_shards))
-    bucket_pos = jnp.arange(per) - starts[sowner]
-    buckets = jnp.full((n_shards, per), fill_id, ids.dtype)
-    buckets = buckets.at[sowner, bucket_pos].set(sids)
-    return buckets, order, sowner, bucket_pos
+    return _bucket_by_key(ids, owner, n_shards, fill_id)
 
 
 def _a2a_body(shard, ids, *, axis: str, n: int):
@@ -82,15 +89,58 @@ def _a2a_body(shard, ids, *, axis: str, n: int):
     return jnp.zeros((per, d), shard.dtype).at[order].set(got)
 
 
+def _a2a2_body(shard, ids, *, dcn: str, axis: str, m: int, k: int):
+    """Two-level (locality-aware) shard_map body for a multi-pod mesh:
+    shard ``[vs, D]`` local on device ``(pod p, col c)`` = global shard
+    ``p*k + c``.  An id owned by shard ``og`` first hops over ICI to the
+    owner's COLUMN (``og % k`` — pod-local, cheap), then over DCN to the
+    owner's POD (``og // k``) — so the expensive tier carries each id
+    exactly once, in the column-aggregated second exchange, instead of
+    every (src, dst) device pair holding its own DCN bucket."""
+    p = lax.axis_index(dcn)
+    c = lax.axis_index(axis)
+    n = m * k
+    g = p * k + c
+    vs, d = shard.shape
+    per = ids.shape[0] // n
+    mine = lax.dynamic_slice(ids, (g * per,), (per,))
+    sentinel = n * vs
+    og1 = jnp.clip(mine // vs, 0, n - 1)
+    # hop 1 (ICI): route to the owner's column inside my pod
+    b1, order1, col1, pos1 = _bucket_by_key(mine, og1 % k, k, sentinel)
+    r1 = lax.all_to_all(b1, axis, 0, 0).reshape(-1)          # [k*per]
+    # hop 2 (DCN): everything here is column-c traffic — route by pod
+    og2 = jnp.clip(r1 // vs, 0, n - 1)
+    b2, order2, pod2, pos2 = _bucket_by_key(r1, og2 // k, m, sentinel)
+    req = lax.all_to_all(b2, dcn, 0, 0).reshape(-1)          # [m*k*per]
+    local = req - g * vs
+    inb = (local >= 0) & (local < vs)
+    rows = jnp.take(shard, jnp.clip(local, 0, vs - 1), axis=0)
+    rows = rows * inb[..., None].astype(shard.dtype)
+    # reverse DCN hop, unpermute to hop-1 arrival order
+    back2 = lax.all_to_all(rows.reshape(m, k * per, d), dcn, 0, 0)
+    got2 = back2[pod2, pos2]
+    flat1 = jnp.zeros((k * per, d), shard.dtype).at[order2].set(got2)
+    # reverse ICI hop, unpermute to requesting positions
+    back1 = lax.all_to_all(flat1.reshape(k, per, d), axis, 0, 0)
+    got1 = back1[col1, pos1]
+    return jnp.zeros((per, d), shard.dtype).at[order1].set(got1)
+
+
 def all_to_all_lookup(mesh, table, ids, *, axis: str = "model",
-                      out_dtype=None):
-    """table: [V_pad, D] sharded ``P(axis, None)``; ids: int array of any
-    shape, replicated.  Returns ``[*ids.shape, D]`` embeddings (sharded over
-    ``axis`` along the flattened request dim; consumers that need them
-    replicated get one all-gather from GSPMD instead of the old psum's full
+                      out_dtype=None, dcn_axis: Optional[str] = None):
+    """table: [V_pad, D] sharded ``P(axis, None)`` (``P((dcn_axis, axis),
+    None)`` on a multi-pod mesh); ids: int array of any shape, replicated.
+    Returns ``[*ids.shape, D]`` embeddings (sharded over the shard axes
+    along the flattened request dim; consumers that need them replicated
+    get one all-gather from GSPMD instead of the old psum's full
     reduction).  ``out_dtype`` casts the gathered rows (bf16 compute over
-    the f32 master, ROADMAP item 3)."""
-    n = int(mesh.shape[axis])
+    the f32 master, ROADMAP item 3).  ``dcn_axis`` routes the exchange in
+    two hops — pod-local column first, cross-pod second — so each id
+    crosses DCN at most once (``_a2a2_body``)."""
+    m = int(mesh.shape[dcn_axis]) if dcn_axis else 1
+    k = int(mesh.shape[axis])
+    n = m * k
     v_pad, d = table.shape
     flat = ids.reshape(-1).astype(jnp.int32)
     nreq = flat.shape[0]
@@ -101,10 +151,17 @@ def all_to_all_lookup(mesh, table, ids, *, axis: str = "model",
         if npad:
             flat = jnp.concatenate(
                 [flat, jnp.zeros((npad,), jnp.int32)])
-        mapped = compat.shard_map(
-            functools.partial(_a2a_body, axis=axis, n=n),
-            mesh=mesh, in_specs=(P(axis, None), P()),
-            out_specs=P(axis), check_vma=False)
+        if m == 1:
+            mapped = compat.shard_map(
+                functools.partial(_a2a_body, axis=axis, n=n),
+                mesh=mesh, in_specs=(P(axis, None), P()),
+                out_specs=P(axis), check_vma=False)
+        else:
+            mapped = compat.shard_map(
+                functools.partial(_a2a2_body, dcn=dcn_axis, axis=axis,
+                                  m=m, k=k),
+                mesh=mesh, in_specs=(P((dcn_axis, axis), None), P()),
+                out_specs=P((dcn_axis, axis)), check_vma=False)
         out = mapped(table, flat)[:nreq]
     if out_dtype is not None:
         out = out.astype(out_dtype)
@@ -129,10 +186,11 @@ class TableProxy:
 
     def __init__(self, name: str, mesh, axis: str, data,
                  proxies: Dict[Tuple[str, str], Any],
-                 compute_dtype=None) -> None:
+                 compute_dtype=None, dcn_axis: Optional[str] = None) -> None:
         self.name = name
         self.mesh = mesh
         self.axis = axis
+        self.dcn_axis = dcn_axis          # two-hop routing on multi-pod
         self.data = data                  # [V_pad, D], non-differentiated
         self.proxies = proxies            # {(table, layer): zeros[ids.., D]}
         self.compute_dtype = compute_dtype
@@ -140,7 +198,8 @@ class TableProxy:
         self.shape = data.shape
 
     def pserver_lookup(self, ids, *, layer: str, pad_to_zero_id=None):
-        rows = all_to_all_lookup(self.mesh, self.data, ids, axis=self.axis)
+        rows = all_to_all_lookup(self.mesh, self.data, ids, axis=self.axis,
+                                 dcn_axis=self.dcn_axis)
         proxy = self.proxies.get((self.name, layer))
         if proxy is not None:
             rows = rows + proxy           # grads flow ONLY through the proxy

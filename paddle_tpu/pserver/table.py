@@ -125,13 +125,20 @@ class ShardedTable:
     wholesale by the jitted step via the tier), everything else is static."""
 
     def __init__(self, spec: TableSpec, mesh, *, axis: str = "model",
-                 pad: bool = True, data=None, dirty=None) -> None:
+                 pad: bool = True, data=None, dirty=None,
+                 dcn_axis: Optional[str] = None) -> None:
         from paddle_tpu.parallel.mesh import as_mesh
 
         self.spec = spec
         self.mesh = mesh = as_mesh(mesh)
         self.axis = axis
-        self.shards = int(mesh.shape[axis])
+        # multi-pod: rows shard over (dcn, axis) jointly — global shard
+        # p*k + c lives on device (pod p, col c), which is what makes the
+        # two-hop a2a routing (lookup._a2a2_body) land each id at its
+        # owner after one ICI + one DCN exchange
+        self.dcn_axis = dcn_axis if dcn_axis and dcn_axis != axis else None
+        self.pods = int(mesh.shape[self.dcn_axis]) if self.dcn_axis else 1
+        self.shards = int(mesh.shape[axis]) * self.pods
         self.vocab_padded = spec.padded_vocab(self.shards, pad=pad)
         self.shard_rows = self.vocab_padded // self.shards
         if spec.device_budget_bytes:
@@ -142,8 +149,10 @@ class ShardedTable:
                     f"({self.shard_rows} x {spec.dim} {spec.dtype}) but the "
                     f"device budget is {spec.device_budget_bytes} — add "
                     f"shards or shrink the table")
-        self.sharding = NamedSharding(mesh, P(axis, None))
-        self.mask_sharding = NamedSharding(mesh, P(axis))
+        row_axes = (self.dcn_axis, axis) if self.dcn_axis else axis
+        self.row_axes = row_axes
+        self.sharding = NamedSharding(mesh, P(row_axes, None))
+        self.mask_sharding = NamedSharding(mesh, P(row_axes))
         self.data = self._init_sharded() if data is None else data
         self.dirty = (jnp.zeros((self.vocab_padded,), jnp.bool_)
                       if dirty is None else dirty)
@@ -161,8 +170,8 @@ class ShardedTable:
             return init_shard_rows(spec, idx[0], vs)
 
         mapped = compat.shard_map(
-            body, mesh=self.mesh, in_specs=(P(self.axis),),
-            out_specs=P(self.axis, None), check_vma=False)
+            body, mesh=self.mesh, in_specs=(P(self.row_axes),),
+            out_specs=P(self.row_axes, None), check_vma=False)
         idx = jax.device_put(jnp.arange(self.shards, dtype=jnp.int32),
                              self.mask_sharding)
         return mapped(idx)
@@ -183,6 +192,8 @@ class ShardedTable:
         return np.asarray(jnp.take(self.data, ids, axis=0))
 
     def __repr__(self) -> str:
+        at = (f"({self.dcn_axis},{self.axis})" if self.dcn_axis
+              else self.axis)
         return (f"<ShardedTable {self.spec.name} {self.spec.vocab}"
                 f"(+{self.vocab_padded - self.spec.vocab} pad)x{self.spec.dim} "
-                f"{self.shards} shards @{self.axis}>")
+                f"{self.shards} shards @{at}>")

@@ -107,6 +107,7 @@ class PServerTier:
             axis = mesh.role_axis("pserver")
         self.mesh = as_mesh(mesh)
         self.axis = axis or FLAGS.pserver_axis
+        self.dcn_axis = self._resolve_dcn(mesh, self.axis)
         self.optimizer = optimizer
         self.lr_scales = dict(lr_scales or {})
         self.decays = dict(decays or {})
@@ -140,7 +141,8 @@ class PServerTier:
                 # (lookup.TableProxy), so masters, row gradients, and the
                 # row-sparse update path stay f32 and bit-identical
                 compute_dtype=("bfloat16" if FLAGS.amp else None))
-            table = ShardedTable(tspec, mesh, axis=self.axis, pad=pad)
+            table = ShardedTable(tspec, mesh, axis=self.axis, pad=pad,
+                                 dcn_axis=self.dcn_axis)
             self.tables[pname] = table
             slots = optimizer.init_leaf(table.data)
             self._slots[pname] = jax.tree_util.tree_map(
@@ -149,6 +151,23 @@ class PServerTier:
                 slots)
             logger.info("pserver: routed %s (%s) -> %r", pname,
                         ", ".join(r.layer for r in rs), table)
+
+    @staticmethod
+    def _resolve_dcn(mesh, axis: str) -> Optional[str]:
+        """The dcn axis tables co-shard over, when the world is multi-pod:
+        a MeshConfig's binding (or ``--dcn_axis``), present in the mesh,
+        larger than 1, and distinct from the pserver axis.  None
+        otherwise — a single-pod world keeps the one-hop a2a unchanged."""
+        from paddle_tpu.parallel.mesh import MeshConfig
+
+        if isinstance(mesh, MeshConfig):
+            name, shape = mesh.dcn_axis, mesh.shape
+        else:
+            name = FLAGS.dcn_axis or None
+            shape = {n: int(mesh.shape[n]) for n in mesh.axis_names}
+        if name and name != axis and shape.get(name, 1) > 1:
+            return name
+        return None
 
     # ------------------------------------------------------------------
     # step-state plumbing (a plain pytree the jitted step donates)
@@ -212,8 +231,11 @@ class PServerTier:
         via ``_repad_rows``; nothing is re-initialized."""
         from paddle_tpu.parallel.mesh import as_mesh
 
-        mesh = as_mesh(mesh)
         state = self.state()
+        # re-resolve the pod axis BEFORE as_mesh: a MeshConfig carries the
+        # binding; the dcn axis may have shrunk to one pod (or grown back)
+        self.dcn_axis = self._resolve_dcn(mesh, self.axis)
+        mesh = as_mesh(mesh)
         self.mesh = mesh
         for pname, old in list(self.tables.items()):
             # adopt() below overwrites data/dirty/slots from ``state``
@@ -223,7 +245,7 @@ class PServerTier:
             # resize window
             self.tables[pname] = ShardedTable(
                 old.spec, mesh, axis=self.axis, data=old.data,
-                dirty=None)
+                dirty=None, dcn_axis=self.dcn_axis)
         # adopt() re-pads the carried rows, dirty bits, and slots into the
         # new shard multiple; place() re-pins everything to the new
         # mesh's shardings
@@ -260,7 +282,8 @@ class PServerTier:
         return {
             name: TableProxy(name, self.mesh, self.axis, tables[name],
                              proxies,
-                             compute_dtype=self.tables[name].spec.compute_dtype)
+                             compute_dtype=self.tables[name].spec.compute_dtype,
+                             dcn_axis=self.dcn_axis)
             for name in self.tables
         }
 
@@ -313,7 +336,7 @@ class PServerTier:
                     self.mesh, self.optimizer, state["tables"][pname],
                     state["slots"][pname], state["dirty"][pname], ids, g,
                     axis=self.axis, lr_eff=lr * scale, step=step,
-                    decay=decay))
+                    decay=decay, dcn_axis=self.dcn_axis))
         return {"step": step, "tables": new_tables, "slots": new_slots,
                 "dirty": new_dirty}
 

@@ -27,10 +27,11 @@ tier of the trainer survivable (docs/resilience.md):
   tests/test_gang.py.
 """
 
-from paddle_tpu.resilience.errors import (CheckpointError, GangError,
-                                          GangFailedError, GangResized,
-                                          ReaderError, SDCDivergence,
-                                          TooManyBadSteps)
+from paddle_tpu.resilience.errors import (CheckpointError, DCNError,
+                                          DCNPartitioned, DCNTimeout,
+                                          GangError, GangFailedError,
+                                          GangResized, ReaderError,
+                                          SDCDivergence, TooManyBadSteps)
 from paddle_tpu.resilience.cluster import (GangContext, GangResult,
                                            GangSupervisor, RankReport,
                                            current_gang)
@@ -54,6 +55,7 @@ from paddle_tpu.resilience.integrity import (ScrubDaemon, fingerprint_hex,
                                              make_agreement_check,
                                              np_tree_fingerprint,
                                              scrub_paths, sdc_vote,
+                                             sdc_vote_pods,
                                              tree_fingerprint)
 from paddle_tpu.resilience.reader import resilient_reader
 from paddle_tpu.resilience.signals import PreemptionHandler
@@ -66,6 +68,9 @@ __all__ = [
     "GangError",
     "GangFailedError",
     "GangResized",
+    "DCNError",
+    "DCNTimeout",
+    "DCNPartitioned",
     "GangContext",
     "GangResult",
     "GangSupervisor",
@@ -96,6 +101,7 @@ __all__ = [
     "fingerprint_int",
     "fingerprint_hex",
     "sdc_vote",
+    "sdc_vote_pods",
     "make_agreement_check",
     "scrub_paths",
     "latest_verified_pass",

@@ -23,7 +23,16 @@ sees, deterministically:
   handlers that SIGKILL or wedge THIS rank at an exact batch (marker-file
   guarded, so only the first gang attempt is sabotaged), and
   ``corrupt_latest_checkpoint`` damages the newest pass dir between
-  restarts.
+  restarts;
+- cross-pod (the pod-as-failure-unit models, resilience/dcn.py —
+  docs/resilience.md): ``kill_pod`` SIGKILLs every rank of one pod (the
+  lost-ICI-domain fault the elastic supervisor must answer with a dcn
+  shrink, never a whole-gang relaunch), ``partition_pod`` black-holes a
+  pod's cross-pod transport files while its heartbeats keep flowing
+  (the network-partition signature — must attribute as
+  ``DCNPartitioned``, not pod death), and ``slow_dcn`` paces every
+  cross-pod wait (merely-slow must be absorbed by the transport's retry
+  budget, not expelled); ``heal_partition`` lifts the partition.
 
 - observability (the event journal, paddle_tpu/obs — docs/
   observability.md): ``kill_mid_journal_write`` SIGKILLs a REAL child
@@ -80,6 +89,10 @@ __all__ = [
     "kill_rank",
     "hang_rank",
     "slow_rank",
+    "kill_pod",
+    "partition_pod",
+    "heal_partition",
+    "slow_dcn",
     "die_at",
     "stall_at",
     "die_during_resize",
@@ -604,6 +617,89 @@ def slow_rank(gang, rank: int, *, stop_s: float = 5.0) -> "object":
     t.daemon = True
     t.start()
     return t
+
+
+def _gang_dir_of(gang) -> str:
+    """The attempt/gang dir the DCN markers live in: a GangSupervisor
+    carries ``attempt_dir``, a worker-side GangContext ``gang_dir``."""
+    d = getattr(gang, "attempt_dir", None) or getattr(gang, "gang_dir", None)
+    if d is None:
+        raise ValueError("partition/slow-DCN chaos needs a GangSupervisor "
+                         "(attempt_dir) or GangContext (gang_dir)")
+    return d
+
+
+def kill_pod(gang, pod: int, *, pod_size: Optional[int] = None,
+             sig: int = _signal.SIGKILL) -> list:
+    """SIGKILL EVERY live rank of one pod — the pod-as-failure-unit fault
+    (an ICI domain lost whole: power, fabric, or maintenance).  With
+    ``--dcn_axis`` bound the elastic supervisor must shrink the dcn axis
+    by exactly this pod (survivor pods keep training — never a
+    whole-gang relaunch) and grow a replacement pod back.  ``pod_size``
+    defaults to the supervisor's/context's own.  Returns the ranks hit."""
+    procs = _procs_of(gang)
+    ps = int(pod_size if pod_size is not None
+             else getattr(gang, "pod_size", 1))
+    hit = []
+    for r in range(pod * ps, (pod + 1) * ps):
+        if r < len(procs) and procs[r].poll() is None:
+            os.kill(procs[r].pid, sig)
+            hit.append(r)
+    return hit
+
+
+def partition_pod(gang, pod: int) -> str:
+    """Black-hole one pod's DCN links: its cross-pod transport files
+    (exchange/broadcast) become invisible in BOTH directions while its
+    processes — and their heartbeats, which ride the supervisor control
+    plane — keep running.  Exactly the network-partition signature the
+    DCN transport must attribute as ``DCNPartitioned`` (pod alive but
+    unreachable), distinct from pod death (``DCNTimeout``/watchdog) and
+    from pod slow (absorbed by retries; ``slow_dcn``).  Returns the
+    marker path; remove it (``heal_partition``) to heal."""
+    from paddle_tpu.resilience.dcn import partition_marker
+
+    path = partition_marker(_gang_dir_of(gang), pod)
+    with open(path, "w") as f:
+        f.write("partitioned\n")
+    return path
+
+
+def heal_partition(gang, pod: Optional[int] = None) -> int:
+    """Remove partition markers (one pod's, or all) — the network heals.
+    Returns the number of markers removed."""
+    d = _gang_dir_of(gang)
+    names = ([f"dcn-partition-pod{pod}"] if pod is not None else
+             [n for n in os.listdir(d) if n.startswith("dcn-partition-pod")])
+    n = 0
+    for name in names:
+        try:
+            os.remove(os.path.join(d, name))
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
+def slow_dcn(gang, seconds: float) -> Optional[str]:
+    """Pace every cross-pod transport wait by ``seconds`` — the slow-DCN
+    fault (congested inter-pod links).  A merely-slow pod must be
+    ABSORBED by the transport's retry budget (no expel, no error) as
+    long as the pacing stays under ``--dcn_timeout_s`` ×
+    ``(--dcn_retries + 1)``; ``seconds <= 0`` removes the pacing.
+    Returns the marker path (None when removed)."""
+    from paddle_tpu.resilience.dcn import slow_marker
+
+    path = slow_marker(_gang_dir_of(gang))
+    if seconds <= 0:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    with open(path, "w") as f:
+        f.write(str(float(seconds)))
+    return path
 
 
 def die_at(*, batch: int, pass_id: int = 0, marker: str,

@@ -54,6 +54,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
+from paddle_tpu.resilience.dcn import (DCNTransport, atomic_publish,
+                                       report_marker)
 from paddle_tpu.resilience.errors import (GangError, GangFailedError,
                                           GangResized)
 from paddle_tpu.utils import FLAGS, logger
@@ -73,6 +75,7 @@ _ENV_SIZE = "PADDLE_TPU_GANG_SIZE"
 _ENV_RANK = "PADDLE_TPU_GANG_RANK"        # falls back to _PROCESS_ID
 _ENV_HEARTBEAT = "PADDLE_TPU_GANG_HEARTBEAT_S"
 _ENV_EPOCH = "PADDLE_TPU_GANG_EPOCH"      # join epoch of an elastic joiner
+_ENV_POD = "PADDLE_TPU_GANG_POD_SIZE"     # ranks per pod (1 = no pods)
 
 _WORLD_FILE = "world.json"                # supervisor-published membership
 
@@ -98,7 +101,8 @@ class GangContext:
     def __init__(self, gang_dir: str, rank: int, size: int,
                  heartbeat_s: Optional[float] = None,
                  barrier_timeout_s: float = 600.0,
-                 epoch: int = 0) -> None:
+                 epoch: int = 0,
+                 pod_size: Optional[int] = None) -> None:
         self.gang_dir = gang_dir
         self.rank = int(rank)
         self.size = int(size)          # CONFIGURED world size (full gang)
@@ -106,9 +110,19 @@ class GangContext:
                             else float(heartbeat_s))
         self.barrier_timeout_s = float(barrier_timeout_s)
         self._barrier_seq = 0
+        self._pod_barrier_seq = 0  # tpu-lint: guarded-by=none - single protocol thread; reset by adopt_world in the same thread that bumps it
         self._hb_count = 0
         self._hb_last = 0.0
         self._preempt_flagged = False
+        # -- pod (DCN) topology: ranks group into contiguous pods of
+        # pod_size; cross-pod waits route through the DCN transport
+        # (resilience/dcn.py) for bounded timeouts, retries, and typed
+        # partition attribution.  pod_size 1 = every rank its own pod =
+        # the classic single-ICI-domain gang.
+        if pod_size is None:
+            pod_size = int(os.environ.get(_ENV_POD, "1"))
+        self.pod_size = max(1, int(pod_size))  # tpu-lint: guarded-by=none - rewritten only by adopt_world on THIS rank's single protocol thread; the supervisor communicates a new pod_size via world.json, never shared memory
+        self._dcn = DCNTransport(gang_dir, self.rank, self.pod_size)  # tpu-lint: guarded-by=none - owned by the single protocol thread; adopt_world re-points its pod_size in the same thread that runs every wait()
         # -- elastic world state (docs/resilience.md "Elastic gang") -----
         # epoch 0 = the configured full world; the supervisor publishes
         # world.json with a higher epoch on every shrink/grow.  A JOINER
@@ -138,6 +152,28 @@ class GangContext:
     def degraded(self) -> bool:
         """True while the live world is smaller than the configured one."""
         return len(self.ranks) < self.size
+
+    # -- pod topology ----------------------------------------------------
+
+    @property
+    def pod(self) -> int:
+        """This rank's pod index."""
+        return self.rank // self.pod_size
+
+    def pod_of(self, rank: int) -> int:
+        """Pod index of ``rank`` (pods are contiguous rank blocks, the
+        same layout ``MeshConfig.pod_of`` assumes with the dcn axis
+        first)."""
+        return int(rank) // self.pod_size
+
+    @property
+    def pods(self) -> List[int]:
+        """Pod indices with at least one LIVE rank."""
+        return sorted({self.pod_of(r) for r in self.ranks})
+
+    def pod_ranks(self, pod: int) -> List[int]:
+        """Live ranks of ``pod``."""
+        return [r for r in self.ranks if self.pod_of(r) == pod]
 
     # -- heartbeat -------------------------------------------------------
 
@@ -201,7 +237,10 @@ class GangContext:
         self.epoch = int(world["epoch"])
         self.ranks = sorted(int(r) for r in world["ranks"])
         self.coordinator = int(world.get("coordinator", self.ranks[0]))
+        self.pod_size = max(1, int(world.get("pod_size", self.pod_size)))
+        self._dcn.pod_size = self.pod_size
         self._barrier_seq = 0
+        self._pod_barrier_seq = 0
         logger.info("rank %d: adopted gang epoch %d (ranks %s, "
                     "coordinator %d)", self.rank, self.epoch, self.ranks,
                     self.coordinator)
@@ -261,6 +300,39 @@ class GangContext:
             self.heartbeat()
             time.sleep(_POLL_S)
 
+    def pod_barrier(self, timeout_s: Optional[float] = None) -> None:
+        """Pod-LOCAL rendezvous: only this pod's live ranks meet (over
+        ICI — never crosses DCN, so it carries no transport budget).  The
+        two-level commit discipline is pod-local first, global second:
+        drain the pod here, THEN run the cross-pod :meth:`barrier` — so a
+        slow pod holds only the global step, never a peer pod's local
+        drain (``lint --protocol`` pins the ordering)."""
+        peers = self.pod_ranks(self.pod)
+        n = self._pod_barrier_seq
+        self._pod_barrier_seq += 1
+        if len(peers) <= 1:
+            return
+        stem = f"pbarrier-e{self.epoch:03d}-p{self.pod}-{n:05d}-rank"
+        _atomic_write(os.path.join(self.gang_dir, f"{stem}{self.rank}"),
+                      "1")
+        deadline = time.monotonic() + (self.barrier_timeout_s
+                                       if timeout_s is None else timeout_s)
+        want = [os.path.join(self.gang_dir, f"{stem}{r}") for r in peers]
+        while True:
+            if all(os.path.exists(p) for p in want):
+                return
+            if not self._resizing:
+                world = self.poll_world()
+                if world is not None:
+                    raise GangResized(world)
+            if time.monotonic() > deadline:
+                raise GangError(
+                    f"rank {self.rank}: pod barrier e{self.epoch}/p"
+                    f"{self.pod}/{n} timed out — a pod-local peer likely "
+                    "died (the supervisor will expel the pod)")
+            self.heartbeat()
+            time.sleep(_POLL_S)
+
     # -- all-ranks exchange (the SDC fingerprint channel) ---------------
 
     def exchange_json(self, obj: Any, *, name: str,
@@ -295,30 +367,40 @@ class GangContext:
         own = os.path.join(self.gang_dir, f"{stem}{self.rank}")
         _atomic_write(own, json.dumps(obj))
         hist.append(own)
-        deadline = time.monotonic() + (self.barrier_timeout_s
-                                       if timeout_s is None else timeout_s)
         want = {r: os.path.join(self.gang_dir, f"{stem}{r}")
                 for r in self.ranks}
-        while True:
-            out: Dict[int, Any] = {}
+        seen: Dict[int, Any] = {}
+
+        # the wait routes through the DCN transport: bounded default
+        # timeout (a wedged pod can no longer hang the healthy side for
+        # the full barrier budget), retries absorbing a slow pod, typed
+        # DCNTimeout/DCNPartitioned attribution of an unreachable one.
+        # An explicit timeout_s keeps the classic one-attempt semantics.
+        def poll() -> Optional[Dict[int, Any]]:
             for r, p in want.items():
+                if r in seen:
+                    continue
+                if r != self.rank and self._dcn.blocked(r):
+                    continue
                 try:
                     with open(p) as f:
-                        out[r] = json.load(f)
+                        seen[r] = json.load(f)
                 except (FileNotFoundError, json.JSONDecodeError, OSError):
-                    break
-            if len(out) == len(want):
-                return out
+                    continue
+            return dict(seen) if len(seen) == len(want) else None
+
+        def on_wait() -> None:
             if not self._resizing:
                 world = self.poll_world()
                 if world is not None:
                     raise GangResized(world)
-            if time.monotonic() > deadline:
-                raise GangError(
-                    f"rank {self.rank}: exchange {name!r} (epoch "
-                    f"{self.epoch}) timed out — a peer likely died")
             self.heartbeat()
-            time.sleep(_POLL_S)
+
+        return self._dcn.wait(
+            f"exchange {name!r} (epoch {self.epoch})", poll,
+            [r for r in self.ranks if r != self.rank],
+            timeout_s=timeout_s, on_wait=on_wait,
+            missing=lambda: [r for r in want if r not in seen])
 
     # -- preemption OR-reduce -------------------------------------------
 
@@ -356,20 +438,26 @@ class GangContext:
         if self.is_coordinator:
             _atomic_write(path, json.dumps(obj))
             return obj
-        deadline = time.monotonic() + (self.barrier_timeout_s
-                                       if timeout_s is None else timeout_s)
-        while True:
+
+        # waits route through the DCN transport like exchange_json: the
+        # coordinator usually lives in another pod, so a partitioned (or
+        # wedged) coordinator pod surfaces as a typed, bounded failure
+        # instead of a barrier-budget hang.  Payloads may be None, so the
+        # poll wraps the decision in a 1-tuple.
+        def poll() -> Optional[tuple]:
+            if self._dcn.blocked(self.coordinator):
+                return None
             try:
                 with open(path) as f:
-                    return json.load(f)
+                    return (json.load(f),)
             except (FileNotFoundError, json.JSONDecodeError):
-                pass
-            if time.monotonic() > deadline:
-                raise GangError(
-                    f"rank {self.rank}: no coordinator decision {name!r} "
-                    f"within {self.barrier_timeout_s:.0f}s")
-            self.heartbeat()
-            time.sleep(_POLL_S)
+                return None
+
+        return self._dcn.wait(
+            f"broadcast {name!r} (epoch {self.epoch})", poll,
+            [self.coordinator], timeout_s=timeout_s,
+            on_wait=self.heartbeat,
+            missing=lambda: [self.coordinator])[0]
 
 
 class _JaxGang:
@@ -390,10 +478,30 @@ class _JaxGang:
         self.epoch = 0
         self.ranks = list(range(self.size))
         self.coordinator = 0
+        # pod surface parity: a live jax.distributed pod IS one ICI
+        # domain — no cross-pod structure to supervise from here
+        self.pod_size = 1
 
     @property
     def is_coordinator(self) -> bool:
         return self.rank == 0
+
+    @property
+    def pod(self) -> int:
+        return 0
+
+    def pod_of(self, rank: int) -> int:
+        return 0
+
+    @property
+    def pods(self) -> List[int]:
+        return [0]
+
+    def pod_ranks(self, pod: int) -> List[int]:
+        return list(self.ranks)
+
+    def pod_barrier(self, timeout_s: Optional[float] = None) -> None:
+        pass                       # one pod: the pod-local drain is free
 
     @property
     def world_size(self) -> int:
@@ -587,6 +695,7 @@ class GangSupervisor:
         grow_back: Optional[bool] = None,
         resize_timeout_s: Optional[float] = None,
         rng: Optional[_random.Random] = None,
+        pod_size: int = 1,
     ) -> None:
         self.hosts = list(hosts)
         self.script = script
@@ -628,6 +737,18 @@ class GangSupervisor:
         self.resize_timeout_s = (FLAGS.gang_resize_timeout_s
                                  if resize_timeout_s is None
                                  else float(resize_timeout_s))
+        # pod-as-failure-unit (docs/resilience.md "Cross-pod recovery"):
+        # ranks group into contiguous pods of pod_size; ANY rank failure
+        # expels its WHOLE pod (an ICI domain is not survivable piecewise
+        # — the survivors of a half-dead pod deadlock in their next
+        # pod-local collective), and a worker-reported DCN partition
+        # expels the unreachable pod the same way.  pod_size 1 keeps the
+        # classic rank-as-failure-unit behavior bit-for-bit.
+        self.pod_size = max(1, int(pod_size))  # tpu-lint: guarded-by=none - immutable after __init__; the monitor loop and resize paths run inline on the supervise() thread
+        if len(self.hosts) % self.pod_size:
+            raise ValueError(
+                f"gang of {len(self.hosts)} rank(s) does not divide into "
+                f"pods of {self.pod_size}")
         self._rng = rng or _random.Random()
         # supervisor's own event journal (paddle_tpu/obs; --obs_journal):
         # rank death/hang, world publishes, relaunches — the supervisor
@@ -687,6 +808,7 @@ class GangSupervisor:
             _ENV_DIR: self.attempt_dir,
             _ENV_SIZE: str(len(self.hosts)),
             _ENV_HEARTBEAT: str(self.heartbeat_s),
+            _ENV_POD: str(self.pod_size),
         }
         launcher.launch(self.script, self.args, env=env, cwd=self.cwd)
         self.launcher = launcher
@@ -755,6 +877,22 @@ class GangSupervisor:
                     failed.append(RankReport(
                         attempt, r, launcher.procs[r].pid, None, "hung",
                         stale_s=age))
+            if not failed and self.pod_size > 1:
+                failed = self._partition_failures(launcher, attempt, codes)
+            if failed and self.pod_size > 1:
+                # pod as the failure unit: expel the culprits' WHOLE pods
+                # — the surviving ranks of a half-dead ICI domain would
+                # only deadlock in their next pod-local collective
+                have = {f.rank for f in failed}
+                for p in sorted({f.rank // self.pod_size for f in failed}):
+                    for r in range(p * self.pod_size,
+                                   (p + 1) * self.pod_size):
+                        if r in self.active and r not in have:
+                            failed.append(RankReport(
+                                attempt, r, launcher.procs[r].pid,
+                                codes[r],
+                                f"pod-killed (pod {p} is the failure "
+                                "unit)"))
             if failed:
                 for f in failed:
                     # a rank that exited because its state fingerprint
@@ -907,6 +1045,66 @@ class GangSupervisor:
                 self._tick(self, attempt, elapsed)
             self._sleep(self.poll_s)
 
+    # -- cross-pod partition folding -------------------------------------
+
+    def _partition_failures(self, launcher, attempt: int,
+                            codes) -> List[RankReport]:
+        """Fold worker partition reports (resilience/dcn.py) into
+        pod-level failures.  A healthy rank whose DCN transport burned
+        its retry budget against a pod that STILL heartbeats wrote a
+        report naming that pod; the supervisor verifies the accusation
+        (the accused must look alive from here — a stale accused pod is
+        the watchdog's case, not a partition) and expels the accused
+        pod's ranks with partition attribution.  The reporters stay
+        alive: they hold at their boundary and adopt the shrunken world
+        — a partition heals by elastic shrink, never by relaunch."""
+        reporters: Dict[int, Dict[str, Any]] = {}
+        try:
+            names = os.listdir(self.attempt_dir)
+        except OSError:
+            return []
+        for n in names:
+            if not n.startswith("dcn-partition-report-rank"):
+                continue
+            try:
+                r = int(n.rsplit("rank", 1)[1])
+                with open(os.path.join(self.attempt_dir, n)) as f:
+                    reporters[r] = json.load(f)
+            except (ValueError, OSError, json.JSONDecodeError):
+                continue
+        if not reporters:
+            return []
+        accused = sorted({int(p) for rep in reporters.values()
+                          for p in rep.get("pods", [rep.get("pod")])
+                          if p is not None})
+        wall = time.time()
+        ranks = []
+        for p in accused:
+            for r in range(p * self.pod_size, (p + 1) * self.pod_size):
+                if r not in self.active or codes[r] is not None:
+                    continue
+                age = self._hb_age(r, wall)
+                if age is None or age > self.watchdog_s:
+                    return []      # accused pod looks dead: watchdog owns it
+                ranks.append((r, p, age))
+        if not ranks:
+            return []
+        for r in reporters:        # consumed — one expel per incident
+            try:
+                os.remove(report_marker(self.attempt_dir, r))
+            except OSError:
+                pass
+        self._jrec("dcn_partition", fsync=True, pods=accused,
+                   reporters=sorted(reporters))
+        logger.warning("gang: pod(s) %s partitioned from the DCN "
+                       "(reported by rank(s) %s; heartbeats fresh) — "
+                       "expelling as unit(s)", accused, sorted(reporters))
+        return [RankReport(
+            attempt, r, launcher.procs[r].pid, None,
+            f"dcn-partitioned (pod {p} unreachable over DCN, reported "
+            f"by rank(s) {sorted(reporters)})", stale_s=age)
+            for r, p, age in ranks]
+
     # -- elastic resize (supervisor half) --------------------------------
 
     def _publish_world(self, reason: str) -> None:
@@ -920,9 +1118,13 @@ class GangSupervisor:
                  "ranks": sorted(self.active),
                  "coordinator": self.coordinator,
                  "size": len(self.hosts),
+                 "pod_size": self.pod_size,
                  "reason": reason}
-        _atomic_write(os.path.join(self.attempt_dir, _WORLD_FILE),
-                      json.dumps(world))
+        # the world publish is the one supervisor write every pod's
+        # adoption hangs off — fsync'd durable via the DCN transport's
+        # publish path, so a supervisor-host crash can never strand pods
+        # on a world that was published but not committed
+        atomic_publish(os.path.join(self.attempt_dir, _WORLD_FILE), world)
         self.last_resize_reason = reason
         if self._journal is not None:
             # fsync'd: world publishes are the anchors an elastic-incident

@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import List, Optional
 
 __all__ = ["CheckpointError", "ReaderError", "TooManyBadSteps",
-           "GangError", "GangFailedError", "GangResized", "SDCDivergence"]
+           "GangError", "GangFailedError", "GangResized", "SDCDivergence",
+           "DCNError", "DCNTimeout", "DCNPartitioned"]
 
 
 class CheckpointError(RuntimeError):
@@ -66,6 +67,41 @@ class GangResized(Exception):
         super().__init__(f"gang resized to epoch {world.get('epoch')}: "
                          f"ranks {world.get('ranks')}")
         self.world = dict(world)
+
+
+class DCNError(GangError):
+    """A cross-pod (DCN) transport operation failed.  Subclass of
+    ``GangError`` so every existing worker-side handler that treats a
+    gang-primitive failure as fatal keeps working unchanged; the typed
+    subclasses below add WHICH pod was unreachable and WHY."""
+
+    def __init__(self, message: str, *, pod: Optional[int] = None,
+                 op: str = "", attempts: int = 0) -> None:
+        super().__init__(message)
+        #: pod index the transport attributes the failure to (None when
+        #: no single pod could be blamed)
+        self.pod = pod
+        #: the transport operation that failed (e.g. "exchange sdc-...")
+        self.op = op
+        #: attempts made (1 + retries) before giving up
+        self.attempts = attempts
+
+
+class DCNTimeout(DCNError):
+    """A DCN exchange exhausted its retry budget and the missing pod is
+    NOT heartbeating — indistinguishable from pod death on this evidence,
+    so the caller should let the normal pod-failure path (supervisor
+    watchdog -> elastic shrink of the dcn axis) attribute and expel it."""
+
+
+class DCNPartitioned(DCNError):
+    """A DCN exchange exhausted its retry budget while the missing pod's
+    ranks were still heartbeating: the pod is alive but unreachable over
+    DCN — a network partition, not a death.  Distinct from
+    :class:`DCNTimeout` so the supervisor can expel a partitioned pod
+    with "partition" attribution (and tests can pin the difference), and
+    distinct from "pod slow", which the bounded retries absorb without
+    raising at all."""
 
 
 class GangFailedError(RuntimeError):

@@ -54,7 +54,8 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -66,6 +67,7 @@ __all__ = [
     "fingerprint_int",
     "fingerprint_hex",
     "sdc_vote",
+    "sdc_vote_pods",
     "SdcVote",
     "make_agreement_check",
     "ScrubFinding",
@@ -264,6 +266,57 @@ def sdc_vote(fps: Mapping[int, int], coordinator: int) -> SdcVote:
     minority = sorted(r for r, v in fps.items() if v != presumed)
     return SdcVote(agreed=False, presumed=presumed, minority=minority,
                    tie=tie)
+
+
+def _fold_digest(digest: "tuple") -> int:
+    """Rotate-xor fold of a pod digest into one u64 — purely a stable
+    label for journaling/logging (`SdcVote.presumed` is rendered %016x).
+    A single-member digest folds to the member's own fingerprint, so
+    pod_size-1 voting journals the same value :func:`sdc_vote` would."""
+    acc = 0
+    for v in digest:
+        acc = (((acc << 7) | (acc >> 57)) ^ int(v)) & 0xFFFFFFFFFFFFFFFF
+    return acc
+
+
+def sdc_vote_pods(fps: Mapping[int, int], coordinator: int,
+                  pod_of: Callable[[int], int]) -> SdcVote:
+    """Pod-level majority vote over ``{rank: u64 fingerprint}``.
+
+    With a dcn axis bound, ranks WITHIN a pod are shards of one replica
+    (their fingerprints legitimately differ rank to rank), while pods are
+    bit-identical replicas of each other — so the unit of agreement is
+    the POD: its digest is the rank-ordered tuple of its members'
+    fingerprints, and the vote runs over pod digests.  A minority pod's
+    ranks are ALL minority (the pod is the failure unit — one corrupt
+    shard poisons every collective the pod runs), so the elastic
+    supervisor quarantines and expels the whole pod.  Tie-break mirrors
+    :func:`sdc_vote`: no unique strict majority of PODS presumes the
+    coordinator's pod and sets ``tie`` so survivors run the conservative
+    rollback path."""
+    if not fps:
+        return SdcVote(agreed=True, presumed=0)
+    members: Dict[int, List[Tuple[int, int]]] = {}
+    for r, v in fps.items():
+        members.setdefault(pod_of(r), []).append((int(r), int(v)))
+    digests = {p: tuple(v for _, v in sorted(ms))
+               for p, ms in members.items()}
+    counts: Dict[tuple, int] = {}
+    for d in digests.values():
+        counts[d] = counts.get(d, 0) + 1
+    if len(counts) == 1:
+        return SdcVote(agreed=True,
+                       presumed=_fold_digest(next(iter(counts))))
+    best = max(counts.values())
+    leaders = [d for d, c in counts.items() if c == best]
+    if len(leaders) == 1 and best * 2 > len(digests):
+        presumed_digest, tie = leaders[0], False
+    else:
+        presumed_digest, tie = digests[pod_of(coordinator)], True
+    minority = sorted(r for p, ms in members.items()
+                      if digests[p] != presumed_digest for r, _ in ms)
+    return SdcVote(agreed=False, presumed=_fold_digest(presumed_digest),
+                   minority=minority, tie=tie)
 
 
 # ---------------------------------------------------------------------------

@@ -29,10 +29,10 @@ import numpy as np
 from paddle_tpu.data.feeder import PreparedFeed, PrepareError
 from paddle_tpu.nn.graph import LayerOutput, Topology
 from paddle_tpu.param.optimizers import Optimizer, ParameterAverager, SGD
-from paddle_tpu.resilience import (GangResized, PreemptionHandler,
-                                   ReaderError, TooManyBadSteps,
-                                   guarded_update, init_loss_scale,
-                                   scaled_guarded_update)
+from paddle_tpu.resilience import (DCNPartitioned, GangResized,
+                                   PreemptionHandler, ReaderError,
+                                   TooManyBadSteps, guarded_update,
+                                   init_loss_scale, scaled_guarded_update)
 from paddle_tpu.resilience.checkpoint_io import (latest_pass, load_checkpoint,
                                                  read_manifest, pass_dir,
                                                  save_checkpoint)
@@ -1356,7 +1356,7 @@ class SGDTrainer:
         re-voting against a quarantined peer's stale digest would only
         re-litigate the same incident."""
         from paddle_tpu.resilience.errors import SDCDivergence
-        from paddle_tpu.resilience.integrity import sdc_vote
+        from paddle_tpu.resilience.integrity import sdc_vote, sdc_vote_pods
 
         if self._sdc_hold_epoch is not None:
             if gang.epoch == self._sdc_hold_epoch:
@@ -1381,9 +1381,27 @@ class SGDTrainer:
                 raise _SdcRollback(pass_id, batch_id + 1,
                                    cursor_ready=True)
             return
+        except DCNPartitioned as e:
+            # the peer pod is alive but unreachable over DCN: the
+            # transport already reported it — hold for the supervisor's
+            # pod-expel publish and resize into the shrunken world
+            world = self._dcn_partition_hold(gang, e)
+            self._gang_resize(gang, world, pass_id, batch_id + 1,
+                              handler)
+            if self._source_resharded:
+                self._source_resharded = False
+                raise _SdcRollback(pass_id, batch_id + 1,
+                                   cursor_ready=True)
+            return
         self._obs_counters["sdc_checks"].inc()
         fps = {int(r): int(v) for r, v in raw.items()}
-        vote = sdc_vote(fps, gang.coordinator)
+        if getattr(gang, "pod_size", 1) > 1:
+            # dcn topology: pods (not ranks) are the bit-identical
+            # replicas AND the failure unit — vote over pod digests so a
+            # divergent pod is quarantined whole
+            vote = sdc_vote_pods(fps, gang.coordinator, gang.pod_of)
+        else:
+            vote = sdc_vote(fps, gang.coordinator)
         if vote.agreed:
             self._sdc_last_agreed = (pass_id, batch_id, fp)
             self._sdc_agreed_fps.append(fp)
@@ -1518,6 +1536,34 @@ class SGDTrainer:
 
     # -- elastic gang resize (worker half; docs/resilience.md) -----------
 
+    def _dcn_partition_hold(self, gang, exc) -> Dict[str, Any]:
+        """A DCN partition heals by the SUPERVISOR expelling the accused
+        pod (elastic shrink), not by this rank dying: the transport left
+        a report marker naming the pod, so hold here — keep heartbeating
+        (this rank is healthy; dying would widen the blast radius to a
+        whole-gang relaunch) and watch for the world publish — then hand
+        the shrunken world to the normal resize protocol.  No publish
+        within the budget means the supervisor disagreed (e.g. the
+        accused pod's heartbeats went stale, so the watchdog owns it as a
+        pod DEATH): re-raise and let the fallback relaunch attribute it."""
+        budget = max(30.0, 4.0 * FLAGS.gang_watchdog_s)
+        logger.warning(
+            "DCN partition: pod %s unreachable after %d attempt(s) on %s "
+            "— holding up to %.0fs for the supervisor's pod-expel "
+            "publish", exc.pod, exc.attempts, exc.op or "?", budget)
+        if self._journal is not None:
+            self._journal.record("dcn_partition_hold", fsync=True,
+                                 pod=exc.pod, op=exc.op,
+                                 attempts=exc.attempts)
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            gang.heartbeat()
+            world = gang.poll_world()
+            if world is not None:
+                return world
+            time.sleep(0.05)
+        raise exc
+
     def _gang_resize(self, gang, world: Dict[str, Any], pass_id: int,
                      next_batch: Optional[int],
                      handler: Optional[Callable] = None) -> None:
@@ -1554,6 +1600,13 @@ class SGDTrainer:
             start = (pass_id, next_batch)
         with gang.resizing():
             gang.adopt_world(world)
+            if getattr(gang, "pod_size", 1) > 1:
+                # pod-LOCAL drain first, global commit second: this pod's
+                # survivors rendezvous over ICI before entering the
+                # cross-pod commit barrier, so a straggler inside a pod
+                # is attributed pod-locally instead of wedging the global
+                # barrier (lint --protocol pins this ordering)
+                gang.pod_barrier()
             self._resize_commit(gang, pass_id, meta)
             # invariant: this one-sided send pairs the JOINER's
             # broadcast_json receive inside _gang_join (a different

@@ -299,6 +299,33 @@ define_flag("gang_backoff_jitter", 0.5, "gang supervisor: restart backoff "
             "(thundering herd); 0 = deterministic backoff",
             validator=lambda v: 0.0 <= v <= 1.0)
 
+# Cross-pod (DCN) topology + transport (parallel/hierarchical.py,
+# resilience/dcn.py; docs/parallel.md "The dcn axis")
+define_flag("dcn_axis", "", "name of the mesh axis that crosses the "
+            "data-center network (pod boundary).  Non-empty turns on the "
+            "hierarchical gradient allreduce (ICI reduce-scatter -> DCN "
+            "allreduce of partials -> ICI allgather) and pod-as-failure-"
+            "unit elastic recovery; empty = single-pod flat collectives "
+            "(bit-identical by construction when the dcn axis has size 1)")
+define_flag("dcn_compress", False, "compress the DCN-crossing gradient "
+            "partials to bf16 with an error-feedback residual (the "
+            "quantization error is carried into the next step's partials, "
+            "so the bias does not accumulate); ICI legs stay full "
+            "precision.  Convergence-gated, not bit-exact")
+define_flag("dcn_timeout_s", 30.0, "cross-pod transport: per-attempt "
+            "timeout for one DCN exchange/broadcast before the transport "
+            "retries; the total budget is dcn_timeout_s * (dcn_retries+1) "
+            "plus backoff, after which the unreachable pod is attributed "
+            "in a typed DCNTimeout/DCNPartitioned",
+            validator=lambda v: v > 0)
+define_flag("dcn_retries", 2, "cross-pod transport: bounded retry count "
+            "per DCN exchange (exponential backoff between attempts, "
+            "jittered by --gang_backoff_jitter); exhausting it raises "
+            "DCNPartitioned when the peer pod still heartbeats (reachable "
+            "via the supervisor, unreachable via DCN) and DCNTimeout "
+            "otherwise",
+            validator=lambda v: v >= 0)
+
 # Serving runtime (paddle_tpu/serving; docs/serving.md) — the
 # `python -m paddle_tpu serve` surface
 define_flag("serve_bundle", "", "model bundle (.ptz) to serve with "

@@ -54,6 +54,7 @@ _UNITS = [
     ("spec_decode_ab", "tok/s (speculative; vs = ×plain)"),
     ("prefix_cache_ab", "tok/s (cache on; vs = ×off)"),
     ("fleet_isolation_ab", "ms (victim p99, fair share on; vs = ×off)"),
+    ("dcn_hierarchy_ab", "ms (hierarchical allreduce; vs = ×flat)"),
 ]
 
 
